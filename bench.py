@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Headline benchmark: effective throughput of the u64 modular SpGEMM.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: effective GFLOP/s of a single SpGEMM (C = A x B) over uint64 k x k
+tiles with the reference's exact mod-(2^64-1) semantics, counting 2*k^3 flops
+per contracted tile pair -- the same op count behind the reference report's
+"~500 GFLOP/s on P100" kernel claim (BASELINE.md), which is the baseline here.
+
+Config (synthesized; zero-egress -- SuiteSparse downloads unavailable):
+random block-sparse 8192x8192 elements as 256x256 blocks of k=32, 10% block
+density -- comparable tile-pair volume to the report's "100k tiles" medium
+config.  Override with --block-dim/--density/--k/--backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--block-dim", type=int, default=256)
+    p.add_argument("--k", type=int, default=32)
+    p.add_argument("--density", type=float, default=0.1)
+    p.add_argument("--backend", default=None, choices=["xla", "pallas"])
+    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--round-size", type=int, default=512)
+    args = p.parse_args()
+
+    sys.path.insert(0, ".")
+    import jax
+
+    platform = jax.devices()[0].platform
+    backend = args.backend or ("xla" if platform == "cpu" else "pallas")
+
+    from spgemm_tpu.ops.spgemm import spgemm
+    from spgemm_tpu.ops.symbolic import symbolic_join
+    from spgemm_tpu.utils.gen import random_block_sparse
+
+    rng = np.random.default_rng(42)
+    a = random_block_sparse(args.block_dim, args.block_dim, args.k, args.density, rng, "full")
+    b = random_block_sparse(args.block_dim, args.block_dim, args.k, args.density, rng, "full")
+
+    join = symbolic_join(a.coords, b.coords)
+    total_pairs = int(join.pair_ptr[-1])
+    flops = 2.0 * total_pairs * args.k ** 3
+
+    # warm-up: compile every (K, P) round shape
+    spgemm(a, b, backend=backend, round_size=args.round_size)
+
+    times = []
+    for _ in range(args.iters):
+        t0 = time.perf_counter()
+        c = spgemm(a, b, backend=backend, round_size=args.round_size)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    gflops = flops / best / 1e9
+
+    baseline_gflops = 500.0  # reference report's claimed P100 kernel rate
+    print(json.dumps({
+        "metric": f"spgemm_u64_effective_gflops_{platform}_{backend}",
+        "value": round(gflops, 3),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / baseline_gflops, 4),
+        "detail": {
+            "block_dim": args.block_dim, "k": args.k, "density": args.density,
+            "nnzb_a": a.nnzb, "nnzb_b": b.nnzb, "out_keys": join.num_keys,
+            "tile_pairs": total_pairs, "best_wall_s": round(best, 4),
+            "result_nnzb": c.nnzb,
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+"""Chain scheduler (L3): order-preserving pairwise reduction of a matrix chain.
+
+The reference's helper2() (sparse_matrix_mult.cu:287-327) halves the array each
+pass, multiplying adjacent pairs left-to-right and carrying the odd trailing
+element; correctness for the non-commutative product relies only on preserving
+left-to-right adjacency, but because the arithmetic is also non-*associative*
+(SURVEY.md section 2.9), parity requires this exact reduction tree, not just
+any ordered fold.
+
+Dispatch is a plain Python loop: each multiply is a jitted device program, so
+host-side control flow costs nothing by comparison (SURVEY.md C11).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+log = logging.getLogger("spgemm_tpu.chain")
+
+
+def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
+                  **kwargs) -> BlockSparseMatrix:
+    """Reduce [M1, ..., MN] to M1 x M2 x ... x MN with helper2's pairing.
+
+    multiply: binary op (defaults to ops.spgemm.spgemm); kwargs forwarded to it.
+    """
+    if multiply is None:
+        from spgemm_tpu.ops.spgemm import spgemm as multiply  # noqa: PLC0415
+    if not matrices:
+        raise ValueError("empty chain")
+    arr = list(matrices)
+    while len(arr) > 1:
+        nxt = []
+        for i in range(0, len(arr) - 1, 2):
+            log.info("multiplying %d %d", i, i + 1)  # the reference's :301 progress line
+            nxt.append(multiply(arr[i], arr[i + 1], **kwargs))
+        if len(arr) % 2 == 1:
+            nxt.append(arr[-1])  # odd element carried (:315-321)
+        arr = nxt
+    return arr[0]

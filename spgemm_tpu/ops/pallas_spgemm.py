@@ -1,0 +1,106 @@
+"""Pallas TPU kernel for the SpGEMM numeric phase (L1 -- the reference's C7).
+
+The reference's CUDA kernel (matrix_multiplyKernel, sparse_matrix_mult.cu:44-66)
+launches one thread block per output tile with k x k threads, each thread
+folding its pair list sequentially.  The TPU-native shape of the same work:
+
+  * grid = (num_keys, max_pairs): the pair axis is the innermost grid
+    dimension, and TPU grids execute sequentially, so each output tile's
+    pairs accumulate in exactly the reference's order (SURVEY.md section 2.9
+    -- the arithmetic is non-associative, so this ordering is load-bearing).
+  * scalar-prefetched index arrays pa/pb drive the BlockSpec index_maps:
+    the pipeline DMAs exactly the (A, B) tile pair each step needs from HBM
+    into VMEM -- the TPU equivalent of the reference's host-side pack+H2D
+    staging (sparse_matrix_mult.cu:189-238), with zero host involvement.
+  * the k x k tile contraction is k unrolled VPU steps of (hi, lo) uint32
+    limb arithmetic (ops/u64.py) -- TPUs have no native u64, and the MXU
+    cannot do exact wrap-then-mod integer arithmetic, so this is VPU work
+    by design (SURVEY.md section 7).
+  * the output block revisits the same VMEM buffer across the pair axis
+    (accumulator-in-output pattern); it is initialized at pair 0.
+
+Sentinel pairs (padding) index an all-zero tile, contributing exactly 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from spgemm_tpu.ops import u64
+
+
+def _kernel(pa_ref, pb_ref, a_hi_ref, a_lo_ref, b_hi_ref, b_lo_ref,
+            out_hi_ref, out_lo_ref, *, k: int):
+    pair = pl.program_id(1)
+
+    @pl.when(pair == 0)
+    def _init():
+        out_hi_ref[...] = jnp.zeros_like(out_hi_ref)
+        out_lo_ref[...] = jnp.zeros_like(out_lo_ref)
+
+    ah = a_hi_ref[0]  # (k, k) uint32
+    al = a_lo_ref[0]
+    bh = b_hi_ref[0]
+    bl = b_lo_ref[0]
+    acc_h = out_hi_ref[0]
+    acc_l = out_lo_ref[0]
+
+    # The reference's j-loop (sparse_matrix_mult.cu:56-62), unrolled (k is
+    # static): fold the outer product of A's column j with B's row j.
+    for j in range(k):
+        acc_h, acc_l = u64.mac(
+            acc_h, acc_l,
+            ah[:, j : j + 1], al[:, j : j + 1],
+            bh[j : j + 1, :], bl[j : j + 1, :],
+        )
+
+    out_hi_ref[0] = acc_h
+    out_lo_ref[0] = acc_l
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None):
+    """Same contract as ops.spgemm.numeric_round_impl, as a Pallas kernel.
+
+    a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
+    pa, pb  : (K, P) int32 slab indices, per-key j-ascending, sentinel-padded.
+    Returns (out_hi, out_lo): (K, k, k) uint32.
+    """
+    K, P = pa.shape
+    k = a_hi.shape[-1]
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # pa, pb
+        grid=(K, P),
+        in_specs=[
+            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (pa[ki, pi], 0, 0)),
+            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (pa[ki, pi], 0, 0)),
+            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (pb[ki, pi], 0, 0)),
+            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (pb[ki, pi], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (ki, 0, 0)),
+            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (ki, 0, 0)),
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((K, k, k), jnp.uint32),
+        jax.ShapeDtypeStruct((K, k, k), jnp.uint32),
+    ]
+    out_hi, out_lo = pl.pallas_call(
+        partial(_kernel, k=k),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),  # sequential: order matters
+        ),
+    )(pa, pb, a_hi, a_lo, b_hi, b_lo)
+    return out_hi, out_lo

@@ -1,0 +1,116 @@
+"""SpGEMM engine: the TPU-native equivalent of the reference's helper() (L2).
+
+Two phases, mirroring the reference's design but not its data movement:
+
+  1. symbolic (host, ops/symbolic.py): sorted merge-join -> output structure +
+     fixed-shape index rounds.  The reference's equivalent is its hash-map join
+     plus the 8 GB host staging copy (sparse_matrix_mult.cu:141-226); here no
+     tile is ever copied on host -- tiles live in HBM and the numeric phase
+     gathers them by index.
+  2. numeric (device, this file): for each round, gather (A, B) tile pairs and
+     fold them into output tiles with the exact wrap-then-mod u64 arithmetic
+     of SURVEY.md section 2.9, sequential over (pair, j) to preserve the
+     reference's accumulation order (matrix_multiplyKernel,
+     sparse_matrix_mult.cu:44-66).
+
+The XLA path below is the always-available implementation; ops/pallas_spgemm.py
+provides the Pallas TPU kernel for the same contract (selected via backend=).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spgemm_tpu.ops import u64
+from spgemm_tpu.ops.symbolic import plan_rounds, symbolic_join
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+
+def pack_tiles(m: BlockSparseMatrix):
+    """Tile slab -> device (hi, lo) uint32 planes with an all-zero sentinel
+    tile appended at index nnzb (padding target for the round planner)."""
+    k = m.k
+    slab = np.concatenate([m.tiles, np.zeros((1, k, k), np.uint64)], axis=0)
+    hi, lo = u64.u64_to_hilo(slab)
+    return jnp.asarray(hi), jnp.asarray(lo)
+
+
+def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
+    """One fixed-shape numeric round (unjitted impl -- wrapped by _numeric_round
+    and by parallel/rowshard's shard_map).
+
+    a_*/b_* : (nnzb + 1, k, k) uint32 tile slabs (sentinel zero tile last).
+    pa, pb  : (K, P) int32 slab indices; per-key pair lists in j-ascending
+              order, padded with the sentinel.
+    Returns (out_hi, out_lo): (K, k, k) uint32.
+
+    The fold runs sequentially over the flattened (pair, j) axis -- P*k steps
+    of vectorized (K, k, k) limb arithmetic -- because addmod is not
+    associative (SURVEY.md section 2.9).  Sentinel pairs contribute exactly 0.
+    """
+    K, P = pa.shape
+    k = a_hi.shape[-1]
+
+    ah, al = a_hi[pa], a_lo[pa]  # (K, P, k, k)
+    bh, bl = b_hi[pb], b_lo[pb]
+
+    # Walk order: for pair p, for j in 0..k-1 -- put (p, j) leading so the
+    # loop body is a static-shape dynamic-index slice.
+    ath = jnp.transpose(ah, (1, 3, 0, 2)).reshape(P * k, K, k)  # [(p,j), key, ty]
+    atl = jnp.transpose(al, (1, 3, 0, 2)).reshape(P * k, K, k)
+    bth = jnp.transpose(bh, (1, 2, 0, 3)).reshape(P * k, K, k)  # [(p,j), key, tx]
+    btl = jnp.transpose(bl, (1, 2, 0, 3)).reshape(P * k, K, k)
+
+    def body(i, acc):
+        acc_h, acc_l = acc
+        return u64.mac(
+            acc_h, acc_l,
+            ath[i][:, :, None], atl[i][:, :, None],
+            bth[i][:, None, :], btl[i][:, None, :],
+        )
+
+    zero = jnp.zeros((K, k, k), jnp.uint32)
+    out_h, out_l = jax.lax.fori_loop(0, P * k, body, (zero, zero))
+    return out_h, out_l
+
+
+_numeric_round = jax.jit(numeric_round_impl)
+
+
+def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
+           round_size: int = 512, backend: str = "xla") -> BlockSparseMatrix:
+    """C = A x B with reference-exact semantics.  Result keeps all-zero output
+    tiles (pruning happens only at final output, sparse_matrix_mult.cu:577-592)
+    and carries rows=a.rows, cols=b.cols (:281-282)."""
+    if a.k != b.k:
+        raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
+    k = a.k
+    join = symbolic_join(a.coords, b.coords)
+    if join.num_keys == 0:
+        return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k)
+
+    a_hi, a_lo = pack_tiles(a)
+    b_hi, b_lo = pack_tiles(b)
+    rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+                         round_size=round_size)
+
+    if backend == "pallas":
+        from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas as numeric  # noqa: PLC0415
+    elif backend == "xla":
+        numeric = _numeric_round
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+
+    out = np.zeros((join.num_keys, k, k), dtype=np.uint64)
+    for rnd in rounds:
+        oh, ol = numeric(a_hi, a_lo, b_hi, b_lo,
+                         jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
+        vals = u64.hilo_to_u64(np.asarray(oh), np.asarray(ol))
+        out[rnd.key_index] = vals[: len(rnd.key_index)]
+
+    return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k,
+                             coords=join.keys, tiles=out)

@@ -1,0 +1,148 @@
+"""Symbolic phase: output-structure join + round bucketing (C5, C6 -- host side).
+
+The reference builds `m2_index: rowB -> [colsB]` then joins A's blocks against
+it with hash maps (sparse_matrix_mult.cu:141-156), producing per-output-tile
+lists of inner block coordinates; the round packer (:167-226) then memcpys
+tile pairs into an 8 GB staging buffer in rounds of <= 500 output keys.
+
+Here the join is a vectorized sorted merge-join over the (already sorted)
+block-coordinate arrays -- O(nnzb + pairs) numpy, no hashing -- and "packing"
+is just index arithmetic: the numeric phase gathers tiles in HBM by index, so
+no staging copy exists.  Rounds become fixed-shape (num_keys, max_pairs)
+buckets, padded with a sentinel index that points at an all-zero tile
+(mulmod(0, x) == 0 and addmod(acc, 0) == acc, so padding is exact) -- this is
+how dynamic sparsity meets XLA's static shapes (SURVEY.md section 7).
+
+Ordering contract (parity-critical, SURVEY.md section 2.9): each output key's
+pair list is ordered by ascending inner block-coordinate j, which is exactly
+the order the reference's sorted-map traversal produces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class JoinResult:
+    """Output structure of A x B, in CSR-over-sorted-keys form.
+
+    keys     : (num_keys, 2) int64, sorted lexicographically -- output tile coords.
+    pair_ptr : (num_keys + 1,) int64 -- segment boundaries into pair_a/pair_b.
+    pair_a   : (total_pairs,) int32 -- A tile slab indices, per key in j-ascending order.
+    pair_b   : (total_pairs,) int32 -- B tile slab indices, aligned with pair_a.
+    """
+
+    keys: np.ndarray
+    pair_ptr: np.ndarray
+    pair_a: np.ndarray
+    pair_b: np.ndarray
+
+    @property
+    def num_keys(self) -> int:
+        return len(self.keys)
+
+    @property
+    def fanouts(self) -> np.ndarray:
+        return np.diff(self.pair_ptr)
+
+
+def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
+    """Structure join: which (A-tile, B-tile) pairs feed which output tile.
+
+    Both coord arrays must be lexicographically sorted by (row, col) --
+    the BlockSparseMatrix invariant.
+    """
+    empty = JoinResult(
+        keys=np.zeros((0, 2), np.int64),
+        pair_ptr=np.zeros(1, np.int64),
+        pair_a=np.zeros(0, np.int32),
+        pair_b=np.zeros(0, np.int32),
+    )
+    if len(a_coords) == 0 or len(b_coords) == 0:
+        return empty
+
+    b_rows = b_coords[:, 0]  # sorted (lex order on (row, col))
+    # For each A block (i, j): B blocks with row == j form the contiguous
+    # range [lo, hi) in the sorted B slab.
+    a_cols = a_coords[:, 1]
+    lo = np.searchsorted(b_rows, a_cols, side="left")
+    hi = np.searchsorted(b_rows, a_cols, side="right")
+    counts = hi - lo
+    total = int(counts.sum())
+    if total == 0:
+        return empty
+
+    # Segment-expand: pair stream in A-traversal order (sorted (i, j)), each A
+    # block contributing its B row-range in ascending-c order.
+    a_slot = np.repeat(np.arange(len(a_coords), dtype=np.int64), counts)
+    seg_start = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    offs = np.arange(total, dtype=np.int64) - np.repeat(seg_start, counts)
+    b_slot = np.repeat(lo, counts) + offs
+
+    out_r = a_coords[a_slot, 0]
+    out_c = b_coords[b_slot, 1]
+
+    # Stable sort by output key: within a key, the stream order is ascending
+    # inner-coordinate j (A sorted by (i, j)), which stability preserves.
+    order = np.lexsort((out_c, out_r))
+    out_r, out_c = out_r[order], out_c[order]
+    a_slot, b_slot = a_slot[order], b_slot[order]
+
+    key_change = np.empty(total, dtype=bool)
+    key_change[0] = True
+    key_change[1:] = (out_r[1:] != out_r[:-1]) | (out_c[1:] != out_c[:-1])
+    key_starts = np.flatnonzero(key_change)
+    keys = np.stack([out_r[key_starts], out_c[key_starts]], axis=1)
+    pair_ptr = np.append(key_starts, total).astype(np.int64)
+
+    return JoinResult(keys=keys, pair_ptr=pair_ptr,
+                      pair_a=a_slot.astype(np.int32), pair_b=b_slot.astype(np.int32))
+
+
+@dataclass
+class Round:
+    """One fixed-shape numeric launch: <= round_size keys, all padded to the
+    same fanout class.  The reference's 500-key round (sparse_matrix_mult.cu:181-185)
+    generalized to (pow-2 key count) x (pow-2 fanout) shape classes so the jit
+    cache stays small."""
+
+    key_index: np.ndarray  # (n,) int64 -- positions into JoinResult.keys
+    pa: np.ndarray         # (K_pad, P) int32 -- A slab indices (sentinel-padded)
+    pb: np.ndarray         # (K_pad, P) int32
+
+
+def _ceil_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
+
+
+def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
+                round_size: int = 512) -> list[Round]:
+    """Bucket output keys by fanout class and chop into fixed-shape rounds.
+
+    a_sentinel/b_sentinel: index of the appended all-zero tile in each slab.
+    Padding both the pair axis (to the fanout class) and the key axis (to a
+    pow-2 <= round_size) keeps the set of compiled shapes logarithmic.
+    """
+    rounds: list[Round] = []
+    if join.num_keys == 0:
+        return rounds
+    fan = join.fanouts
+    classes = np.array([_ceil_pow2(int(f)) for f in fan])
+    for cls in np.unique(classes):
+        members = np.flatnonzero(classes == cls)
+        P = int(cls)
+        for start in range(0, len(members), round_size):
+            chunk = members[start : start + round_size]
+            K = len(chunk)
+            K_pad = min(_ceil_pow2(K), round_size)
+            pa = np.full((K_pad, P), a_sentinel, dtype=np.int32)
+            pb = np.full((K_pad, P), b_sentinel, dtype=np.int32)
+            for row, ki in enumerate(chunk):
+                s, e = join.pair_ptr[ki], join.pair_ptr[ki + 1]
+                pa[row, : e - s] = join.pair_a[s:e]
+                pb[row, : e - s] = join.pair_b[s:e]
+            rounds.append(Round(key_index=chunk, pa=pa, pb=pb))
+    return rounds

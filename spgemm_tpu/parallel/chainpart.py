@@ -1,0 +1,57 @@
+"""Chain partition + combine: the reference's MPI distribution, re-done (C12, C14).
+
+The reference range-partitions the chain over P ranks (sparse_matrix_mult.cu:
+438-456): rank r owns [r*q, (r+1)*q - 1] with q = N/P (integer), the last rank
+takes the remainder, and if q == 0 rank 0 does everything alone (:612-666).
+Each rank reduces its sub-chain with helper2, partials are gathered to rank 0
+(:460-556) and rank 0 runs helper2 over the P partials (:557-571).
+
+Here the partition arithmetic is replicated exactly -- including the q == 0
+degenerate branch -- because with non-associative arithmetic (SURVEY.md
+section 2.9) `mpirun -np P` can produce different bits than P=1, and parity
+means matching the reference *at the same P*.  The gather disappears: partial
+products are just arrays; the combine is the same pairwise tree (a log-P
+reduction, which the reference's report claimed but its code never had --
+SURVEY.md section 0 caveat 1).
+"""
+
+from __future__ import annotations
+
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+
+def partition_chain(n: int, p: int) -> list[tuple[int, int] | None]:
+    """Rank r -> inclusive (start, end) into the chain, or None for idle ranks.
+
+    Exact replica of sparse_matrix_mult.cu:438-456 (+ :612 degenerate case).
+    """
+    q = n // p
+    if q == 0:
+        return [(0, n - 1)] + [None] * (p - 1)
+    parts: list[tuple[int, int] | None] = []
+    for r in range(p):
+        start = r * q
+        end = (r + 1) * q - 1 if r < p - 1 else n - 1
+        parts.append((start, end))
+    return parts
+
+
+def chain_product_partitioned(matrices: list[BlockSparseMatrix], num_parts: int,
+                              multiply=None, **kwargs) -> BlockSparseMatrix:
+    """Chain product with the reference's P-rank partition/combine semantics.
+
+    Equivalent to `mpirun -np num_parts ./a4`: each part reduces its sub-chain
+    with the helper2 tree, then the partials are reduced with the same tree
+    (the reference's rank-0 combine, :571)."""
+    if num_parts < 1:
+        raise ValueError("num_parts must be >= 1")
+    parts = partition_chain(len(matrices), num_parts)
+    partials = [
+        chain_product(matrices[start : end + 1], multiply=multiply, **kwargs)
+        for part in parts if part is not None
+        for start, end in [part]
+    ]
+    if len(partials) == 1:
+        return partials[0]
+    return chain_product(partials, multiply=multiply, **kwargs)

@@ -1,0 +1,51 @@
+"""Per-phase timing + structured logging (the reference's C17, done properly).
+
+The reference wraps every phase in chrono spans with the prints commented out
+(sparse_matrix_mult.cu:101,160-163,...) and reports only the final
+"time taken X seconds" (:679).  Here phases are named context managers
+accumulated in a registry, reported as structured lines, with optional
+jax.profiler traces; the CLI keeps the final `time taken` line for parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+log = logging.getLogger("spgemm_tpu.timers")
+
+
+class PhaseTimers:
+    """Accumulates wall-clock per named phase (re-entrant by name)."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def log_report(self):
+        for name in self.totals:
+            log.info("phase %s: %.4fs (x%d)", name, self.totals[name], self.counts[name])
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: str | None):
+    """jax.profiler.trace wrapper -- the XLA-level analog of the reference's
+    hand-rolled chrono spans."""
+    if trace_dir:
+        import jax
+
+        with jax.profiler.trace(trace_dir):
+            yield
+    else:
+        yield

@@ -1,0 +1,24 @@
+"""Test env: force the CPU backend with 8 virtual devices so every multi-chip
+code path (shard_map / psum / ppermute) runs single-process, per SURVEY.md
+section 4 ("multi-chip without a pod").
+
+In this environment jax is already imported at interpreter start (the axon TPU
+plugin's sitecustomize), so setting JAX_PLATFORMS here is too late; instead we
+update jax.config before any backend is initialized, which conftest load time
+guarantees."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+assert not jax._src.xla_bridge._backends, (
+    "a jax backend initialized before conftest -- platform pinning failed")
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

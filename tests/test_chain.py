@@ -1,0 +1,38 @@
+"""Chain reduction (helper2 parity) vs the oracle's reduction tree."""
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_chain
+from spgemm_tpu.utils.semantics import chain_oracle
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7])
+def test_chain_vs_oracle(n):
+    rng = np.random.default_rng(40 + n)
+    k = 2
+    mats = random_chain(n, 4, k, 0.5, rng, "full")
+    got = chain_product(mats)
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_m = BlockSparseMatrix.from_dict(mats[0].rows, mats[-1].cols, k, want)
+    assert np.array_equal(got.coords, want_m.coords)
+    assert np.array_equal(got.tiles, want_m.tiles)
+
+
+def test_chain_result_dims():
+    rng = np.random.default_rng(50)
+    from spgemm_tpu.utils.gen import random_block_sparse
+    mats = [random_block_sparse(2, 3, 2, 1.0, rng),
+            random_block_sparse(3, 4, 2, 1.0, rng),
+            random_block_sparse(4, 5, 2, 1.0, rng)]
+    got = chain_product(mats)
+    assert got.rows == 2 * 2 and got.cols == 5 * 2
+
+
+def test_single_matrix_chain():
+    rng = np.random.default_rng(51)
+    mats = random_chain(1, 3, 2, 0.5, rng)
+    got = chain_product(mats)
+    assert got == mats[0]

@@ -1,0 +1,46 @@
+"""End-to-end CLI: reference contract (folder in, ./matrix out, 'time taken')."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.cli import run
+from spgemm_tpu.utils import io_text
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_chain
+from spgemm_tpu.utils.semantics import chain_oracle
+
+
+def _expected_bytes(mats, k):
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_m = BlockSparseMatrix.from_dict(mats[0].rows, mats[-1].cols, k, want)
+    return io_text.format_matrix(want_m.prune_zeros())
+
+
+@pytest.mark.parametrize("n,dist", [(3, "full"), (5, "small"), (4, "adversarial")])
+def test_cli_end_to_end(tmp_path, capsys, n, dist):
+    rng = np.random.default_rng(60 + n)
+    k = 2
+    mats = random_chain(n, 4, k, 0.5, rng, dist)
+    folder = str(tmp_path / "in")
+    io_text.write_chain_dir(folder, mats, k)
+    out = str(tmp_path / "matrix")
+
+    rc = run([folder, "--output", out])
+    assert rc == 0
+    assert open(out, "rb").read() == _expected_bytes(mats, k)
+    assert "time taken " in capsys.readouterr().out  # :679 parity line
+
+
+def test_cli_default_output_cwd(tmp_path, monkeypatch, capsys):
+    """The reference writes to ./matrix in the cwd (sparse_matrix_mult.cu:595)."""
+    rng = np.random.default_rng(70)
+    k = 2
+    mats = random_chain(2, 3, k, 0.6, rng, "small")
+    folder = str(tmp_path / "in")
+    io_text.write_chain_dir(folder, mats, k)
+    monkeypatch.chdir(tmp_path)
+    assert run([folder]) == 0
+    assert os.path.exists(tmp_path / "matrix")
+    assert (tmp_path / "matrix").read_bytes() == _expected_bytes(mats, k)

@@ -1,0 +1,84 @@
+"""Text format reader/writer: roundtrip, byte-exactness, std::map semantics."""
+
+import numpy as np
+
+from spgemm_tpu.utils import io_text
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_block_sparse
+
+
+def test_golden_bytes_exact_format(tmp_path):
+    """Writer must match the reference's byte format (sparse_matrix_mult.cu:595-608):
+    'R C\\n', 'blocks\\n', per tile 'r c\\n' + k space-joined rows, no trailing space."""
+    m = BlockSparseMatrix.from_blocks(
+        4, 4, 2,
+        coords=[(2, 0), (0, 2)],  # unsorted on purpose: writer emits sorted order
+        tiles=np.array([[[1, 2], [3, 4]],
+                        [[18446744073709551615, 0], [7, 8]]], dtype=np.uint64),
+    )
+    golden = (b"4 4\n2\n"
+              b"0 2\n18446744073709551615 0\n7 8\n"
+              b"2 0\n1 2\n3 4\n")
+    assert io_text.format_matrix(m) == golden
+    path = tmp_path / "matrix"
+    io_text.write_matrix(str(path), m)
+    assert path.read_bytes() == golden
+
+
+def test_reader_roundtrip(tmp_path):
+    rng = np.random.default_rng(20)
+    m = random_block_sparse(8, 8, 4, 0.3, rng, "full")
+    path = tmp_path / "matrix1"
+    io_text.write_matrix(str(path), m)
+    m2 = io_text.read_matrix(str(path), 4)
+    assert m2 == m
+
+
+def test_reader_whitespace_insensitive(tmp_path):
+    """istream >> semantics: any whitespace separates tokens."""
+    text = "2 2\n1\n0    0\n1 2\n3\t4\n"
+    path = tmp_path / "m"
+    path.write_text(text)
+    m = io_text.read_matrix(str(path), 2)
+    assert m.rows == 2 and m.cols == 2 and m.nnzb == 1
+    assert np.array_equal(m.tiles[0], np.array([[1, 2], [3, 4]], dtype=np.uint64))
+
+
+def test_duplicate_coords_last_wins(tmp_path):
+    """std::map operator[] overwrite (sparse_matrix_mult.cu:383)."""
+    text = "2 2\n2\n0 0\n1 1\n1 1\n0 0\n9 9\n9 9\n"
+    path = tmp_path / "m"
+    path.write_text(text)
+    m = io_text.read_matrix(str(path), 2)
+    assert m.nnzb == 1
+    assert np.array_equal(m.tiles[0], np.full((2, 2), 9, dtype=np.uint64))
+
+
+def test_chain_dir_roundtrip(tmp_path):
+    rng = np.random.default_rng(21)
+    mats = [random_block_sparse(4, 4, 2, 0.5, rng) for _ in range(3)]
+    folder = str(tmp_path / "chain")
+    io_text.write_chain_dir(folder, mats, 2)
+    n, k = io_text.read_size(folder)
+    assert (n, k) == (3, 2)
+    loaded = io_text.read_chain(folder, 0, n - 1, k)
+    for a, b in zip(loaded, mats):
+        assert a == b
+
+
+def test_empty_matrix(tmp_path):
+    path = tmp_path / "m"
+    path.write_text("8 8\n0\n")
+    m = io_text.read_matrix(str(path), 4)
+    assert m.nnzb == 0
+    io_text.write_matrix(str(tmp_path / "out"), m)
+    assert (tmp_path / "out").read_bytes() == b"8 8\n0\n"
+
+
+def test_prune_zeros():
+    tiles = np.zeros((3, 2, 2), dtype=np.uint64)
+    tiles[1, 0, 1] = 5
+    m = BlockSparseMatrix.from_blocks(4, 4, 2, [(0, 0), (0, 1), (1, 1)], tiles)
+    p = m.prune_zeros()
+    assert p.nnzb == 1
+    assert tuple(p.coords[0]) == (0, 1)

@@ -1,0 +1,36 @@
+"""Pallas numeric kernel vs the XLA numeric phase and the oracle.
+
+Runs in interpret mode on the CPU backend (SURVEY.md section 4: multi-chip /
+kernel testing without a pod); the real-TPU compile path is exercised by
+bench.py and the CLI on hardware.
+"""
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.ops.spgemm import spgemm
+from spgemm_tpu.utils.gen import random_block_sparse
+from spgemm_tpu.utils.semantics import spgemm_oracle
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+
+@pytest.mark.parametrize("dist", ["small", "full", "adversarial"])
+@pytest.mark.parametrize("k", [2, 8])
+def test_pallas_backend_vs_oracle(k, dist):
+    rng = np.random.default_rng(2000 * k + len(dist))
+    a = random_block_sparse(5, 5, k, 0.4, rng, dist)
+    b = random_block_sparse(5, 5, k, 0.4, rng, dist)
+    got = spgemm(a, b, backend="pallas")
+    want = spgemm_oracle(a.to_dict(), b.to_dict(), k)
+    want_m = BlockSparseMatrix.from_dict(a.rows, b.cols, k, want)
+    assert np.array_equal(got.coords, want_m.coords)
+    assert np.array_equal(got.tiles, want_m.tiles)
+
+
+def test_pallas_multi_round_and_padding():
+    rng = np.random.default_rng(77)
+    a = random_block_sparse(9, 9, 4, 0.5, rng, "full")
+    b = random_block_sparse(9, 9, 4, 0.5, rng, "full")
+    got = spgemm(a, b, backend="pallas", round_size=4)
+    want = spgemm(a, b, backend="xla")
+    assert got == want

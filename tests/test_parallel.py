@@ -1,0 +1,141 @@
+"""Distribution layer on the 8-virtual-device CPU mesh (SURVEY.md section 4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.ops import u64
+from spgemm_tpu.parallel.chainpart import chain_product_partitioned, partition_chain
+from spgemm_tpu.parallel.innershard import spgemm_inner
+from spgemm_tpu.parallel.mesh import default_mesh
+from spgemm_tpu.parallel.rowshard import spgemm_sharded
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_block_sparse, random_chain
+from spgemm_tpu.utils.semantics import MAX_INT, spgemm_oracle
+
+import jax.numpy as jnp
+
+
+def test_virtual_mesh_present():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual CPU devices"
+
+
+# -- rowshard: bit-exact output-space sharding ------------------------------
+
+@pytest.mark.parametrize("dist", ["full", "adversarial"])
+def test_rowshard_vs_oracle_bit_exact(dist):
+    rng = np.random.default_rng(300 + len(dist))
+    k = 4
+    a = random_block_sparse(7, 7, k, 0.4, rng, dist)
+    b = random_block_sparse(7, 7, k, 0.4, rng, dist)
+    got = spgemm_sharded(a, b)
+    want = spgemm_oracle(a.to_dict(), b.to_dict(), k)
+    want_m = BlockSparseMatrix.from_dict(a.rows, b.cols, k, want)
+    assert np.array_equal(got.coords, want_m.coords)
+    assert np.array_equal(got.tiles, want_m.tiles)
+
+
+def test_rowshard_small_key_count():
+    """Fewer output keys than devices: padding must not corrupt results."""
+    rng = np.random.default_rng(310)
+    k = 2
+    a = random_block_sparse(2, 2, k, 1.0, rng, "full")
+    b = random_block_sparse(2, 2, k, 1.0, rng, "full")
+    from spgemm_tpu.ops.spgemm import spgemm
+    assert spgemm_sharded(a, b) == spgemm(a, b)
+
+
+# -- field-mode arithmetic --------------------------------------------------
+
+def test_field_ops_vs_python_int():
+    rng = np.random.default_rng(320)
+    a = rng.integers(0, 1 << 64, size=512, dtype=np.uint64)
+    b = rng.integers(0, 1 << 64, size=512, dtype=np.uint64)
+    corners = np.array([0, 1, MAX_INT, MAX_INT - 1, 1 << 32, 1 << 63],
+                       dtype=np.uint64)
+    ca, cb = np.meshgrid(corners, corners)
+    a, b = np.concatenate([a, ca.ravel()]), np.concatenate([b, cb.ravel()])
+    ah, al = u64.u64_to_hilo(a)
+    bh, bl = u64.u64_to_hilo(b)
+    ja = (jnp.asarray(ah), jnp.asarray(al))
+    jb = (jnp.asarray(bh), jnp.asarray(bl))
+
+    sh, sl = u64.addmod_field(*ja, *jb)
+    got_add = u64.hilo_to_u64(np.asarray(sh), np.asarray(sl))
+    want_add = np.array([(int(x) + int(y)) % MAX_INT for x, y in zip(a, b)],
+                        dtype=np.uint64)
+    assert np.array_equal(got_add, want_add)
+
+    mh, ml = u64.mulmod_field(*ja, *jb)
+    got_mul = u64.hilo_to_u64(np.asarray(mh), np.asarray(ml))
+    want_mul = np.array([(int(x) * int(y)) % MAX_INT for x, y in zip(a, b)],
+                        dtype=np.uint64)
+    assert np.array_equal(got_mul, want_mul)
+
+
+def test_innershard_matches_reference_on_small_values():
+    """Below 2^32 nothing wraps, so field mode == reference mode exactly."""
+    rng = np.random.default_rng(330)
+    k = 4
+    a = random_block_sparse(6, 6, k, 0.5, rng, "small")
+    b = random_block_sparse(6, 6, k, 0.5, rng, "small")
+    got = spgemm_inner(a, b)
+    want = spgemm_oracle(a.to_dict(), b.to_dict(), k)
+    want_m = BlockSparseMatrix.from_dict(a.rows, b.cols, k, want)
+    assert np.array_equal(got.coords, want_m.coords)
+    assert np.array_equal(got.tiles, want_m.tiles)
+
+
+def test_innershard_field_semantics_on_full_values():
+    """On arbitrary u64 data, innershard computes the clean mod-(2^64-1) product."""
+    rng = np.random.default_rng(340)
+    k = 2
+    a = random_block_sparse(4, 4, k, 0.6, rng, "full")
+    b = random_block_sparse(4, 4, k, 0.6, rng, "full")
+    got = spgemm_inner(a, b)
+
+    # python-int clean modular oracle
+    ad, bd = a.to_dict(), b.to_dict()
+    want: dict = {}
+    for (ar, ac), a_tile in ad.items():
+        for (br, bc), b_tile in bd.items():
+            if ac != br:
+                continue
+            acc = want.setdefault((ar, bc), [[0] * k for _ in range(k)])
+            for ty in range(k):
+                for tx in range(k):
+                    s = acc[ty][tx]
+                    for j in range(k):
+                        s = (s + int(a_tile[ty][j]) * int(b_tile[j][tx])) % MAX_INT
+                    acc[ty][tx] = s
+    for i, (r, c) in enumerate(got.coords):
+        tile = np.array(want[(int(r), int(c))], dtype=np.uint64)
+        assert np.array_equal(got.tiles[i], tile)
+
+
+# -- chain partition (MPI semantics) ----------------------------------------
+
+def test_partition_chain_reference_arithmetic():
+    # N=10, P=3: q=3 -> [0,2],[3,5],[6,9] (last rank takes remainder)
+    assert partition_chain(10, 3) == [(0, 2), (3, 5), (6, 9)]
+    # N < P: only rank 0 works
+    assert partition_chain(2, 4) == [(0, 1), None, None, None]
+    assert partition_chain(8, 1) == [(0, 7)]
+
+
+@pytest.mark.parametrize("n,p", [(7, 3), (8, 2), (3, 8), (5, 5)])
+def test_chain_partitioned_matches_manual(n, p):
+    rng = np.random.default_rng(350 + n * 10 + p)
+    k = 2
+    mats = random_chain(n, 3, k, 0.6, rng, "full")
+    got = chain_product_partitioned(mats, p)
+    parts = [pt for pt in partition_chain(n, p) if pt is not None]
+    partials = [chain_product(mats[s : e + 1]) for s, e in parts]
+    want = partials[0] if len(partials) == 1 else chain_product(partials)
+    assert got == want
+
+
+def test_mesh_helper():
+    m = default_mesh(4)
+    assert m.devices.size == 4

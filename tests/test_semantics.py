@@ -1,0 +1,71 @@
+"""numpy oracle (utils/semantics) vs the dead-simple python-int reference."""
+
+import numpy as np
+
+from spgemm_tpu.utils import semantics as sem
+from spgemm_tpu.utils.gen import random_values
+
+
+def test_mulmod_addmod_np_vs_scalar():
+    rng = np.random.default_rng(10)
+    a = random_values(512, rng, "full")
+    b = random_values(512, rng, "full")
+    got = sem.mulmod_np(a, b)
+    want = np.array([sem.scalar_mac(0, int(x), int(y)) for x, y in zip(a, b)],
+                    dtype=np.uint64)
+    assert np.array_equal(got, want)
+
+
+def test_tile_pair_mac_np_vs_scalar_tile():
+    rng = np.random.default_rng(11)
+    k = 4
+    for dist in ("full", "small", "adversarial"):
+        a_tile = random_values((k, k), rng, dist)
+        b_tile = random_values((k, k), rng, dist)
+        acc0 = random_values((k, k), rng, dist)
+        got = sem.tile_pair_mac_np(acc0.copy(), a_tile, b_tile)
+        want = np.array(sem.scalar_tile_matmul(acc0, a_tile, b_tile), dtype=np.uint64)
+        assert np.array_equal(got, want), dist
+
+
+def test_spgemm_oracle_small_dense_identity():
+    k = 2
+    ident = {(0, 0): np.eye(k, dtype=np.uint64), (1, 1): np.eye(k, dtype=np.uint64)}
+    rng = np.random.default_rng(12)
+    m = {(0, 0): random_values((k, k), rng, "small"),
+         (0, 1): random_values((k, k), rng, "small"),
+         (1, 0): random_values((k, k), rng, "small")}
+    out = sem.spgemm_oracle(ident, m, k)
+    assert set(out.keys()) == set(m.keys())
+    for key in m:
+        assert np.array_equal(out[key], m[key])
+
+
+def test_spgemm_oracle_pair_order_is_j_ascending():
+    """Construct a case where wrong pair order changes the result."""
+    k = 1
+    big = np.array([[0xFFFFFFFFFFFFFFFE]], dtype=np.uint64)
+    one = np.array([[1]], dtype=np.uint64)
+    # output (0,0) accumulates j=0 then j=1: order affects the wrap quirk
+    a = {(0, 0): big, (0, 1): one}
+    b = {(0, 0): big, (1, 0): big}
+    out = sem.spgemm_oracle(a, b, k)
+    # manual fold in j-ascending order
+    acc = sem.scalar_mac(0, int(big[0, 0]), int(big[0, 0]))
+    acc = sem.scalar_mac(acc, 1, int(big[0, 0]))
+    assert int(out[(0, 0)][0, 0]) == acc
+
+
+def test_chain_oracle_odd_carry():
+    rng = np.random.default_rng(13)
+    k = 2
+    mats = []
+    for _ in range(5):
+        mats.append({(0, 0): random_values((k, k), rng, "full")})
+    got = sem.chain_oracle(mats, k)
+    # helper2 pairing for 5: ((M0 M1)(M2 M3)) then ((P0 P1) M4) -> ((P01) M4)
+    p0 = sem.spgemm_oracle(mats[0], mats[1], k)
+    p1 = sem.spgemm_oracle(mats[2], mats[3], k)
+    q0 = sem.spgemm_oracle(p0, p1, k)
+    want = sem.spgemm_oracle(q0, mats[4], k)
+    assert np.array_equal(got[(0, 0)], want[(0, 0)])

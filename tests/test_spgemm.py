@@ -1,0 +1,89 @@
+"""Single-chip SpGEMM (symbolic + XLA numeric) vs the numpy oracle."""
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.ops.spgemm import spgemm
+from spgemm_tpu.ops.symbolic import plan_rounds, symbolic_join
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_block_sparse
+from spgemm_tpu.utils.semantics import spgemm_oracle
+
+
+def assert_matches_oracle(a: BlockSparseMatrix, b: BlockSparseMatrix, **kw):
+    got = spgemm(a, b, **kw)
+    want = spgemm_oracle(a.to_dict(), b.to_dict(), a.k)
+    want_m = BlockSparseMatrix.from_dict(a.rows, b.cols, a.k, want)
+    assert got.nnzb == want_m.nnzb, (got.coords, want_m.coords)
+    assert np.array_equal(got.coords, want_m.coords)
+    assert np.array_equal(got.tiles, want_m.tiles)
+
+
+@pytest.mark.parametrize("dist", ["small", "full", "adversarial"])
+@pytest.mark.parametrize("k", [1, 2, 4, 8])
+def test_random_vs_oracle(k, dist):
+    # deterministic seed (str hash() is salted per process)
+    rng = np.random.default_rng(1000 * k + len(dist))
+    a = random_block_sparse(6, 6, k, 0.4, rng, dist)
+    b = random_block_sparse(6, 6, k, 0.4, rng, dist)
+    assert_matches_oracle(a, b)
+
+
+def test_rectangular():
+    rng = np.random.default_rng(30)
+    a = random_block_sparse(3, 7, 4, 0.5, rng, "full")
+    b = random_block_sparse(7, 2, 4, 0.5, rng, "full")
+    assert_matches_oracle(a, b)
+
+
+def test_no_structural_match():
+    """A's cols never meet B's rows -> empty result with correct dims."""
+    a = BlockSparseMatrix.from_blocks(4, 4, 2, [(0, 0)],
+                                      np.ones((1, 2, 2), np.uint64))
+    b = BlockSparseMatrix.from_blocks(4, 4, 2, [(1, 1)],
+                                      np.ones((1, 2, 2), np.uint64))
+    c = spgemm(a, b)
+    assert c.nnzb == 0 and c.rows == 4 and c.cols == 4
+
+
+def test_zero_product_tiles_kept():
+    """All-zero output tiles are NOT pruned by spgemm (only at final write)."""
+    k = 2
+    a = BlockSparseMatrix.from_blocks(2, 2, k, [(0, 0)],
+                                      np.zeros((1, k, k), np.uint64))
+    b = BlockSparseMatrix.from_blocks(2, 2, k, [(0, 0)],
+                                      np.ones((1, k, k), np.uint64))
+    c = spgemm(a, b)
+    assert c.nnzb == 1
+    assert np.all(c.tiles == 0)
+
+
+def test_small_round_size_multiple_rounds():
+    rng = np.random.default_rng(31)
+    a = random_block_sparse(10, 10, 2, 0.4, rng, "full")
+    b = random_block_sparse(10, 10, 2, 0.4, rng, "full")
+    assert_matches_oracle(a, b, round_size=4)
+
+
+def test_symbolic_join_pair_order():
+    """Pair lists must be j-ascending (reference map order, SURVEY 2.9)."""
+    a_coords = np.array([(0, 0), (0, 1), (0, 3)], dtype=np.int64)
+    b_coords = np.array([(0, 5), (1, 5), (3, 5)], dtype=np.int64)
+    join = symbolic_join(a_coords, b_coords)
+    assert join.num_keys == 1
+    assert tuple(join.keys[0]) == (0, 5)
+    # pairs in ascending inner-coordinate order: j = 0, 1, 3
+    inner = a_coords[join.pair_a, 1]
+    assert list(inner) == [0, 1, 3]
+
+
+def test_plan_rounds_shapes_and_sentinels():
+    a_coords = np.array([(0, 0), (0, 1), (1, 0)], dtype=np.int64)
+    b_coords = np.array([(0, 0), (1, 0)], dtype=np.int64)
+    join = symbolic_join(a_coords, b_coords)
+    rounds = plan_rounds(join, a_sentinel=3, b_sentinel=2, round_size=512)
+    covered = np.concatenate([r.key_index for r in rounds])
+    assert sorted(covered.tolist()) == list(range(join.num_keys))
+    for r in rounds:
+        assert r.pa.shape == r.pb.shape
+        assert (r.pa.shape[1] & (r.pa.shape[1] - 1)) == 0  # pow2 fanout class

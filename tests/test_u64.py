@@ -1,0 +1,109 @@
+"""Limb arithmetic (ops/u64) vs exact python ints, including every wrap corner."""
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.ops import u64
+from spgemm_tpu.utils.gen import ADVERSARIAL_VALUES
+from spgemm_tpu.utils.semantics import MAX_INT, scalar_mac
+
+import jax.numpy as jnp
+
+
+def _pairs(rng, n=2048):
+    a = rng.integers(0, 1 << 64, size=n, dtype=np.uint64)
+    b = rng.integers(0, 1 << 64, size=n, dtype=np.uint64)
+    # splice in the full adversarial cross product
+    adv = ADVERSARIAL_VALUES
+    aa, bb = np.meshgrid(adv, adv)
+    a = np.concatenate([a, aa.ravel()])
+    b = np.concatenate([b, bb.ravel()])
+    return a, b
+
+
+def test_hilo_roundtrip():
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 1 << 64, size=1000, dtype=np.uint64)
+    hi, lo = u64.u64_to_hilo(x)
+    assert hi.dtype == np.uint32 and lo.dtype == np.uint32
+    assert np.array_equal(u64.hilo_to_u64(hi, lo), x)
+
+
+def test_mul32_wide_exact():
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 1 << 32, size=4096, dtype=np.uint32)
+    b = rng.integers(0, 1 << 32, size=4096, dtype=np.uint32)
+    edges = np.array([0, 1, 0xFFFF, 0x10000, 0xFFFFFFFF, 0x80000000], dtype=np.uint32)
+    ea, eb = np.meshgrid(edges, edges)
+    a, b = np.concatenate([a, ea.ravel()]), np.concatenate([b, eb.ravel()])
+    hi, lo = u64.mul32_wide(jnp.asarray(a), jnp.asarray(b))
+    got = u64.hilo_to_u64(np.asarray(hi), np.asarray(lo))
+    want = a.astype(np.uint64) * b.astype(np.uint64)  # exact: fits in u64
+    assert np.array_equal(got, want)
+
+
+def test_mul64_lo_matches_wrapping_product():
+    rng = np.random.default_rng(2)
+    a, b = _pairs(rng)
+    ah, al = u64.u64_to_hilo(a)
+    bh, bl = u64.u64_to_hilo(b)
+    hi, lo = u64.mul64_lo(jnp.asarray(ah), jnp.asarray(al), jnp.asarray(bh), jnp.asarray(bl))
+    got = u64.hilo_to_u64(np.asarray(hi), np.asarray(lo))
+    want = np.array([(int(x) * int(y)) & MAX_INT for x, y in zip(a, b)], dtype=np.uint64)
+    assert np.array_equal(got, want)
+
+
+def test_addmod_and_mulmod_vs_python():
+    rng = np.random.default_rng(3)
+    a, b = _pairs(rng)
+    ah, al = u64.u64_to_hilo(a)
+    bh, bl = u64.u64_to_hilo(b)
+    ja, jb = (jnp.asarray(ah), jnp.asarray(al)), (jnp.asarray(bh), jnp.asarray(bl))
+
+    mh, ml = u64.mulmod(*ja, *jb)
+    got_mul = u64.hilo_to_u64(np.asarray(mh), np.asarray(ml))
+    want_mul = np.array([scalar_mac(0, int(x), int(y)) for x, y in zip(a, b)],
+                        dtype=np.uint64)
+    assert np.array_equal(got_mul, want_mul)
+
+    sh, sl = u64.addmod(*ja, *jb)
+    got_add = u64.hilo_to_u64(np.asarray(sh), np.asarray(sl))
+
+    def ref_add(x, y):
+        s = (int(x) + int(y)) & MAX_INT
+        return 0 if s == MAX_INT else s
+
+    want_add = np.array([ref_add(x, y) for x, y in zip(a, b)], dtype=np.uint64)
+    assert np.array_equal(got_add, want_add)
+
+
+def test_mac_sequence_order_dependence():
+    """The non-associativity quirk itself: folding must match scalar_mac order."""
+    rng = np.random.default_rng(4)
+    vals_a = rng.integers(0, 1 << 64, size=64, dtype=np.uint64)
+    vals_b = rng.integers(0, 1 << 64, size=64, dtype=np.uint64)
+
+    acc_int = 0
+    for x, y in zip(vals_a, vals_b):
+        acc_int = scalar_mac(acc_int, int(x), int(y))
+
+    acc_h = jnp.zeros((), jnp.uint32)
+    acc_l = jnp.zeros((), jnp.uint32)
+    for x, y in zip(vals_a, vals_b):
+        ah, al = u64.u64_to_hilo(np.uint64(x))
+        bh, bl = u64.u64_to_hilo(np.uint64(y))
+        acc_h, acc_l = u64.mac(acc_h, acc_l,
+                               jnp.uint32(ah), jnp.uint32(al),
+                               jnp.uint32(bh), jnp.uint32(bl))
+    got = int(u64.hilo_to_u64(np.asarray(acc_h), np.asarray(acc_l)))
+    assert got == acc_int
+
+
+@pytest.mark.parametrize("a,b", [(MAX_INT, MAX_INT), (MAX_INT, 1), (1 << 63, 2),
+                                 (MAX_INT - 1, MAX_INT - 1), (0, MAX_INT)])
+def test_known_corners(a, b):
+    ah, al = u64.u64_to_hilo(np.uint64(a))
+    bh, bl = u64.u64_to_hilo(np.uint64(b))
+    mh, ml = u64.mulmod(jnp.uint32(ah), jnp.uint32(al), jnp.uint32(bh), jnp.uint32(bl))
+    got = int(u64.hilo_to_u64(np.asarray(mh), np.asarray(ml)))
+    assert got == scalar_mac(0, a, b)
