@@ -1,0 +1,47 @@
+# tpu-spgemm build + run targets.
+#
+# The reference's Makefile compiles nvcc+mpicxx into binary `a4` run as
+# `mpirun -np P ./a4 <folder>`.  Here there is no compiler in the TPU loop
+# (north star, BASELINE.json): `make run DEVICE=tpu FOLDER=<dir>` invokes the
+# JAX entrypoint directly; `make native` builds the C++ I/O library.
+
+PY      ?= python
+DEVICE  ?= tpu
+FOLDER  ?=
+RANKS   ?= 1
+BACKEND ?= xla
+SHARD   ?= none
+
+NATIVE_SRC = spgemm_tpu/native/smmio.cpp
+NATIVE_SO  = spgemm_tpu/native/libsmmio.so
+
+.PHONY: all native run test bench clean
+
+all: native
+
+native: $(NATIVE_SO)
+
+$(NATIVE_SO): $(NATIVE_SRC)
+	g++ -O3 -march=native -shared -fPIC -o $@ $<
+
+# DEVICE=tpu runs on whatever TPU platform JAX sees (the default);
+# DEVICE=cpu forces the CPU backend.
+run:
+ifeq ($(FOLDER),)
+	$(error usage: make run FOLDER=<input dir> [DEVICE=tpu|cpu] [RANKS=P] [BACKEND=xla|pallas] [SHARD=none|keys|inner])
+endif
+ifeq ($(DEVICE),tpu)
+	$(PY) -m spgemm_tpu.cli $(FOLDER) --backend $(BACKEND) --shard $(SHARD) --ranks $(RANKS)
+else
+	$(PY) -m spgemm_tpu.cli $(FOLDER) --device $(DEVICE) --backend $(BACKEND) --shard $(SHARD) --ranks $(RANKS)
+endif
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -f $(NATIVE_SO)
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

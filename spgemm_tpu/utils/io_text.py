@@ -42,11 +42,19 @@ def read_size(folder: str) -> tuple[int, int]:
 def read_matrix(path: str, k: int) -> BlockSparseMatrix:
     """Parse one matrix file into a BlockSparseMatrix.
 
-    Token-vectorized: everything after the 3-token header is one uint64 parse
-    + reshape to (blocks, 2 + k*k), instead of the reference's per-element
-    formatted `>>` reads (sparse_matrix_mult.cu:372-380) that motivated its
-    OpenMP task pool.
+    Fast path: the native C++ tokenizer (utils/native.py, GIL-released).
+    Fallback is token-vectorized numpy: everything after the 3-token header is
+    one uint64 parse + reshape to (blocks, 2 + k*k).  Either way, no
+    per-element formatted reads (the reference's `>>` loop at
+    sparse_matrix_mult.cu:372-380 is what motivated its OpenMP task pool).
     """
+    from spgemm_tpu.utils import native
+
+    parsed = native.parse_matrix(path, k)
+    if parsed is not None:
+        rows, cols, coords, tiles = parsed
+        return BlockSparseMatrix.from_blocks(rows, cols, k, coords, tiles)
+
     with open(path, "rb") as f:
         toks = f.read().split()
     if len(toks) < 3:
@@ -95,6 +103,10 @@ def write_matrix(path: str, m: BlockSparseMatrix) -> None:
 
     NOTE: the reference prunes all-zero tiles before writing
     (sparse_matrix_mult.cu:577-592); callers do that via m.prune_zeros()."""
+    from spgemm_tpu.utils import native
+
+    if native.write_matrix(path, m.rows, m.cols, m.k, m.coords, m.tiles):
+        return
     with open(path, "wb") as f:
         f.write(format_matrix(m))
 

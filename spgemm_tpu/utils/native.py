@@ -1,0 +1,121 @@
+"""ctypes bindings for the native I/O library (spgemm_tpu/native/smmio.cpp).
+
+Loads libsmmio.so if present, building it once with g++ if the source is newer
+(no pybind11 in this image; the C ABI + ctypes is the binding layer).  All
+entry points release the GIL for their full duration, so the loader thread
+pool gets real parallelism -- the reference's OpenMP-task-per-file pattern
+(sparse_matrix_mult.cu:334-341) without the hardcoded thread count.
+
+Set SPGEMM_TPU_NO_NATIVE=1 to force the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_DIR, "smmio.cpp")
+_SO = os.path.join(_DIR, "libsmmio.so")
+
+_lib = None
+_lock = threading.Lock()
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def get_lib():
+    """The loaded library, or None if unavailable/disabled."""
+    global _lib, _tried
+    if os.environ.get("SPGEMM_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        needs_build = (not os.path.exists(_SO)
+                       or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if needs_build and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.smm_parse_matrix.restype = ctypes.c_int
+        lib.smm_parse_matrix.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ]
+        lib.smm_free.restype = None
+        lib.smm_free.argtypes = [ctypes.c_void_p]
+        lib.smm_write_matrix.restype = ctypes.c_int
+        lib.smm_write_matrix.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+        return _lib
+
+
+def parse_matrix(path: str, k: int):
+    """Parse via native code -> (rows, cols, coords (nnzb,2) i64, tiles (nnzb,k,k) u64).
+
+    Returns None if the native library is unavailable; raises on parse errors.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    header = (ctypes.c_int64 * 3)()
+    coords_p = ctypes.POINTER(ctypes.c_int64)()
+    tiles_p = ctypes.POINTER(ctypes.c_uint64)()
+    rc = lib.smm_parse_matrix(path.encode(), k, header,
+                              ctypes.byref(coords_p), ctypes.byref(tiles_p))
+    if rc == -1:
+        raise FileNotFoundError(f"cannot open {path!r}")
+    if rc != 0:
+        raise ValueError(f"malformed matrix file {path!r} (native rc={rc})")
+    rows, cols, blocks = header[0], header[1], header[2]
+    try:
+        if blocks == 0:
+            coords = np.zeros((0, 2), np.int64)
+            tiles = np.zeros((0, k, k), np.uint64)
+        else:
+            coords = np.ctypeslib.as_array(coords_p, shape=(blocks, 2)).copy()
+            tiles = np.ctypeslib.as_array(tiles_p, shape=(blocks, k, k)).copy()
+    finally:
+        if blocks != 0:
+            lib.smm_free(coords_p)
+            lib.smm_free(tiles_p)
+    return int(rows), int(cols), coords, tiles
+
+
+def write_matrix(path: str, rows: int, cols: int, k: int,
+                 coords: np.ndarray, tiles: np.ndarray) -> bool:
+    """Write via native code; returns False if the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    coords = np.ascontiguousarray(coords, np.int64)
+    tiles = np.ascontiguousarray(tiles, np.uint64)
+    rc = lib.smm_write_matrix(path.encode(), rows, cols, k, len(coords),
+                              coords, tiles)
+    if rc != 0:
+        raise OSError(f"native writer failed for {path!r} (rc={rc})")
+    return True

@@ -1,0 +1,71 @@
+"""Native C++ I/O vs the pure-Python path: identical parse, identical bytes."""
+
+import os
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.utils import io_text, native
+from spgemm_tpu.utils.gen import random_block_sparse
+
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None,
+                                reason="native library unavailable (no g++?)")
+
+
+def _py_read(path, k):
+    os.environ["SPGEMM_TPU_NO_NATIVE"] = "1"
+    try:
+        return io_text.read_matrix(path, k)
+    finally:
+        del os.environ["SPGEMM_TPU_NO_NATIVE"]
+
+
+def test_native_parse_matches_python(tmp_path):
+    rng = np.random.default_rng(90)
+    m = random_block_sparse(8, 8, 4, 0.4, rng, "full")
+    path = str(tmp_path / "m")
+    io_text.write_matrix(path, m)
+    got = io_text.read_matrix(path, 4)       # native path
+    want = _py_read(path, 4)                 # python path
+    assert got == want == m
+
+
+def test_native_write_bytes_identical(tmp_path):
+    rng = np.random.default_rng(91)
+    m = random_block_sparse(6, 6, 3, 0.5, rng, "adversarial")
+    p_native = str(tmp_path / "native")
+    assert native.write_matrix(p_native, m.rows, m.cols, m.k, m.coords, m.tiles)
+    assert open(p_native, "rb").read() == io_text.format_matrix(m)
+
+
+def test_native_empty_matrix(tmp_path):
+    path = str(tmp_path / "m")
+    (tmp_path / "m").write_text("8 8\n0\n")
+    rows, cols, coords, tiles = native.parse_matrix(path, 4)
+    assert (rows, cols) == (8, 8)
+    assert coords.shape == (0, 2) and tiles.shape == (0, 4, 4)
+
+
+def test_native_malformed_raises(tmp_path):
+    path = tmp_path / "m"
+    path.write_text("2 2\n1\n0 0\n1 2\n")  # truncated tile
+    with pytest.raises(ValueError):
+        native.parse_matrix(str(path), 2)
+    path2 = tmp_path / "m2"
+    path2.write_text("junk\n")
+    with pytest.raises(ValueError):
+        native.parse_matrix(str(path2), 2)
+
+
+def test_native_missing_file():
+    with pytest.raises(FileNotFoundError):
+        native.parse_matrix("/does/not/exist", 2)
+
+
+def test_native_u64_extremes(tmp_path):
+    path = tmp_path / "m"
+    path.write_text("2 2\n1\n0 0\n18446744073709551615 0\n1 18446744073709551614\n")
+    rows, cols, coords, tiles = native.parse_matrix(str(path), 2)
+    assert tiles[0, 0, 0] == np.uint64(18446744073709551615)
+    assert tiles[0, 1, 1] == np.uint64(18446744073709551614)
