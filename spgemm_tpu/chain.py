@@ -21,16 +21,27 @@ log = logging.getLogger("spgemm_tpu.chain")
 
 
 def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
+                  checkpoint_dir: str | None = None, resume: bool = True,
                   **kwargs) -> BlockSparseMatrix:
     """Reduce [M1, ..., MN] to M1 x M2 x ... x MN with helper2's pairing.
 
     multiply: binary op (defaults to ops.spgemm.spgemm); kwargs forwarded to it.
+    checkpoint_dir: if set, snapshot the surviving partials after each pass
+    (utils/checkpoint.py) and resume from the newest snapshot on restart.
     """
     if multiply is None:
         from spgemm_tpu.ops.spgemm import spgemm as multiply  # noqa: PLC0415
     if not matrices:
         raise ValueError("empty chain")
     arr = list(matrices)
+    pass_idx = 0
+    if checkpoint_dir and resume:
+        from spgemm_tpu.utils import checkpoint  # noqa: PLC0415
+        found = checkpoint.latest_pass(checkpoint_dir)
+        if found is not None:
+            pass_idx, arr = found
+            log.info("resumed from checkpoint pass %d (%d partials)",
+                     pass_idx, len(arr))
     while len(arr) > 1:
         nxt = []
         for i in range(0, len(arr) - 1, 2):
@@ -39,4 +50,8 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
         if len(arr) % 2 == 1:
             nxt.append(arr[-1])  # odd element carried (:315-321)
         arr = nxt
+        pass_idx += 1
+        if checkpoint_dir:
+            from spgemm_tpu.utils import checkpoint  # noqa: PLC0415
+            checkpoint.save_pass(checkpoint_dir, pass_idx, arr)
     return arr[0]

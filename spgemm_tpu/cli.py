@@ -45,11 +45,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max output tiles per numeric launch (reference small_size=500)")
     p.add_argument("--threads", type=int, default=16,
                    help="file-loader thread pool size (reference num_threads(16))")
-    p.add_argument("--shard", choices=["none", "keys", "inner"], default="none",
+    p.add_argument("--shard", choices=["none", "keys", "inner", "ring"], default="none",
                    help="shard the numeric phase over the visible device mesh: "
                         "'keys' = output-tile sharding (bit-exact), 'inner' = "
-                        "contraction sharding + ICI all-reduce (clean mod-(2^64-1) "
-                        "arithmetic, see parallel/innershard.py)")
+                        "contraction sharding + ICI all-reduce, 'ring' = rotate B "
+                        "around the ring, O(1/n) operand memory ('inner'/'ring' use "
+                        "clean mod-(2^64-1) arithmetic, see parallel/)")
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="snapshot chain partials after each reduction pass and "
+                        "resume from the newest snapshot on restart")
     p.add_argument("--ranks", type=int, default=1, metavar="P",
                    help="emulate `mpirun -np P` chain partitioning semantics "
                         "(reference sparse_matrix_mult.cu:438-456)")
@@ -63,6 +67,16 @@ def run(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.device:
         os.environ["JAX_PLATFORMS"] = args.device
+        # If an embedding (e.g. a TPU plugin's sitecustomize) already imported
+        # jax, the env var alone is too late -- the config default was
+        # snapshotted at import.  Updating the config still works as long as
+        # no backend has been initialized.
+        import sys as _sys
+        if "jax" in _sys.modules:
+            import jax
+            from jax._src import xla_bridge
+            if not xla_bridge._backends:
+                jax.config.update("jax_platforms", args.device)
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,
         format="%(name)s %(message)s",
@@ -95,8 +109,13 @@ def run(argv: list[str] | None = None) -> int:
                     from spgemm_tpu.parallel.rowshard import spgemm_sharded as multiply
                 elif args.shard == "inner":
                     from spgemm_tpu.parallel.innershard import spgemm_inner as multiply
+                elif args.shard == "ring":
+                    from spgemm_tpu.parallel.ring import spgemm_ring as multiply
+                    kwargs.pop("round_size")
                 else:
                     kwargs["backend"] = args.backend
+                if args.checkpoint_dir:
+                    kwargs["checkpoint_dir"] = args.checkpoint_dir
                 if args.ranks > 1:
                     from spgemm_tpu.parallel.chainpart import chain_product_partitioned
                     result = chain_product_partitioned(

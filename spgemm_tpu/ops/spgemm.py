@@ -19,6 +19,7 @@ provides the Pallas TPU kernel for the same contract (selected via backend=).
 
 from __future__ import annotations
 
+import logging
 from functools import partial
 
 import jax
@@ -28,6 +29,8 @@ import numpy as np
 from spgemm_tpu.ops import u64
 from spgemm_tpu.ops.symbolic import plan_rounds, symbolic_join
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+log = logging.getLogger("spgemm_tpu.spgemm")
 
 
 def pack_tiles(m: BlockSparseMatrix):
@@ -111,6 +114,12 @@ def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
                          jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
         vals = u64.hilo_to_u64(np.asarray(oh), np.asarray(ol))
         out[rnd.key_index] = vals[: len(rnd.key_index)]
+
+    # structured observability (SURVEY.md section 5.5): size, fill-in, work
+    total_pairs = int(join.pair_ptr[-1])
+    log.info("spgemm[%s]: nnzb %d x %d -> keys=%d pairs=%d rounds=%d work=%.3f GFLOP",
+             backend, a.nnzb, b.nnzb, join.num_keys, total_pairs, len(rounds),
+             2.0 * total_pairs * k ** 3 / 1e9)
 
     return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k,
                              coords=join.keys, tiles=out)

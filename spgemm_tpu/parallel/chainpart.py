@@ -38,20 +38,30 @@ def partition_chain(n: int, p: int) -> list[tuple[int, int] | None]:
 
 
 def chain_product_partitioned(matrices: list[BlockSparseMatrix], num_parts: int,
-                              multiply=None, **kwargs) -> BlockSparseMatrix:
+                              multiply=None, checkpoint_dir: str | None = None,
+                              **kwargs) -> BlockSparseMatrix:
     """Chain product with the reference's P-rank partition/combine semantics.
 
     Equivalent to `mpirun -np num_parts ./a4`: each part reduces its sub-chain
     with the helper2 tree, then the partials are reduced with the same tree
-    (the reference's rank-0 combine, :571)."""
+    (the reference's rank-0 combine, :571).  With checkpoint_dir, each rank's
+    sub-chain and the combine get their own snapshot subdirectory."""
+    import os
+
     if num_parts < 1:
         raise ValueError("num_parts must be >= 1")
+
+    def sub(name):
+        return os.path.join(checkpoint_dir, name) if checkpoint_dir else None
+
     parts = partition_chain(len(matrices), num_parts)
     partials = [
-        chain_product(matrices[start : end + 1], multiply=multiply, **kwargs)
-        for part in parts if part is not None
+        chain_product(matrices[start : end + 1], multiply=multiply,
+                      checkpoint_dir=sub(f"rank{idx}"), **kwargs)
+        for idx, part in enumerate(parts) if part is not None
         for start, end in [part]
     ]
     if len(partials) == 1:
         return partials[0]
-    return chain_product(partials, multiply=multiply, **kwargs)
+    return chain_product(partials, multiply=multiply,
+                         checkpoint_dir=sub("combine"), **kwargs)

@@ -1,0 +1,62 @@
+"""Chain checkpoint/resume (utils/checkpoint + chain_product integration)."""
+
+import os
+
+import numpy as np
+
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.utils import checkpoint
+from spgemm_tpu.utils.gen import random_chain
+
+
+def test_save_load_roundtrip(tmp_path):
+    rng = np.random.default_rng(400)
+    mats = random_chain(3, 4, 2, 0.5, rng, "full")
+    path = checkpoint.save_pass(str(tmp_path), 2, mats)
+    assert os.path.exists(path)
+    idx, loaded = checkpoint.latest_pass(str(tmp_path))
+    assert idx == 2
+    assert loaded == mats
+
+
+def test_latest_pass_picks_newest(tmp_path):
+    rng = np.random.default_rng(401)
+    checkpoint.save_pass(str(tmp_path), 1, random_chain(2, 3, 2, 0.5, rng))
+    mats3 = random_chain(1, 3, 2, 0.5, rng)
+    checkpoint.save_pass(str(tmp_path), 3, mats3)
+    idx, loaded = checkpoint.latest_pass(str(tmp_path))
+    assert idx == 3 and loaded == mats3
+
+
+def test_latest_pass_empty(tmp_path):
+    assert checkpoint.latest_pass(str(tmp_path / "nope")) is None
+    assert checkpoint.latest_pass(str(tmp_path)) is None
+
+
+def test_chain_with_checkpointing_matches_plain(tmp_path):
+    rng = np.random.default_rng(402)
+    mats = random_chain(5, 4, 2, 0.5, rng, "full")
+    plain = chain_product(mats)
+    ckpt = chain_product(mats, checkpoint_dir=str(tmp_path / "ck"))
+    assert ckpt == plain
+    # passes for n=5: 5 -> 3 -> 2 -> 1 (three snapshots)
+    names = sorted(os.listdir(tmp_path / "ck"))
+    assert names == ["pass_1.npz", "pass_2.npz", "pass_3.npz"]
+
+
+def test_chain_resume_from_partial(tmp_path):
+    """Kill after pass 1, restart -- result identical, passes 2..3 recomputed."""
+    rng = np.random.default_rng(403)
+    mats = random_chain(5, 4, 2, 0.5, rng, "full")
+    want = chain_product(mats)
+
+    # simulate the first pass only
+    arr = [chain_product(mats[i : i + 2]) for i in range(0, 4, 2)] + [mats[4]]
+    ckdir = str(tmp_path / "ck")
+    checkpoint.save_pass(ckdir, 1, arr)
+
+    # resume: input matrices are deliberately garbage to prove the resume path
+    # is what produced the result
+    garbage = random_chain(5, 4, 2, 0.5, np.random.default_rng(999))
+    got = chain_product(garbage, checkpoint_dir=ckdir)
+    assert got == want

@@ -1,0 +1,90 @@
+"""MatrixMarket converter (utils/mtx)."""
+
+import numpy as np
+
+from spgemm_tpu.utils.mtx import elements_to_blocks, main, mtx_to_block_matrix, read_mtx
+
+
+MTX_GENERAL = """%%MatrixMarket matrix coordinate real general
+% a comment
+4 4 5
+1 1 1.5
+2 1 2.0
+3 3 0.25
+4 4 7.0
+1 4 3.0
+"""
+
+MTX_SYM = """%%MatrixMarket matrix coordinate real symmetric
+3 3 3
+1 1 5.0
+2 1 1.0
+3 3 2.0
+"""
+
+MTX_PATTERN = """%%MatrixMarket matrix coordinate pattern general
+2 2 2
+1 1
+2 2
+"""
+
+
+def test_read_general(tmp_path):
+    p = tmp_path / "a.mtx"
+    p.write_text(MTX_GENERAL)
+    rows, cols, r, c, v = read_mtx(str(p), value_map="pattern")
+    assert (rows, cols) == (4, 4)
+    assert len(r) == 5
+    assert np.all(v == 1)
+
+
+def test_read_symmetric_mirrors(tmp_path):
+    p = tmp_path / "s.mtx"
+    p.write_text(MTX_SYM)
+    rows, cols, r, c, v = read_mtx(str(p), value_map="pattern")
+    have = set(zip(r.tolist(), c.tolist()))
+    assert have == {(0, 0), (1, 0), (0, 1), (2, 2)}
+
+
+def test_value_map_scale(tmp_path):
+    p = tmp_path / "a.mtx"
+    p.write_text(MTX_GENERAL)
+    rows, cols, r, c, v = read_mtx(str(p), value_map="scale", scale=4.0)
+    by_coord = dict(zip(zip(r.tolist(), c.tolist()), v.tolist()))
+    assert by_coord[(0, 0)] == 6      # 1.5 * 4
+    assert by_coord[(2, 2)] == 1      # 0.25 * 4
+    assert by_coord[(3, 3)] == 28
+
+
+def test_elements_to_blocks_tiling():
+    r = np.array([0, 1, 3, 2])
+    c = np.array([0, 1, 3, 0])
+    v = np.array([10, 20, 30, 40], np.uint64)
+    m = elements_to_blocks(4, 4, r, c, v, k=2)
+    assert m.nnzb == 3
+    d = m.to_dict()
+    assert set(d.keys()) == {(0, 0), (1, 0), (1, 1)}
+    assert d[(0, 0)][0, 0] == 10 and d[(0, 0)][1, 1] == 20
+    assert d[(1, 0)][0, 0] == 40
+    assert d[(1, 1)][1, 1] == 30
+
+
+def test_pattern_mtx(tmp_path):
+    p = tmp_path / "p.mtx"
+    p.write_text(MTX_PATTERN)
+    m = mtx_to_block_matrix(str(p), k=2)
+    assert m.nnzb == 1
+    assert m.tiles[0, 0, 0] == 1 and m.tiles[0, 1, 1] == 1
+
+
+def test_cli_convert_roundtrip(tmp_path):
+    p = tmp_path / "a.mtx"
+    p.write_text(MTX_GENERAL)
+    out = tmp_path / "dir"
+    assert main([str(p), str(p), str(out), "--k", "2"]) == 0
+    from spgemm_tpu.utils import io_text
+    n, k = io_text.read_size(str(out))
+    assert (n, k) == (2, 2)
+    mats = io_text.read_chain(str(out), 0, 1, 2)
+    assert mats[0] == mats[1]
+    assert mats[0].nnzb > 0
