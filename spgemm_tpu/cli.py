@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ranks", type=int, default=1, metavar="P",
                    help="emulate `mpirun -np P` chain partitioning semantics "
                         "(reference sparse_matrix_mult.cu:438-456)")
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host mode: partition the chain across JAX "
+                        "processes (set JAX_COORDINATOR/JAX_NUM_PROCESSES/"
+                        "JAX_PROCESS_ID per host; replaces `mpirun -np P`)")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace to DIR")
@@ -88,6 +92,23 @@ def run(argv: list[str] | None = None) -> int:
     from spgemm_tpu.chain import chain_product
     from spgemm_tpu.utils import io_text
     from spgemm_tpu.utils.timers import PhaseTimers, maybe_profile
+
+    if args.distributed:
+        from spgemm_tpu.parallel import multihost
+
+        multihost.init_from_env()
+        import jax
+
+        n, k = io_text.read_size(args.folder)
+        result = multihost.run_distributed(
+            args.folder, k, n,
+            loader=lambda s, e: io_text.read_chain(
+                args.folder, s, e, k, max_workers=args.threads),
+            round_size=args.round_size)
+        if jax.process_index() == 0:
+            io_text.write_matrix(args.output, result.prune_zeros())
+        print(f"time taken {time.perf_counter() - t_start} seconds")
+        return 0
 
     timers = PhaseTimers()
     with maybe_profile(args.profile):

@@ -4,14 +4,17 @@ The reference's CUDA kernel (matrix_multiplyKernel, sparse_matrix_mult.cu:44-66)
 launches one thread block per output tile with k x k threads, each thread
 folding its pair list sequentially.  The TPU-native shape of the same work:
 
-  * grid = (num_keys, max_pairs): the pair axis is the innermost grid
+  * grid = (key_groups, max_pairs): the pair axis is the innermost grid
     dimension, and TPU grids execute sequentially, so each output tile's
     pairs accumulate in exactly the reference's order (SURVEY.md section 2.9
     -- the arithmetic is non-associative, so this ordering is load-bearing).
   * scalar-prefetched index arrays pa/pb drive the BlockSpec index_maps:
-    the pipeline DMAs exactly the (A, B) tile pair each step needs from HBM
+    the pipeline DMAs exactly the (A, B) tile pairs each step needs from HBM
     into VMEM -- the TPU equivalent of the reference's host-side pack+H2D
     staging (sparse_matrix_mult.cu:189-238), with zero host involvement.
+  * lane packing: a k x k tile only fills k of the VPU's 128 lanes, so each
+    grid step processes a GROUP of G = min(4, 128 // k) output tiles side by
+    side in a (k, G*k) accumulator -- full vregs at k = 32.
   * the k x k tile contraction is k unrolled VPU steps of (hi, lo) uint32
     limb arithmetic (ops/u64.py) -- TPUs have no native u64, and the MXU
     cannot do exact wrap-then-mod integer arithmetic, so this is VPU work
@@ -28,14 +31,21 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from spgemm_tpu.ops import u64
 
 
-def _kernel(pa_ref, pb_ref, a_hi_ref, a_lo_ref, b_hi_ref, b_lo_ref,
-            out_hi_ref, out_lo_ref, *, k: int):
+def _kernel(pa_ref, pb_ref, *refs, k: int, G: int):
+    # refs layout: ah x G, al x G, bh x G, bl x G, out_hi, out_lo
+    ahs = [r[0] for r in refs[0 * G : 1 * G]]          # each (k, k) uint32
+    als = [r[0] for r in refs[1 * G : 2 * G]]
+    bhs = [r[0] for r in refs[2 * G : 3 * G]]
+    bls = [r[0] for r in refs[3 * G : 4 * G]]
+    out_hi_ref, out_lo_ref = refs[4 * G], refs[4 * G + 1]
+
     pair = pl.program_id(1)
 
     @pl.when(pair == 0)
@@ -43,21 +53,23 @@ def _kernel(pa_ref, pb_ref, a_hi_ref, a_lo_ref, b_hi_ref, b_lo_ref,
         out_hi_ref[...] = jnp.zeros_like(out_hi_ref)
         out_lo_ref[...] = jnp.zeros_like(out_lo_ref)
 
-    ah = a_hi_ref[0]  # (k, k) uint32
-    al = a_lo_ref[0]
-    bh = b_hi_ref[0]
-    bl = b_lo_ref[0]
-    acc_h = out_hi_ref[0]
+    acc_h = out_hi_ref[0]                              # (k, G*k)
     acc_l = out_lo_ref[0]
+
+    # B rows pack once per step: group tiles side by side along lanes.
+    bh_cat = jnp.concatenate(bhs, axis=1)              # (k, G*k)
+    bl_cat = jnp.concatenate(bls, axis=1)
 
     # The reference's j-loop (sparse_matrix_mult.cu:56-62), unrolled (k is
     # static): fold the outer product of A's column j with B's row j.
     for j in range(k):
-        acc_h, acc_l = u64.mac(
-            acc_h, acc_l,
-            ah[:, j : j + 1], al[:, j : j + 1],
-            bh[j : j + 1, :], bl[j : j + 1, :],
-        )
+        a_h = jnp.concatenate(
+            [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in ahs], axis=1)
+        a_l = jnp.concatenate(
+            [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in als], axis=1)
+        b_h = jnp.broadcast_to(bh_cat[j : j + 1, :], (k, G * k))
+        b_l = jnp.broadcast_to(bl_cat[j : j + 1, :], (k, G * k))
+        acc_h, acc_l = u64.mac(acc_h, acc_l, a_h, a_l, b_h, b_l)
 
     out_hi_ref[0] = acc_h
     out_lo_ref[0] = acc_l
@@ -76,31 +88,53 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None):
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
 
+    G = max(1, min(4, 128 // k, K))
+    K_pad = -(-K // G) * G
+    if K_pad != K:
+        pad = ((0, K_pad - K), (0, 0))
+        a_sent = jnp.int32(a_hi.shape[0] - 1)
+        b_sent = jnp.int32(b_hi.shape[0] - 1)
+        pa = jnp.concatenate(
+            [pa, jnp.full((K_pad - K, P), a_sent, jnp.int32)], axis=0)
+        pb = jnp.concatenate(
+            [pb, jnp.full((K_pad - K, P), b_sent, jnp.int32)], axis=0)
+    KG = K_pad // G
+
+    def a_map(g):
+        return lambda kg, p, pa, pb: (pa[kg * G + g, p], 0, 0)
+
+    def b_map(g):
+        return lambda kg, p, pa, pb: (pb[kg * G + g, p], 0, 0)
+
+    tile_spec_a = [pl.BlockSpec((1, k, k), a_map(g)) for g in range(G)]
+    tile_spec_b = [pl.BlockSpec((1, k, k), b_map(g)) for g in range(G)]
+    out_spec = pl.BlockSpec((1, k, G * k), lambda kg, p, pa, pb: (kg, 0, 0))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # pa, pb
-        grid=(K, P),
-        in_specs=[
-            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (pa[ki, pi], 0, 0)),
-            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (pa[ki, pi], 0, 0)),
-            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (pb[ki, pi], 0, 0)),
-            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (pb[ki, pi], 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (ki, 0, 0)),
-            pl.BlockSpec((1, k, k), lambda ki, pi, pa, pb: (ki, 0, 0)),
-        ],
+        grid=(KG, P),
+        in_specs=tile_spec_a + tile_spec_a + tile_spec_b + tile_spec_b,
+        out_specs=[out_spec, out_spec],
     )
     out_shape = [
-        jax.ShapeDtypeStruct((K, k, k), jnp.uint32),
-        jax.ShapeDtypeStruct((K, k, k), jnp.uint32),
+        jax.ShapeDtypeStruct((KG, k, G * k), jnp.uint32),
+        jax.ShapeDtypeStruct((KG, k, G * k), jnp.uint32),
     ]
-    out_hi, out_lo = pl.pallas_call(
-        partial(_kernel, k=k),
+    packed_hi, packed_lo = pl.pallas_call(
+        partial(_kernel, k=k, G=G),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),  # sequential: order matters
         ),
-    )(pa, pb, a_hi, a_lo, b_hi, b_lo)
-    return out_hi, out_lo
+    )(pa, pb,
+      *([a_hi] * G), *([a_lo] * G), *([b_hi] * G), *([b_lo] * G))
+
+    def unpack(x):
+        # (KG, ty, g*k+tx) -> (K, ty, tx)
+        return (x.reshape(KG, k, G, k)
+                 .transpose(0, 2, 1, 3)
+                 .reshape(K_pad, k, k)[:K])
+
+    return unpack(packed_hi), unpack(packed_lo)

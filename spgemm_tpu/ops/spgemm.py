@@ -61,23 +61,28 @@ def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
     ah, al = a_hi[pa], a_lo[pa]  # (K, P, k, k)
     bh, bl = b_hi[pb], b_lo[pb]
 
-    # Walk order: for pair p, for j in 0..k-1 -- put (p, j) leading so the
-    # loop body is a static-shape dynamic-index slice.
-    ath = jnp.transpose(ah, (1, 3, 0, 2)).reshape(P * k, K, k)  # [(p,j), key, ty]
-    atl = jnp.transpose(al, (1, 3, 0, 2)).reshape(P * k, K, k)
-    bth = jnp.transpose(bh, (1, 2, 0, 3)).reshape(P * k, K, k)  # [(p,j), key, tx]
-    btl = jnp.transpose(bl, (1, 2, 0, 3)).reshape(P * k, K, k)
+    # Walk order: for pair p, for j in 0..k-1.  The pair axis is a fori_loop
+    # (dynamic-index slice per step); the j fold is unrolled (k is static), so
+    # each loop body is ~k fused vector MACs instead of one.
+    ath = jnp.transpose(ah, (1, 0, 2, 3))  # (P, K, ty, j)
+    atl = jnp.transpose(al, (1, 0, 2, 3))
+    bth = jnp.transpose(bh, (1, 0, 2, 3))  # (P, K, j, tx)
+    btl = jnp.transpose(bl, (1, 0, 2, 3))
 
-    def body(i, acc):
+    def body(p, acc):
         acc_h, acc_l = acc
-        return u64.mac(
-            acc_h, acc_l,
-            ath[i][:, :, None], atl[i][:, :, None],
-            bth[i][:, None, :], btl[i][:, None, :],
-        )
+        pah, pal = ath[p], atl[p]  # (K, k, k)
+        pbh, pbl = bth[p], btl[p]
+        for j in range(k):
+            acc_h, acc_l = u64.mac(
+                acc_h, acc_l,
+                pah[:, :, j : j + 1], pal[:, :, j : j + 1],
+                pbh[:, j : j + 1, :], pbl[:, j : j + 1, :],
+            )
+        return acc_h, acc_l
 
     zero = jnp.zeros((K, k, k), jnp.uint32)
-    out_h, out_l = jax.lax.fori_loop(0, P * k, body, (zero, zero))
+    out_h, out_l = jax.lax.fori_loop(0, P, body, (zero, zero))
     return out_h, out_l
 
 

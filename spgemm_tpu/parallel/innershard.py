@@ -37,21 +37,25 @@ def fold_pairs_field(a_hi, a_lo, b_hi, b_lo, pa, pb):
     k = a_hi.shape[-1]
     ah, al = a_hi[pa], a_lo[pa]
     bh, bl = b_hi[pb], b_lo[pb]
-    ath = jnp.transpose(ah, (1, 3, 0, 2)).reshape(Pn * k, K, k)
-    atl = jnp.transpose(al, (1, 3, 0, 2)).reshape(Pn * k, K, k)
-    bth = jnp.transpose(bh, (1, 2, 0, 3)).reshape(Pn * k, K, k)
-    btl = jnp.transpose(bl, (1, 2, 0, 3)).reshape(Pn * k, K, k)
+    ath = jnp.transpose(ah, (1, 0, 2, 3))  # (P, K, ty, j)
+    atl = jnp.transpose(al, (1, 0, 2, 3))
+    bth = jnp.transpose(bh, (1, 0, 2, 3))  # (P, K, j, tx)
+    btl = jnp.transpose(bl, (1, 0, 2, 3))
 
-    def body(i, acc):
+    def body(p, acc):
         acc_h, acc_l = acc
-        return u64.mac_field(
-            acc_h, acc_l,
-            ath[i][:, :, None], atl[i][:, :, None],
-            bth[i][:, None, :], btl[i][:, None, :],
-        )
+        pah, pal = ath[p], atl[p]
+        pbh, pbl = bth[p], btl[p]
+        for j in range(k):  # unrolled: field mode is order-free anyway
+            acc_h, acc_l = u64.mac_field(
+                acc_h, acc_l,
+                pah[:, :, j : j + 1], pal[:, :, j : j + 1],
+                pbh[:, j : j + 1, :], pbl[:, j : j + 1, :],
+            )
+        return acc_h, acc_l
 
     zero = jnp.zeros((K, k, k), jnp.uint32)
-    return jax.lax.fori_loop(0, Pn * k, body, (zero, zero))
+    return jax.lax.fori_loop(0, Pn, body, (zero, zero))
 
 
 def butterfly_allreduce_modadd(hi, lo, axis_name: str, n_dev: int):

@@ -1,0 +1,40 @@
+"""Subprocess worker for the 2-process DCN test (tests/test_multihost.py).
+
+Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <dir>
+Builds a deterministic chain, partitions it by process, runs the multi-host
+reduction, and (process 0) writes the result matrix file into <dir>/out.
+"""
+
+import sys
+
+
+def main():
+    coordinator, num_procs, proc_id, workdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+
+    import jax
+    from jax._src import xla_bridge
+
+    assert not xla_bridge._backends
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_procs, process_id=proc_id)
+
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import numpy as np
+
+    from spgemm_tpu.parallel import multihost
+    from spgemm_tpu.utils import io_text
+    from spgemm_tpu.utils.gen import random_chain
+
+    k = 2
+    mats = random_chain(5, 4, k, 0.5, np.random.default_rng(777), "full")
+    result = multihost.run_distributed(
+        "unused", k, len(mats), loader=lambda s, e: mats[s : e + 1])
+    if jax.process_index() == 0:
+        io_text.write_matrix(f"{workdir}/out", result)
+    print(f"proc {proc_id} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
