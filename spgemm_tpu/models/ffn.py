@@ -113,6 +113,39 @@ def ffn_forward(params, x, cfg: BlockSparseFFNConfig) -> jax.Array:
     return y.reshape(B, S, D).astype(x.dtype)
 
 
+def prepare_pallas_params(params, cfg: BlockSparseFFNConfig) -> dict:
+    """One-time host-side prep for the Pallas forward: convert W2 to
+    column-major (ops/pallas_bsmm.w2_to_column_major)."""
+    from spgemm_tpu.ops.pallas_bsmm import w2_to_column_major
+
+    rows2, tiles2 = w2_to_column_major(
+        params["w2"]["cols"], params["w2"]["tiles"], cfg.nb_model)
+    return {"w1": params["w1"], "w2cm": {"rows": rows2, "tiles": tiles2}}
+
+
+def ffn_forward_pallas(pparams, x, cfg: BlockSparseFFNConfig,
+                       block_m: int = 128) -> jax.Array:
+    """ffn_forward with both matmuls as Pallas MXU kernels (single chip).
+
+    pparams: output of prepare_pallas_params.  The batch*seq axis is padded to
+    a block_m multiple; weights stream through VMEM via scalar-prefetch index
+    maps (no gather materialization)."""
+    from spgemm_tpu.ops.pallas_bsmm import bsmm_pallas
+
+    B, S, D = x.shape
+    M = B * S
+    M_pad = -(-M // block_m) * block_m
+    xf = x.reshape(M, D)
+    if M_pad != M:
+        xf = jnp.concatenate(
+            [xf, jnp.zeros((M_pad - M, D), x.dtype)], axis=0)
+    h = jax.nn.gelu(bsmm_pallas(xf, pparams["w1"]["rows"],
+                                pparams["w1"]["tiles"], block_m=block_m))
+    y = bsmm_pallas(h, pparams["w2cm"]["rows"], pparams["w2cm"]["tiles"],
+                    block_m=block_m)
+    return y[:M].reshape(B, S, D).astype(x.dtype)
+
+
 def loss_fn(params, x, y, cfg: BlockSparseFFNConfig):
     pred = ffn_forward(params, x, cfg)
     return jnp.mean(jnp.square(pred.astype(jnp.float32) - y.astype(jnp.float32)))

@@ -106,3 +106,30 @@ def test_sharded_training_reduces_loss(mesh8):
         params, loss = step(params, x, y)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+def test_pallas_forward_matches_einsum():
+    """Both FFN matmuls as Pallas MXU kernels (interpret mode on CPU)."""
+    from spgemm_tpu.models.ffn import ffn_forward_pallas, prepare_pallas_params
+    cfg = BlockSparseFFNConfig(d_model=64, d_ff=128, k=8, block_density=0.5,
+                               dtype="float32")
+    params = init_params(cfg, jax.random.key(20))
+    x = jax.random.normal(jax.random.key(21), (2, 4, cfg.d_model), jnp.float32)
+    want = ffn_forward(params, x, cfg)
+    pp = prepare_pallas_params(params, cfg)
+    got = ffn_forward_pallas(pp, x, cfg, block_m=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_forward_ragged_w2_fanin():
+    """Column fan-in of W2 is ragged -> zero-tile padding must be exact."""
+    from spgemm_tpu.models.ffn import ffn_forward_pallas, prepare_pallas_params
+    cfg = BlockSparseFFNConfig(d_model=32, d_ff=64, k=8, block_density=0.3,
+                               dtype="float32")
+    params = init_params(cfg, jax.random.key(22))
+    x = jax.random.normal(jax.random.key(23), (1, 3, cfg.d_model), jnp.float32)
+    want = ffn_forward(params, x, cfg)
+    got = ffn_forward_pallas(prepare_pallas_params(params, cfg), x, cfg, block_m=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
