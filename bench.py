@@ -1,80 +1,143 @@
 #!/usr/bin/env python
-"""Headline benchmark: effective throughput of the u64 modular SpGEMM.
+"""Headline benchmark: end-to-end chain-product wall-clock vs the reference.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Metric: effective GFLOP/s of a single SpGEMM (C = A x B) over uint64 k x k
-tiles with the reference's exact mod-(2^64-1) semantics, counting 2*k^3 flops
-per contracted tile pair -- the same op count behind the reference report's
-"~500 GFLOP/s on P100" kernel claim (BASELINE.md), which is the baseline here.
+Workload: the reference report's "Medium" scale -- a chain of N=10 block-sparse
+matrices totalling ~100k k=32 uint64 tiles -- with banded structure (nd24k-like
+fill-in growth; SuiteSparse downloads are unavailable in this zero-egress
+environment, see BASELINE.md).  The reference's published number for this
+scale is 32.1 s "total multiply time" on 8 MPI ranks x 16 threads + P100
+(report.pdf p.3 Table 1; BASELINE.md).
 
-Config (synthesized; zero-egress -- SuiteSparse downloads unavailable):
-random block-sparse 8192x8192 elements as 256x256 blocks of k=32, 10% block
-density -- comparable tile-pair volume to the report's "100k tiles" medium
-config.  Override with --block-dim/--density/--k/--backend.
+  value       = our total multiply time (chain product, device-resident)
+  vs_baseline = 32.1 / value  (>1 means faster than the reference)
+
+Timing notes:
+  * The timed region is the full chain reduction: host symbolic phase, all
+    numeric launches, on-device result assembly -- everything the reference
+    counts in its "total multiply time" (pack, H2D, kernel, D2H, MPI merge).
+    Input file/generation and the one-time upload of input tiles into HBM are
+    outside, matching the reference's exclusion of its extract() load phase.
+    Per-multiply staging copies -- 27% of the reference's time -- do not exist
+    here: partial products never leave HBM.
+  * jax.block_until_ready is acknowledged at enqueue time by this
+    environment's TPU tunnel, so completion is forced by an 8-byte digest
+    fetch (DeviceBlockMatrix.block_until_ready).
+
+Also reported in "detail": single-SpGEMM effective GFLOP/s (2*k^3 per
+contracted tile pair -- the op count behind the report's "~500 GFLOP/s on
+P100" kernel claim) for the same kernel.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
 
+def _chain_config(args, rng):
+    from spgemm_tpu.utils.gen import banded_block_sparse
+
+    mats = [banded_block_sparse(args.block_dim, args.k, args.bandwidth, rng,
+                                args.dist)
+            for _ in range(args.chain)]
+    return mats
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--block-dim", type=int, default=256)
+    p.add_argument("--chain", type=int, default=10, help="chain length N")
+    p.add_argument("--block-dim", type=int, default=1111)
+    p.add_argument("--bandwidth", type=int, default=4)
     p.add_argument("--k", type=int, default=32)
-    p.add_argument("--density", type=float, default=0.1)
+    p.add_argument("--dist", default="full", choices=["full", "small", "adversarial"])
     p.add_argument("--backend", default=None, choices=["xla", "pallas"])
-    p.add_argument("--iters", type=int, default=3)
+    p.add_argument("--iters", type=int, default=2)
     p.add_argument("--round-size", type=int, default=512)
     args = p.parse_args()
 
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
 
+    # persistent compilation cache: the first-ever run pays ~100 s of Pallas/
+    # XLA compiles for the round-shape classes; subsequent runs hit the cache
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
     platform = jax.devices()[0].platform
-    backend = args.backend or ("xla" if platform == "cpu" else "pallas")
-
-    from spgemm_tpu.ops.spgemm import spgemm
+    from spgemm_tpu.chain import chain_product
+    from spgemm_tpu.ops.device import DeviceBlockMatrix
+    from spgemm_tpu.ops.spgemm import resolve_backend, spgemm_device
     from spgemm_tpu.ops.symbolic import symbolic_join
-    from spgemm_tpu.utils.gen import random_block_sparse
 
+    backend = resolve_backend(args.backend)
     rng = np.random.default_rng(42)
-    a = random_block_sparse(args.block_dim, args.block_dim, args.k, args.density, rng, "full")
-    b = random_block_sparse(args.block_dim, args.block_dim, args.k, args.density, rng, "full")
+    mats = _chain_config(args, rng)
+    total_tiles = sum(m.nnzb for m in mats)
 
-    join = symbolic_join(a.coords, b.coords)
-    total_pairs = int(join.pair_ptr[-1])
-    flops = 2.0 * total_pairs * args.k ** 3
+    # one-time upload (the load phase, outside the timed region); every
+    # upload must be digest-barriered -- enqueue-time acks would otherwise
+    # leak upload time into the first timed iteration
+    dmats = [DeviceBlockMatrix.from_host(m) for m in mats]
+    for d in dmats:
+        d.block_until_ready()
 
-    # warm-up: compile every (K, P) round shape
-    spgemm(a, b, backend=backend, round_size=args.round_size)
+    def run():
+        out = chain_product(
+            dmats, multiply=spgemm_device, keep_device=True,
+            backend=backend, round_size=args.round_size)
+        out.block_until_ready()  # honest completion barrier (8-byte digest)
+        return out
 
     times = []
     for _ in range(args.iters):
         t0 = time.perf_counter()
-        c = spgemm(a, b, backend=backend, round_size=args.round_size)
+        c = run()
         times.append(time.perf_counter() - t0)
     best = min(times)
-    gflops = flops / best / 1e9
 
-    baseline_gflops = 500.0  # reference report's claimed P100 kernel rate
+    # kernel-rate detail: one mid-chain-sized SpGEMM, same kernel
+    a, b = dmats[0], dmats[-1]
+    join = symbolic_join(a.coords, b.coords)
+    pair_flops = 2.0 * int(join.pair_ptr[-1]) * args.k ** 3
+    spgemm_device(a, b, backend=backend).block_until_ready()  # warm
+    t0 = time.perf_counter()
+    spgemm_device(a, b, backend=backend).block_until_ready()
+    single_s = time.perf_counter() - t0
+    single_gflops = pair_flops / single_s / 1e9
+
+    # reference Table 1 scales (BASELINE.md): tiles -> total multiply time.
+    # Only claim a baseline ratio when the measured workload matches a
+    # published scale (within ~25%); otherwise vs_baseline is null.
+    scales = [(10_000, 3.4, "Small"), (100_000, 32.1, "Medium"),
+              (1_000_000, 320.5, "Large")]
+    baseline_s, scale_name = None, f"{total_tiles}_tiles"
+    for tiles, secs, name in scales:
+        # a chain of 1 does zero multiplies -- never claim a baseline for it
+        if args.chain >= 2 and 0.8 * tiles <= total_tiles <= 1.25 * tiles:
+            baseline_s, scale_name = secs, f"{name.lower()}_{tiles // 1000}k_tiles"
     print(json.dumps({
-        "metric": f"spgemm_u64_effective_gflops_{platform}_{backend}",
-        "value": round(gflops, 3),
-        "unit": "GFLOP/s",
-        "vs_baseline": round(gflops / baseline_gflops, 4),
+        "metric": f"chain_multiply_wall_clock_{scale_name}_{platform}_{backend}",
+        "value": round(best, 3),
+        "unit": "s",
+        "vs_baseline": round(baseline_s / best, 3) if baseline_s else None,
         "detail": {
-            "block_dim": args.block_dim, "k": args.k, "density": args.density,
-            "nnzb_a": a.nnzb, "nnzb_b": b.nnzb, "out_keys": join.num_keys,
-            "tile_pairs": total_pairs, "best_wall_s": round(best, 4),
-            "result_nnzb": c.nnzb,
+            "baseline": (f"reference report Table 1: {baseline_s} s on 8xMPI+P100"
+                         if baseline_s else "no published scale matches this config"),
+            "chain_n": args.chain, "k": args.k, "block_dim": args.block_dim,
+            "bandwidth": args.bandwidth, "total_input_tiles": total_tiles,
+            "result_nnzb": c.nnzb, "iters_s": [round(t, 3) for t in times],
+            "single_spgemm_gflops": round(single_gflops, 2),
+            "single_spgemm_pairs": int(join.pair_ptr[-1]),
+            "values_dist": args.dist,
         },
     }))
     return 0

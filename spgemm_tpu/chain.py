@@ -20,17 +20,23 @@ from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 log = logging.getLogger("spgemm_tpu.chain")
 
 
+def _to_host(m):
+    return m.to_host() if hasattr(m, "to_host") else m
+
+
 def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
                   checkpoint_dir: str | None = None, resume: bool = True,
-                  **kwargs) -> BlockSparseMatrix:
+                  keep_device: bool = False, **kwargs) -> BlockSparseMatrix:
     """Reduce [M1, ..., MN] to M1 x M2 x ... x MN with helper2's pairing.
 
-    multiply: binary op (defaults to ops.spgemm.spgemm); kwargs forwarded to it.
+    multiply: binary op (defaults to ops.spgemm.spgemm_device, which keeps
+    every partial product in HBM -- tile data crosses the host boundary only
+    at the final result, or never with keep_device=True); kwargs forwarded.
     checkpoint_dir: if set, snapshot the surviving partials after each pass
     (utils/checkpoint.py) and resume from the newest snapshot on restart.
     """
     if multiply is None:
-        from spgemm_tpu.ops.spgemm import spgemm as multiply  # noqa: PLC0415
+        from spgemm_tpu.ops.spgemm import spgemm_device as multiply  # noqa: PLC0415
     if not matrices:
         raise ValueError("empty chain")
     arr = list(matrices)
@@ -53,5 +59,6 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
         pass_idx += 1
         if checkpoint_dir:
             from spgemm_tpu.utils import checkpoint  # noqa: PLC0415
-            checkpoint.save_pass(checkpoint_dir, pass_idx, arr)
-    return arr[0]
+            checkpoint.save_pass(checkpoint_dir, pass_idx,
+                                 [_to_host(m) for m in arr])
+    return arr[0] if keep_device else _to_host(arr[0])

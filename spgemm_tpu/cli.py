@@ -37,8 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", default=None, metavar="PLATFORM",
                    help="force a JAX platform, e.g. tpu or cpu "
                         "(default: whatever JAX selects)")
-    p.add_argument("--backend", choices=["xla", "pallas", "oracle"], default="xla",
-                   help="numeric-phase implementation")
+    p.add_argument("--backend", choices=["xla", "pallas", "oracle"], default=None,
+                   help="numeric-phase implementation "
+                        "(default: pallas on TPU, xla elsewhere)")
     p.add_argument("--output", default="matrix",
                    help="output path (reference writes ./matrix)")
     p.add_argument("--round-size", type=int, default=512,

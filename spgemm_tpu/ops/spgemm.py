@@ -89,23 +89,38 @@ def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
 _numeric_round = jax.jit(numeric_round_impl)
 
 
-def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
-           round_size: int = 512, backend: str = "xla") -> BlockSparseMatrix:
-    """C = A x B with reference-exact semantics.  Result keeps all-zero output
-    tiles (pruning happens only at final output, sparse_matrix_mult.cu:577-592)
-    and carries rows=a.rows, cols=b.cols (:281-282)."""
+def resolve_backend(backend: str | None) -> str:
+    """None -> 'pallas' on TPU, 'xla' elsewhere (the Pallas kernel runs in
+    interpret mode on CPU, which is correct but slow -- tests opt in)."""
+    if backend is not None:
+        return backend
+    return "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+
+
+def spgemm_device(a, b, *, round_size: int = 512,
+                  backend: str | None = None):
+    """C = A x B with reference-exact semantics, tiles staying in HBM.
+
+    a, b: DeviceBlockMatrix (or host BlockSparseMatrix -- uploaded on entry).
+    Returns a DeviceBlockMatrix; no tile data crosses the device boundary,
+    which inverts the reference's pack/H2D/D2H round-trip per multiply
+    (sparse_matrix_mult.cu:189-269, 27% of its report's total time).
+    """
+    from spgemm_tpu.ops.device import DeviceBlockMatrix, ensure_device  # noqa: PLC0415
+
+    a = ensure_device(a)
+    b = ensure_device(b)
     if a.k != b.k:
         raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
     k = a.k
     join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:
-        return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k)
+        return DeviceBlockMatrix.empty(a.rows, b.cols, k)
 
-    a_hi, a_lo = pack_tiles(a)
-    b_hi, b_lo = pack_tiles(b)
     rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
                          round_size=round_size)
 
+    backend = resolve_backend(backend)
     if backend == "pallas":
         from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas as numeric  # noqa: PLC0415
     elif backend == "xla":
@@ -113,12 +128,27 @@ def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
-    out = np.zeros((join.num_keys, k, k), dtype=np.uint64)
+    # All rounds dispatch asynchronously; outputs are assembled into one
+    # key-ordered slab on device (concat + gather), never touching host.
+    outs_h, outs_l, order = [], [], []
     for rnd in rounds:
-        oh, ol = numeric(a_hi, a_lo, b_hi, b_lo,
+        oh, ol = numeric(a.hi, a.lo, b.hi, b.lo,
                          jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
-        vals = u64.hilo_to_u64(np.asarray(oh), np.asarray(ol))
-        out[rnd.key_index] = vals[: len(rnd.key_index)]
+        n_valid = len(rnd.key_index)
+        outs_h.append(oh[:n_valid])
+        outs_l.append(ol[:n_valid])
+        order.append(rnd.key_index)
+
+    # inv[key] = position of that key in the concatenated round outputs;
+    # the extra last entry maps the sentinel slot to the appended zero tile.
+    cat_idx = np.concatenate(order)
+    inv = np.empty(join.num_keys + 1, np.int64)
+    inv[cat_idx] = np.arange(len(cat_idx))
+    inv[-1] = len(cat_idx)
+    take = jnp.asarray(inv)
+    zero = jnp.zeros((1, k, k), jnp.uint32)
+    out_hi = jnp.concatenate(outs_h + [zero], axis=0)[take]
+    out_lo = jnp.concatenate(outs_l + [zero], axis=0)[take]
 
     # structured observability (SURVEY.md section 5.5): size, fill-in, work
     total_pairs = int(join.pair_ptr[-1])
@@ -126,5 +156,15 @@ def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
              backend, a.nnzb, b.nnzb, join.num_keys, total_pairs, len(rounds),
              2.0 * total_pairs * k ** 3 / 1e9)
 
-    return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k,
-                             coords=join.keys, tiles=out)
+    return DeviceBlockMatrix(rows=a.rows, cols=b.cols, k=k,
+                             coords=join.keys, hi=out_hi, lo=out_lo)
+
+
+def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
+           round_size: int = 512, backend: str | None = None) -> BlockSparseMatrix:
+    """C = A x B with reference-exact semantics, host-to-host.  Result keeps
+    all-zero output tiles (pruning happens only at final output,
+    sparse_matrix_mult.cu:577-592) and carries rows=a.rows, cols=b.cols
+    (:281-282).  One fused D2H at the end; use spgemm_device to chain
+    multiplies without leaving HBM."""
+    return spgemm_device(a, b, round_size=round_size, backend=backend).to_host()

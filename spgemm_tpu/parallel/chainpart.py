@@ -17,7 +17,7 @@ SURVEY.md section 0 caveat 1).
 
 from __future__ import annotations
 
-from spgemm_tpu.chain import chain_product
+from spgemm_tpu.chain import _to_host, chain_product
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 
 
@@ -54,14 +54,19 @@ def chain_product_partitioned(matrices: list[BlockSparseMatrix], num_parts: int,
     def sub(name):
         return os.path.join(checkpoint_dir, name) if checkpoint_dir else None
 
+    # With the default device-resident multiply, each part's partial product
+    # stays in HBM between the per-part reduction and the combine tree (the
+    # reference instead serializes partials through MPI to rank 0, :460-556).
+    keep_device = kwargs.pop("keep_device", False)
+    keep = {"keep_device": True} if multiply is None else {}
     parts = partition_chain(len(matrices), num_parts)
     partials = [
         chain_product(matrices[start : end + 1], multiply=multiply,
-                      checkpoint_dir=sub(f"rank{idx}"), **kwargs)
+                      checkpoint_dir=sub(f"rank{idx}"), **keep, **kwargs)
         for idx, part in enumerate(parts) if part is not None
         for start, end in [part]
     ]
     if len(partials) == 1:
-        return partials[0]
-    return chain_product(partials, multiply=multiply,
+        return partials[0] if keep_device else _to_host(partials[0])
+    return chain_product(partials, multiply=multiply, keep_device=keep_device,
                          checkpoint_dir=sub("combine"), **kwargs)
