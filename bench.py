@@ -60,7 +60,7 @@ def main() -> int:
     p.add_argument("--dist", default="full", choices=["full", "small", "adversarial"])
     p.add_argument("--backend", default=None, choices=["xla", "pallas"])
     p.add_argument("--iters", type=int, default=2)
-    p.add_argument("--round-size", type=int, default=512)
+    p.add_argument("--round-size", type=int, default=None)
     args = p.parse_args()
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -104,8 +104,13 @@ def main() -> int:
         times.append(time.perf_counter() - t0)
     best = min(times)
 
-    # kernel-rate detail: one mid-chain-sized SpGEMM, same kernel
-    a, b = dmats[0], dmats[-1]
+    # kernel-rate detail: a genuinely mid-chain SpGEMM (two level-1 partial
+    # products, i.e. doubled bandwidth and real fill-in), same kernel
+    if args.chain >= 4:
+        a = spgemm_device(dmats[0], dmats[1], backend=backend)
+        b = spgemm_device(dmats[2], dmats[3], backend=backend)
+    else:
+        a, b = dmats[0], dmats[-1]
     join = symbolic_join(a.coords, b.coords)
     pair_flops = 2.0 * int(join.pair_ptr[-1]) * args.k ** 3
     spgemm_device(a, b, backend=backend).block_until_ready()  # warm

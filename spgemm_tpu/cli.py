@@ -12,7 +12,7 @@ TPU-native contract (north star, BASELINE.json): same positional argument,
 same files, same output, no MPI launcher --
 
     python -m spgemm_tpu.cli <folder> [--device tpu|cpu] [--backend xla|pallas]
-                             [--output matrix] [--round-size 512] [--threads 16]
+                             [--output matrix] [--round-size N] [--threads 16]
 
 The reference's hard-coded globals become flags with the same defaults
 (SURVEY.md section 5.6).  Multi-chip sharding is picked up automatically from
@@ -42,8 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: pallas on TPU, xla elsewhere)")
     p.add_argument("--output", default="matrix",
                    help="output path (reference writes ./matrix)")
-    p.add_argument("--round-size", type=int, default=512,
-                   help="max output tiles per numeric launch (reference small_size=500)")
+    p.add_argument("--round-size", type=int, default=None,
+                   help="max output tiles per numeric launch (default: auto -- "
+                        "SMEM-bounded on the Pallas backend, 512 on XLA; the "
+                        "reference's small_size=500)")
     p.add_argument("--threads", type=int, default=16,
                    help="file-loader thread pool size (reference num_threads(16))")
     p.add_argument("--shard", choices=["none", "keys", "inner", "ring"], default="none",

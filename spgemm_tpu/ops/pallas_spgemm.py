@@ -100,11 +100,17 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None):
             [pb, jnp.full((K_pad - K, P), b_sent, jnp.int32)], axis=0)
     KG = K_pad // G
 
+    # Prefetch arrays are SMEM-resident and lane-padded to 128 in their last
+    # dimension: ship them transposed (P, K) so the long key axis rides the
+    # padded dimension and the SMEM footprint stays K*max(P,8)*4 bytes.
+    pa_t = pa.T
+    pb_t = pb.T
+
     def a_map(g):
-        return lambda kg, p, pa, pb: (pa[kg * G + g, p], 0, 0)
+        return lambda kg, p, pa, pb: (pa[p, kg * G + g], 0, 0)
 
     def b_map(g):
-        return lambda kg, p, pa, pb: (pb[kg * G + g, p], 0, 0)
+        return lambda kg, p, pa, pb: (pb[p, kg * G + g], 0, 0)
 
     tile_spec_a = [pl.BlockSpec((1, k, k), a_map(g)) for g in range(G)]
     tile_spec_b = [pl.BlockSpec((1, k, k), b_map(g)) for g in range(G)]
@@ -128,7 +134,7 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),  # sequential: order matters
         ),
-    )(pa, pb,
+    )(pa_t, pb_t,
       *([a_hi] * G), *([a_lo] * G), *([b_hi] * G), *([b_lo] * G))
 
     def unpack(x):

@@ -97,7 +97,7 @@ def resolve_backend(backend: str | None) -> str:
     return "pallas" if jax.devices()[0].platform == "tpu" else "xla"
 
 
-def spgemm_device(a, b, *, round_size: int = 512,
+def spgemm_device(a, b, *, round_size: int | None = None,
                   backend: str | None = None):
     """C = A x B with reference-exact semantics, tiles staying in HBM.
 
@@ -117,16 +117,25 @@ def spgemm_device(a, b, *, round_size: int = 512,
     if join.num_keys == 0:
         return DeviceBlockMatrix.empty(a.rows, b.cols, k)
 
-    rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                         round_size=round_size)
-
     backend = resolve_backend(backend)
     if backend == "pallas":
         from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas as numeric  # noqa: PLC0415
+
+        # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
+        # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to 8),
+        # not by gather materialization: merge key chunks into fewer, bigger
+        # launches.  An explicit round_size still caps the key axis.
+        max_entries = 64 * 1024
+        round_size = 8192 if round_size is None else round_size
     elif backend == "xla":
         numeric = _numeric_round
+        max_entries = None
+        round_size = 512 if round_size is None else round_size
     else:
         raise ValueError(f"unknown backend {backend!r}")
+
+    rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+                         round_size=round_size, max_entries=max_entries)
 
     # All rounds dispatch asynchronously; outputs are assembled into one
     # key-ordered slab on device (concat + gather), never touching host.
@@ -161,7 +170,8 @@ def spgemm_device(a, b, *, round_size: int = 512,
 
 
 def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
-           round_size: int = 512, backend: str | None = None) -> BlockSparseMatrix:
+           round_size: int | None = None,
+           backend: str | None = None) -> BlockSparseMatrix:
     """C = A x B with reference-exact semantics, host-to-host.  Result keeps
     all-zero output tiles (pruning happens only at final output,
     sparse_matrix_mult.cu:577-592) and carries rows=a.rows, cols=b.cols

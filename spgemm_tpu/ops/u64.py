@@ -94,8 +94,11 @@ def add64(a_hi, a_lo, b_hi, b_lo):
 
 
 def mod_max(hi, lo):
-    """x mod (2^64 - 1) for x < 2^64: collapse x == 2^64-1 to 0."""
-    is_max = (hi == _M32_U32) & (lo == _M32_U32)
+    """x mod (2^64 - 1) for x < 2^64: collapse x == 2^64-1 to 0.
+
+    (hi & lo) == 0xFFFFFFFF iff both words are all-ones -- one op cheaper
+    than two compares, and this runs twice per MAC in the hot kernel."""
+    is_max = (hi & lo) == _M32_U32
     zero = jnp.zeros_like(hi)
     return jnp.where(is_max, zero, hi), jnp.where(is_max, zero, lo)
 

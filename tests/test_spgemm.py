@@ -86,4 +86,34 @@ def test_plan_rounds_shapes_and_sentinels():
     assert sorted(covered.tolist()) == list(range(join.num_keys))
     for r in rounds:
         assert r.pa.shape == r.pb.shape
-        assert (r.pa.shape[1] & (r.pa.shape[1] - 1)) == 0  # pow2 fanout class
+        assert _is_shape_class(r.pa.shape[1])
+
+
+def _is_shape_class(x: int) -> bool:
+    """Member of the pow2 + 3/4-pow2 ladder {1, 2, 3, 4, 6, 8, 12, 16, ...}."""
+    if x & (x - 1) == 0:
+        return True
+    return x % 3 == 0 and ((x // 3) & (x // 3 - 1)) == 0
+
+
+def test_plan_rounds_34_pow2_classes():
+    # bandwidth-1 banded: interior output keys have fanout 3, which must land
+    # in the 3-slot class (not pad to 4), and the scattered pair lists must
+    # match the join exactly
+    n = 16
+    coords = np.array([(r, c) for r in range(n)
+                       for c in range(max(0, r - 1), min(n, r + 2))], np.int64)
+    join = symbolic_join(coords, coords)
+    assert 3 in np.diff(join.pair_ptr)
+    rounds = plan_rounds(join, a_sentinel=len(coords), b_sentinel=len(coords))
+    widths = {r.pa.shape[1] for r in rounds}
+    assert 3 in widths and 4 not in widths
+    # reassemble per-key pair lists from rounds and compare against the join
+    for r in rounds:
+        for row, ki in enumerate(r.key_index):
+            s, e = join.pair_ptr[ki], join.pair_ptr[ki + 1]
+            got_a = r.pa[row][: e - s]
+            got_b = r.pb[row][: e - s]
+            assert list(got_a) == list(join.pair_a[s:e])
+            assert list(got_b) == list(join.pair_b[s:e])
+            assert all(v == len(coords) for v in r.pa[row][e - s:])  # sentinel tail
