@@ -37,9 +37,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", default=None, metavar="PLATFORM",
                    help="force a JAX platform, e.g. tpu or cpu "
                         "(default: whatever JAX selects)")
-    p.add_argument("--backend", choices=["xla", "pallas", "oracle"], default=None,
-                   help="numeric-phase implementation "
-                        "(default: pallas on TPU, xla elsewhere)")
+    p.add_argument("--backend",
+                   choices=["xla", "pallas", "mxu", "hybrid", "oracle"],
+                   default=None,
+                   help="numeric-phase implementation (default: pallas on "
+                        "TPU, xla elsewhere; mxu = field-mode limb matmul on "
+                        "the systolic array, hybrid = mxu only when provably "
+                        "bit-exact)")
     p.add_argument("--output", default="matrix",
                    help="output path (reference writes ./matrix)")
     p.add_argument("--round-size", type=int, default=None,

@@ -45,6 +45,11 @@ class DeviceBlockMatrix:
     # unchanged across checkpointed chain passes) must not re-cross the
     # device boundary
     _host: "BlockSparseMatrix | None" = None
+    # inclusive upper bound on element values, when known (python int --
+    # may exceed 2^64 for propagated bounds).  None = unknown.  Drives the
+    # hybrid backend's proof that MXU field mode is bit-exact here
+    # (ops/mxu_spgemm.safe_exact_bound).
+    val_bound: "int | None" = None
 
     @property
     def nnzb(self) -> int:
@@ -56,14 +61,16 @@ class DeviceBlockMatrix:
         from spgemm_tpu.ops.spgemm import pack_tiles  # noqa: PLC0415
 
         hi, lo = pack_tiles(m)
+        bound = int(m.tiles.max()) if m.nnzb else 0
         return cls(rows=m.rows, cols=m.cols, k=m.k, coords=m.coords,
-                   hi=hi, lo=lo, _host=m)
+                   hi=hi, lo=lo, _host=m, val_bound=bound)
 
     @classmethod
     def empty(cls, rows: int, cols: int, k: int) -> "DeviceBlockMatrix":
         zero = jnp.zeros((1, k, k), jnp.uint32)
         return cls(rows=rows, cols=cols, k=k,
-                   coords=np.zeros((0, 2), np.int64), hi=zero, lo=zero)
+                   coords=np.zeros((0, 2), np.int64), hi=zero, lo=zero,
+                   val_bound=0)
 
     def to_host(self) -> BlockSparseMatrix:
         """Fetch tiles to host (the one D2H of the pipeline) and reassemble."""

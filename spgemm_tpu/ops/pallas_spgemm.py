@@ -100,17 +100,31 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None):
             [pb, jnp.full((K_pad - K, P), b_sent, jnp.int32)], axis=0)
     KG = K_pad // G
 
-    # Prefetch arrays are SMEM-resident and lane-padded to 128 in their last
-    # dimension: ship them transposed (P, K) so the long key axis rides the
-    # padded dimension and the SMEM footprint stays K*max(P,8)*4 bytes.
-    pa_t = pa.T
-    pb_t = pb.T
+    # Prefetch arrays are SMEM-resident, lane-padded to 128 in the last
+    # dimension and sublane-padded to 8 in the first: ship whichever
+    # orientation has the smaller footprint (normally (P, K) -- the long key
+    # axis rides the lane padding; for huge fanout classes P > K the
+    # untransposed (K, P) wins).
+    def pad8(x):
+        return -(-x // 8) * 8
 
-    def a_map(g):
-        return lambda kg, p, pa, pb: (pa[p, kg * G + g], 0, 0)
+    transpose = pad8(P) * max(K_pad, 128) <= pad8(K_pad) * max(P, 128)
+    if transpose:
+        pa_t, pb_t = pa.T, pb.T
 
-    def b_map(g):
-        return lambda kg, p, pa, pb: (pb[p, kg * G + g], 0, 0)
+        def a_map(g):
+            return lambda kg, p, pa, pb: (pa[p, kg * G + g], 0, 0)
+
+        def b_map(g):
+            return lambda kg, p, pa, pb: (pb[p, kg * G + g], 0, 0)
+    else:
+        pa_t, pb_t = pa, pb
+
+        def a_map(g):
+            return lambda kg, p, pa, pb: (pa[kg * G + g, p], 0, 0)
+
+        def b_map(g):
+            return lambda kg, p, pa, pb: (pb[kg * G + g, p], 0, 0)
 
     tile_spec_a = [pl.BlockSpec((1, k, k), a_map(g)) for g in range(G)]
     tile_spec_b = [pl.BlockSpec((1, k, k), b_map(g)) for g in range(G)]

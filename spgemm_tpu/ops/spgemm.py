@@ -91,7 +91,12 @@ _numeric_round = jax.jit(numeric_round_impl)
 
 def resolve_backend(backend: str | None) -> str:
     """None -> 'pallas' on TPU, 'xla' elsewhere (the Pallas kernel runs in
-    interpret mode on CPU, which is correct but slow -- tests opt in)."""
+    interpret mode on CPU, which is correct but slow -- tests opt in).
+
+    Other values: 'mxu' = field-mode limb matmul on the systolic array
+    (clean mod-(2^64-1) semantics, ops/mxu_spgemm.py); 'hybrid' = per-multiply
+    choice of 'mxu' when provably bit-exact vs the reference fold, exact VPU
+    backend otherwise."""
     if backend is not None:
         return backend
     return "pallas" if jax.devices()[0].platform == "tpu" else "xla"
@@ -118,6 +123,25 @@ def spgemm_device(a, b, *, round_size: int | None = None,
         return DeviceBlockMatrix.empty(a.rows, b.cols, k)
 
     backend = resolve_backend(backend)
+    out_bound = (1 << 64) - 2  # any backend's outputs are mod-collapsed
+    if backend == "hybrid":
+        # MXU field mode when provably bit-exact for these operands
+        # (no product or partial sum can reach 2^64-1), VPU exact otherwise
+        from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
+
+        from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
+
+        proven = None
+        if a.val_bound is not None and b.val_bound is not None:
+            proven = safe_exact_bound(a.val_bound, b.val_bound,
+                                      int(join.fanouts.max()), k)
+        # the MXU kernel's int32 accumulator caps the padded pair axis
+        if proven is not None and _shape_class(int(join.fanouts.max())) * k > 1 << 17:
+            proven = None
+        if proven is not None:
+            backend, out_bound = "mxu", proven
+        else:
+            backend = resolve_backend(None)
     if backend == "pallas":
         from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas as numeric  # noqa: PLC0415
 
@@ -129,6 +153,11 @@ def spgemm_device(a, b, *, round_size: int | None = None,
         round_size = 8192 if round_size is None else round_size
     elif backend == "xla":
         numeric = _numeric_round
+        max_entries = None
+        round_size = 512 if round_size is None else round_size
+    elif backend == "mxu":
+        from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu as numeric  # noqa: PLC0415
+
         max_entries = None
         round_size = 512 if round_size is None else round_size
     else:
@@ -166,7 +195,8 @@ def spgemm_device(a, b, *, round_size: int | None = None,
              2.0 * total_pairs * k ** 3 / 1e9)
 
     return DeviceBlockMatrix(rows=a.rows, cols=b.cols, k=k,
-                             coords=join.keys, hi=out_hi, lo=out_lo)
+                             coords=join.keys, hi=out_hi, lo=out_lo,
+                             val_bound=min(out_bound, (1 << 64) - 2))
 
 
 def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,

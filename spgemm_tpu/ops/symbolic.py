@@ -49,6 +49,16 @@ class JoinResult:
         return np.diff(self.pair_ptr)
 
 
+def _segment_expand(counts: np.ndarray):
+    """Ragged expansion: for segments of the given lengths, return
+    (segment_id, within_segment_offset) arrays of total length counts.sum()."""
+    total = int(counts.sum())
+    seg_id = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    seg_start = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    offs = np.arange(total, dtype=np.int64) - np.repeat(seg_start, counts)
+    return seg_id, offs
+
+
 def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
     """Structure join: which (A-tile, B-tile) pairs feed which output tile.
 
@@ -77,9 +87,7 @@ def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
 
     # Segment-expand: pair stream in A-traversal order (sorted (i, j)), each A
     # block contributing its B row-range in ascending-c order.
-    a_slot = np.repeat(np.arange(len(a_coords), dtype=np.int64), counts)
-    seg_start = np.concatenate(([0], np.cumsum(counts)[:-1]))
-    offs = np.arange(total, dtype=np.int64) - np.repeat(seg_start, counts)
+    a_slot, offs = _segment_expand(counts)
     b_slot = np.repeat(lo, counts) + offs
 
     out_r = a_coords[a_slot, 0]
@@ -169,8 +177,16 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
         if max_entries is None:
             chunk_cap = round_size
         else:
-            # SMEM-derived cap, still bounded by the caller's round_size
-            chunk_cap = max(64, min(8192, _floor_pow2(max_entries // P)))
+            # SMEM-derived cap.  The kernel ships pa/pb with the LONGER axis
+            # in lanes (lane-padded to 128, sublanes to 8), so the per-array
+            # footprint is pad8(short) * max(long, 128) entries; solve for
+            # the key-chunk size under the max_entries budget.
+            pad8_p = -(-P // 8) * 8
+            if P <= 512:
+                cap = max_entries // pad8_p       # (P, K): P sublanes
+            else:
+                cap = max(max_entries // P, 1)    # (K, P): K sublanes
+            chunk_cap = max(1, min(8192, _floor_pow2(cap)))
             chunk_cap = min(chunk_cap, max(round_size, 1))
         for start in range(0, len(members), chunk_cap):
             chunk = members[start : start + chunk_cap]
@@ -180,9 +196,7 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
             pb = np.full((K_pad, P), b_sentinel, dtype=np.int32)
             # scatter each key's pair list into its row (vectorized over keys)
             lens = fan[chunk]
-            rows = np.repeat(np.arange(K, dtype=np.int64), lens)
-            segs = np.concatenate(([0], np.cumsum(lens)[:-1]))
-            cols = np.arange(int(lens.sum()), dtype=np.int64) - np.repeat(segs, lens)
+            rows, cols = _segment_expand(lens)
             src = np.repeat(join.pair_ptr[chunk], lens) + cols
             pa[rows, cols] = join.pair_a[src]
             pb[rows, cols] = join.pair_b[src]

@@ -103,7 +103,7 @@ def _make_sharded_fold(mesh: Mesh):
 
 
 def spgemm_inner(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
-                 round_size: int = 512, mesh: Mesh | None = None,
+                 round_size: int | None = None, mesh: Mesh | None = None,
                  **_ignored) -> BlockSparseMatrix:
     """C = A x B with the contraction dimension sharded over the mesh and
     partial products all-reduced over ICI (field-mode arithmetic)."""
@@ -121,7 +121,7 @@ def spgemm_inner(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     a_hi, a_lo = pack_tiles(a)
     b_hi, b_lo = pack_tiles(b)
     rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                         round_size=round_size)
+                         round_size=512 if round_size is None else round_size)
     fold = _make_sharded_fold(mesh)
 
     out = np.zeros((join.num_keys, k, k), dtype=np.uint64)

@@ -44,7 +44,7 @@ def _numeric_round_sharded(a_hi, a_lo, b_hi, b_lo, pa, pb, *, mesh: Mesh):
 
 
 def spgemm_sharded(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
-                   round_size: int = 512, mesh: Mesh | None = None,
+                   round_size: int | None = None, mesh: Mesh | None = None,
                    **_ignored) -> BlockSparseMatrix:
     """C = A x B, numeric phase sharded over the visible mesh. Bit-exact."""
     if a.k != b.k:
@@ -61,7 +61,7 @@ def spgemm_sharded(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     a_hi, a_lo = pack_tiles(a)
     b_hi, b_lo = pack_tiles(b)
     rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                         round_size=round_size)
+                         round_size=512 if round_size is None else round_size)
 
     out = np.zeros((join.num_keys, k, k), dtype=np.uint64)
     for rnd in rounds:

@@ -1,0 +1,161 @@
+"""MXU field-mode numeric phase (ops/mxu_spgemm.py) and the hybrid backend.
+
+Field-mode ground truth is python-int arithmetic mod (2^64 - 1); reference-
+mode ground truth is utils/semantics.spgemm_oracle.  The hybrid backend must
+be bit-exact against the REFERENCE oracle whenever it claims safety.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spgemm_tpu.ops import u64
+from spgemm_tpu.ops.mxu_spgemm import (
+    limbs7, numeric_round_mxu, safe_exact_bound)
+from spgemm_tpu.ops.spgemm import spgemm, spgemm_device
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import ADVERSARIAL_VALUES, random_block_sparse
+from spgemm_tpu.utils.semantics import spgemm_oracle
+
+M = (1 << 64) - 1
+
+
+def field_oracle(a: BlockSparseMatrix, b: BlockSparseMatrix) -> dict:
+    """Clean mod-(2^64-1) SpGEMM oracle in python ints."""
+    out = {}
+    bd = b.to_dict()
+    for i, (ar, ac) in enumerate(a.coords):
+        for (br, bc), btile in bd.items():
+            if br != ac:
+                continue
+            key = (int(ar), int(bc))
+            acc = out.setdefault(key, [[0] * a.k for _ in range(a.k)])
+            at = a.tiles[i]
+            for ti in range(a.k):
+                for tn in range(a.k):
+                    s = acc[ti][tn]
+                    for tj in range(a.k):
+                        s = (s + int(at[ti, tj]) * int(btile[tj, tn])) % M
+                    acc[ti][tn] = s
+    return {key: np.array(v, dtype=np.uint64) for key, v in out.items()}
+
+
+def test_limbs7_roundtrip():
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([
+        rng.integers(0, 1 << 64, size=200, dtype=np.uint64),
+        ADVERSARIAL_VALUES])
+    hi, lo = u64.u64_to_hilo(vals)
+    planes = limbs7(jnp.asarray(hi), jnp.asarray(lo))
+    got = np.zeros(len(vals), dtype=object)
+    for l, plane in enumerate(planes):
+        got = got + (np.asarray(plane).astype(object) << (7 * l))
+    assert all(int(g) == int(v) for g, v in zip(got, vals))
+
+
+def test_numeric_round_mxu_adversarial():
+    """Single-tile folds over adversarial values vs the python-int field oracle."""
+    rng = np.random.default_rng(1)
+    k = 8
+    n_tiles, P = 6, 3
+    idx = rng.integers(0, len(ADVERSARIAL_VALUES), size=(n_tiles, k, k))
+    tiles = ADVERSARIAL_VALUES[idx]
+    slab = np.concatenate([tiles, np.zeros((1, k, k), np.uint64)])
+    hi, lo = u64.u64_to_hilo(slab)
+    pa = rng.integers(0, n_tiles, size=(4, P)).astype(np.int32)
+    pb = rng.integers(0, n_tiles, size=(4, P)).astype(np.int32)
+    # pad one row with sentinels to cover the zero-contribution path
+    pa[-1, 1:] = n_tiles
+    pb[-1, 1:] = n_tiles
+
+    oh, ol = numeric_round_mxu(jnp.asarray(hi), jnp.asarray(lo),
+                               jnp.asarray(hi), jnp.asarray(lo),
+                               jnp.asarray(pa), jnp.asarray(pb))
+    got = u64.hilo_to_u64(np.asarray(oh), np.asarray(ol))
+
+    for key in range(pa.shape[0]):
+        want = [[0] * k for _ in range(k)]
+        for p in range(P):
+            at = slab[pa[key, p]]
+            bt = slab[pb[key, p]]
+            for i in range(k):
+                for n_ in range(k):
+                    s = want[i][n_]
+                    for j in range(k):
+                        s = (s + int(at[i, j]) * int(bt[j, n_])) % M
+                    want[i][n_] = s
+        assert np.array_equal(got[key], np.array(want, dtype=np.uint64)), key
+
+
+def test_spgemm_mxu_vs_field_oracle():
+    rng = np.random.default_rng(2)
+    a = random_block_sparse(6, 6, 8, 0.4, rng, "full")
+    b = random_block_sparse(6, 6, 8, 0.4, rng, "full")
+    c = spgemm(a, b, backend="mxu")
+    want = field_oracle(a, b)
+    assert set(map(tuple, c.coords.tolist())) == set(want.keys())
+    cd = c.to_dict()
+    for key, tile in want.items():
+        assert np.array_equal(cd[key], tile), key
+
+
+def test_hybrid_small_values_bit_exact_and_uses_mxu(caplog):
+    import logging
+    rng = np.random.default_rng(3)
+    a = random_block_sparse(8, 8, 8, 0.5, rng, "small")
+    b = random_block_sparse(8, 8, 8, 0.5, rng, "small")
+    with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
+        c = spgemm(a, b, backend="hybrid")
+    assert "spgemm[mxu]" in caplog.text  # the proof fired
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+    assert c == want  # bit-exact REFERENCE semantics via the MXU path
+
+
+def test_hybrid_full_values_falls_back_to_exact(caplog):
+    import logging
+    rng = np.random.default_rng(4)
+    a = random_block_sparse(6, 6, 8, 0.4, rng, "full")
+    b = random_block_sparse(6, 6, 8, 0.4, rng, "full")
+    with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
+        c = spgemm(a, b, backend="hybrid")
+    assert "spgemm[mxu]" not in caplog.text
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+    assert c == want
+
+
+def test_hybrid_chain_bound_propagation():
+    """Level-1 multiplies of a small-valued chain may ride the MXU; the
+    propagated bound must force exact mode once safety is unprovable, and the
+    end result must equal the reference chain oracle bit-for-bit."""
+    from spgemm_tpu.chain import chain_product
+    from spgemm_tpu.utils.semantics import chain_oracle
+
+    rng = np.random.default_rng(5)
+    mats = [random_block_sparse(6, 6, 8, 0.5, rng, "small") for _ in range(4)]
+    got = chain_product(mats, backend="hybrid")
+    want = BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, 8,
+        chain_oracle([m.to_dict() for m in mats], 8))
+    assert got == want
+
+
+def test_safe_exact_bound():
+    assert safe_exact_bound(0, 0, 4, 32) == 0
+    assert safe_exact_bound(1, 1, 4, 32) == 128  # boolean adjacency
+    # (2^32-1)^2 < 2^64-1: a single max-u32 product is still provably safe
+    assert safe_exact_bound((1 << 32) - 1, (1 << 32) - 1, 1, 1) is not None
+    assert safe_exact_bound(1 << 33, 1 << 33, 1, 1) is None
+    assert safe_exact_bound((1 << 32) - 1, (1 << 32) - 1, 1, 2) is None
+    small = (1 << 16) - 1
+    out = safe_exact_bound(small, small, 9, 32)
+    assert out is not None and out < (1 << 64) - 1
+
+
+def test_pxk_cap_raises():
+    k = 32
+    hi = jnp.zeros((2, k, k), jnp.uint32)
+    pa = jnp.zeros((1, 8192), jnp.int32)
+    with pytest.raises(ValueError, match="int32-exact bound"):
+        numeric_round_mxu(hi, hi, hi, hi, pa, pa)
