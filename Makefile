@@ -12,7 +12,7 @@ RANKS   ?= 1
 BACKEND ?= xla
 SHARD   ?= none
 
-NATIVE_SRC = spgemm_tpu/native/smmio.cpp
+NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
 .PHONY: all native run test bench clean
@@ -22,7 +22,7 @@ all: native
 native: $(NATIVE_SO)
 
 $(NATIVE_SO): $(NATIVE_SRC)
-	g++ -O3 -march=native -shared -fPIC -o $@ $<
+	g++ -O3 -march=native -shared -fPIC -o $@ $(NATIVE_SRC)
 
 # DEVICE=tpu runs on whatever TPU platform JAX sees (the default);
 # DEVICE=cpu forces the CPU backend.

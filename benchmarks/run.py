@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Benchmark suite: the five BASELINE.json configs, synthesized.
+
+SuiteSparse downloads are impossible here (zero egress), so each named
+matrix is replaced by a generator matching its structural statistics
+(BASELINE.md notes this caveat); the configs, parallel strategy, and
+correctness metrics (nnz parity + exact value equality vs the oracle,
+BASELINE.json "nnz/Frobenius parity") are the north star's:
+
+  1. random-1pct : random block-sparse 32x32-block 1024-block matrix pair,
+                   ~1% block density, through FILE I/O on the CPU backend --
+                   the reference text-format round-trip config.
+  2. cage12      : uniform ~8 blocks/row (cage12's near-uniform ~16 nnz/row
+                   profile), single-chip Pallas kernel.
+  3. nd24k       : banded, bandwidth 16 (nd24k's dense-block high fill-in
+                   profile), single-chip, the fill-in stress test.
+  4. webbase-1M  : power-law row degrees (webbase's web-graph skew),
+                   row-partitioned over a 4-device mesh (rowshard,
+                   bit-exact output sharding) -- runs on a virtual CPU mesh
+                   when only one real chip is visible.
+  5. ffn         : block-sparse Transformer FFN forward, d=4096, 90% block
+                   sparsity, bf16 on the MXU (models/ffn.py).
+
+Each config prints one JSON line; --write-table also refreshes
+benchmarks/RESULTS.md.  Run: python benchmarks/run.py [--config NAME]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _digest_barrier(x):
+    import jax.numpy as jnp
+    _ = int(jnp.asarray(x).ravel()[0])
+
+
+def _spgemm_config(name, a, b, backend, parity=True):
+    """Time one device-resident SpGEMM; optionally verify vs the oracle."""
+    import jax
+    from spgemm_tpu.ops.device import DeviceBlockMatrix
+    from spgemm_tpu.ops.spgemm import spgemm_device
+    from spgemm_tpu.ops.symbolic import symbolic_join
+
+    da, db = DeviceBlockMatrix.from_host(a), DeviceBlockMatrix.from_host(b)
+    da.block_until_ready()
+    db.block_until_ready()
+    join = symbolic_join(a.coords, b.coords)
+    flops = 2.0 * int(join.pair_ptr[-1]) * a.k ** 3
+
+    spgemm_device(da, db, backend=backend).block_until_ready()  # warm
+    t0 = time.perf_counter()
+    c = spgemm_device(da, db, backend=backend)
+    c.block_until_ready()
+    wall = time.perf_counter() - t0
+
+    result = {
+        "config": name, "backend": backend,
+        "platform": jax.devices()[0].platform,
+        "nnzb_a": a.nnzb, "nnzb_b": b.nnzb, "out_keys": join.num_keys,
+        "tile_pairs": int(join.pair_ptr[-1]),
+        "wall_s": round(wall, 4),
+        "effective_gflops": round(flops / wall / 1e9, 2),
+    }
+    if parity:
+        from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+        from spgemm_tpu.utils.semantics import spgemm_oracle
+        want = BlockSparseMatrix.from_dict(
+            a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+        got = c.to_host()
+        result["nnz_parity"] = bool(got.nnz == want.nnz)
+        result["value_parity"] = bool(got == want)
+    return result
+
+
+def config_random_1pct():
+    """Reference-format file I/O round-trip on the CPU backend."""
+    from spgemm_tpu.utils import io_text
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+    from spgemm_tpu.utils.gen import random_block_sparse
+    from spgemm_tpu.utils.semantics import chain_oracle
+
+    rng = np.random.default_rng(0)
+    k = 32
+    mats = [random_block_sparse(32, 32, k, 0.01 * 32, rng, "full")
+            for _ in range(2)]
+    with tempfile.TemporaryDirectory() as td:
+        io_text.write_chain_dir(os.path.join(td, "in"), mats, k)
+        t0 = time.perf_counter()
+        out = os.path.join(td, "matrix")
+        rc = subprocess.run(
+            [sys.executable, "-m", "spgemm_tpu.cli", os.path.join(td, "in"),
+             "--device", "cpu", "--output", out],
+            cwd=REPO, capture_output=True, text=True,
+            env={**os.environ, "PYTHONPATH": REPO + ":" + os.environ.get("PYTHONPATH", "")})
+        wall = time.perf_counter() - t0
+        assert rc.returncode == 0, rc.stderr[-2000:]
+        got = io_text.read_matrix(out, k)
+    want = BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, k,
+        chain_oracle([m.to_dict() for m in mats], k)).prune_zeros()
+    return {"config": "random-1pct", "backend": "cli+file-io", "platform": "cpu",
+            "nnzb_a": mats[0].nnzb, "nnzb_b": mats[1].nnzb,
+            "wall_s": round(wall, 4), "end_to_end": True,
+            "nnz_parity": bool(got.nnz == want.nnz),
+            "value_parity": bool(got == want)}
+
+
+def config_cage12(backend=None):
+    from spgemm_tpu.ops.spgemm import resolve_backend
+    from spgemm_tpu.utils.gen import random_block_sparse
+
+    rng = np.random.default_rng(1)
+    # cage12 profile: near-uniform row degree; 512 block-rows x ~8 blocks/row
+    a = random_block_sparse(512, 512, 32, 8 / 512, rng, "full")
+    b = random_block_sparse(512, 512, 32, 8 / 512, rng, "full")
+    return _spgemm_config("cage12", a, b, resolve_backend(backend), parity=False)
+
+
+def config_nd24k(backend=None):
+    from spgemm_tpu.ops.spgemm import resolve_backend
+    from spgemm_tpu.utils.gen import banded_block_sparse
+
+    rng = np.random.default_rng(2)
+    a = banded_block_sparse(720, 32, 16, rng, "full")
+    b = banded_block_sparse(720, 32, 16, rng, "full")
+    return _spgemm_config("nd24k", a, b, resolve_backend(backend), parity=False)
+
+
+def config_webbase(n_dev=4):
+    """Row-partitioned over a mesh; re-execs onto a virtual CPU mesh when
+    fewer than n_dev real chips are visible (the BASELINE config asks for 4)."""
+    import jax
+
+    if len(jax.devices()) < n_dev:
+        env = {**os.environ,
+               "PYTHONPATH": REPO + ":" + os.environ.get("PYTHONPATH", "")}
+        rc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--config", "webbase-1M",
+             "--device", "cpu", "--virtual-devices", str(n_dev)],
+            capture_output=True, text=True, env=env, cwd=REPO)
+        assert rc.returncode == 0, rc.stderr[-2000:]
+        return json.loads(rc.stdout.strip().splitlines()[-1])
+
+    from spgemm_tpu.parallel.rowshard import spgemm_sharded
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+    from spgemm_tpu.utils.gen import powerlaw_block_sparse
+    from spgemm_tpu.utils.semantics import spgemm_oracle
+    from spgemm_tpu.ops.symbolic import symbolic_join
+
+    rng = np.random.default_rng(3)
+    a = powerlaw_block_sparse(256, 32, 3.0, rng, "full")
+    b = powerlaw_block_sparse(256, 32, 3.0, rng, "full")
+    join = symbolic_join(a.coords, b.coords)
+    flops = 2.0 * int(join.pair_ptr[-1]) * a.k ** 3
+
+    spgemm_sharded(a, b)  # warm/compile
+    t0 = time.perf_counter()
+    got = spgemm_sharded(a, b)
+    wall = time.perf_counter() - t0
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+    return {"config": "webbase-1M", "backend": f"rowshard x{n_dev}",
+            "platform": jax.devices()[0].platform,
+            "nnzb_a": a.nnzb, "nnzb_b": b.nnzb, "out_keys": join.num_keys,
+            "tile_pairs": int(join.pair_ptr[-1]), "wall_s": round(wall, 4),
+            "effective_gflops": round(flops / wall / 1e9, 2),
+            "nnz_parity": bool(got.nnz == want.nnz),
+            "value_parity": bool(got == want)}
+
+
+def config_ffn():
+    import jax
+    import jax.numpy as jnp
+    from spgemm_tpu.models.ffn import (
+        BlockSparseFFNConfig, ffn_forward, init_params)
+
+    cfg = BlockSparseFFNConfig(d_model=4096, d_ff=16384, k=128,
+                               block_density=0.1)
+    params = init_params(cfg, jax.random.key(0))
+    x = jnp.ones((8, 128, cfg.d_model), jnp.bfloat16)
+    fwd = jax.jit(lambda p, x: ffn_forward(p, x, cfg))
+    _digest_barrier(fwd(params, x))
+    t0 = time.perf_counter()
+    _digest_barrier(fwd(params, x))
+    wall = time.perf_counter() - t0
+    # dense-equivalent flops * density = sparse flops actually done
+    tokens = x.shape[0] * x.shape[1]
+    sparse_flops = 2 * 2 * tokens * cfg.d_model * cfg.d_ff * cfg.block_density
+    return {"config": "ffn-d4096-90pct-sparse", "backend": "bf16-mxu",
+            "platform": jax.devices()[0].platform,
+            "tokens": tokens, "wall_s": round(wall, 4),
+            "sparse_tflops": round(sparse_flops / wall / 1e12, 2)}
+
+
+CONFIGS = {
+    "random-1pct": config_random_1pct,
+    "cage12": config_cage12,
+    "nd24k": config_nd24k,
+    "webbase-1M": config_webbase,
+    "ffn": config_ffn,
+}
+
+
+def write_table(rows):
+    path = os.path.join(REPO, "benchmarks", "RESULTS.md")
+    lines = ["# Benchmark suite results (BASELINE.json configs, synthesized)",
+             "",
+             "Regenerate: `python benchmarks/run.py --write-table`", "",
+             "| config | backend | platform | wall s | eff. GFLOP/s | parity |",
+             "|---|---|---|---|---|---|"]
+    for r in rows:
+        par = ""
+        if "value_parity" in r:
+            par = "bit-exact" if r["value_parity"] else "MISMATCH"
+        gf = r.get("effective_gflops", r.get("sparse_tflops"))
+        if "sparse_tflops" in r:
+            gf = f"{r['sparse_tflops']} TF/s"
+        lines.append(f"| {r['config']} | {r['backend']} | {r['platform']} | "
+                     f"{r['wall_s']} | {gf or ''} | {par} |")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def _pin_platform(platform: str | None, n_virtual: int = 0) -> None:
+    """Pin the JAX platform in-process.  The env var alone is not enough:
+    this environment's TPU plugin sitecustomize imports jax at interpreter
+    start and snapshots JAX_PLATFORMS, so the config must be updated before
+    any backend initializes (same dance as cli.py / tests/conftest.py)."""
+    if not platform:
+        return
+    os.environ["JAX_PLATFORMS"] = platform
+    if n_virtual:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_virtual}").strip()
+    import jax
+    from jax._src import xla_bridge
+    if not xla_bridge._backends:
+        jax.config.update("jax_platforms", platform)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", choices=list(CONFIGS), default=None)
+    p.add_argument("--device", default=None, help="force a JAX platform")
+    p.add_argument("--virtual-devices", type=int, default=0)
+    p.add_argument("--write-table", action="store_true")
+    args = p.parse_args()
+
+    _pin_platform(args.device, args.virtual_devices)
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+    names = [args.config] if args.config else list(CONFIGS)
+    rows = []
+    for name in names:
+        row = CONFIGS[name]()
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if args.write_table:
+        print("wrote", write_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

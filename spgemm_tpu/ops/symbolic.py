@@ -64,7 +64,20 @@ def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
 
     Both coord arrays must be lexicographically sorted by (row, col) --
     the BlockSparseMatrix invariant.
+
+    Dispatches to the native C++ join (native/symbolic.cpp: searchsorted
+    ranges + stable LSD radix sort) when the library is available -- the
+    host runtime is native where the reference's is (its hash-join was "CPU
+    hot loop #1", SURVEY.md section 3.2).  The numpy path below is the
+    always-available fallback, kept bit-identical (tests cross-check).
     """
+    from spgemm_tpu.utils import native  # noqa: PLC0415
+
+    nat = native.symbolic_join_native(a_coords, b_coords)
+    if nat is not None:
+        keys, pair_ptr, pair_a, pair_b = nat
+        return JoinResult(keys=keys, pair_ptr=pair_ptr,
+                          pair_a=pair_a, pair_b=pair_b)
     empty = JoinResult(
         keys=np.zeros((0, 2), np.int64),
         pair_ptr=np.zeros(1, np.int64),
@@ -119,8 +132,8 @@ def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
 class Round:
     """One fixed-shape numeric launch: <= round_size keys, all padded to the
     same fanout class.  The reference's 500-key round (sparse_matrix_mult.cu:181-185)
-    generalized to (pow-2 key count) x (pow-2 fanout) shape classes so the jit
-    cache stays small."""
+    generalized to (pow-4 key count) x (3/4-pow-2 fanout) shape classes so
+    the jit cache stays small."""
 
     key_index: np.ndarray  # (n,) int64 -- positions into JoinResult.keys
     pa: np.ndarray         # (K_pad, P) int32 -- A slab indices (sentinel-padded)
@@ -157,8 +170,9 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
     """Bucket output keys by fanout class and chop into fixed-shape rounds.
 
     a_sentinel/b_sentinel: index of the appended all-zero tile in each slab.
-    Padding both the pair axis (to the fanout class) and the key axis (to a
-    pow-2 <= round_size) keeps the set of compiled shapes logarithmic.
+    Padding both the pair axis (to the 3/4-pow-2 fanout class) and the key
+    axis (to a pow-4 rung <= the chunk cap) keeps the set of compiled shapes
+    logarithmic.
 
     max_entries: if set, the key-axis chunk for fanout class P grows to
     max_entries // P (pow-2, capped at 8192) instead of round_size -- fewer,
@@ -191,7 +205,16 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
         for start in range(0, len(members), chunk_cap):
             chunk = members[start : start + chunk_cap]
             K = len(chunk)
-            K_pad = min(_shape_class(K), chunk_cap)
+            # key-axis ladder is pow4 (4, 16, 64, 256, 1024, 4096): padded
+            # keys compute discarded zeros only on the one tail round per
+            # class, while the compiled-shape count -- the expensive resource
+            # on the slow-AOT TPU toolchain -- stays at <= 6 per fanout
+            # class.  The pair axis keeps the finer 3/4-pow2 ladder because
+            # its padding costs real work on every round.
+            K_pad = 4
+            while K_pad < K:
+                K_pad *= 4
+            K_pad = min(K_pad, chunk_cap)
             pa = np.full((K_pad, P), a_sentinel, dtype=np.int32)
             pb = np.full((K_pad, P), b_sentinel, dtype=np.int32)
             # scatter each key's pair list into its row (vectorized over keys)

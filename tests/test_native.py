@@ -69,3 +69,40 @@ def test_native_u64_extremes(tmp_path):
     rows, cols, coords, tiles = native.parse_matrix(str(path), 2)
     assert tiles[0, 0, 0] == np.uint64(18446744073709551615)
     assert tiles[0, 1, 1] == np.uint64(18446744073709551614)
+
+
+# -- native symbolic join (native/symbolic.cpp) ------------------------------
+
+def test_native_symbolic_join_matches_numpy(monkeypatch):
+    """The C++ join must be bit-identical to the numpy fallback across
+    structure families (uniform, banded, power-law, near-empty, empty)."""
+    import spgemm_tpu.ops.symbolic as S
+    from spgemm_tpu.utils.gen import (
+        banded_block_sparse, powerlaw_block_sparse, random_block_sparse)
+
+    if native.get_lib() is None:
+        pytest.skip("native library unavailable")
+
+    rng = np.random.default_rng(7)
+    cases = [
+        (random_block_sparse(48, 48, 8, 0.15, rng).coords,
+         random_block_sparse(48, 48, 8, 0.15, rng).coords),
+        (banded_block_sparse(64, 8, 3, rng).coords,
+         banded_block_sparse(64, 8, 6, rng).coords),
+        (powerlaw_block_sparse(64, 8, 3.0, rng).coords,
+         powerlaw_block_sparse(64, 8, 3.0, rng).coords),
+        (random_block_sparse(8, 8, 8, 0.02, rng).coords,
+         random_block_sparse(8, 8, 8, 0.02, rng).coords),
+        (np.zeros((0, 2), np.int64), random_block_sparse(8, 8, 8, 0.2, rng).coords),
+        # disjoint structures: zero pairs
+        (np.array([[0, 0]], np.int64), np.array([[5, 5]], np.int64)),
+    ]
+    for i, (ac, bc) in enumerate(cases):
+        nat = S.symbolic_join(ac, bc)
+        with monkeypatch.context() as m:
+            m.setattr(native, "symbolic_join_native", lambda *a: None)
+            py = S.symbolic_join(ac, bc)
+        assert np.array_equal(nat.keys, py.keys), i
+        assert np.array_equal(nat.pair_ptr, py.pair_ptr), i
+        assert np.array_equal(nat.pair_a, py.pair_a), i
+        assert np.array_equal(nat.pair_b, py.pair_b), i
