@@ -61,10 +61,20 @@ def main() -> int:
     p.add_argument("--backend", default=None, choices=["xla", "pallas"])
     p.add_argument("--iters", type=int, default=2)
     p.add_argument("--round-size", type=int, default=None)
+    p.add_argument("--device", default=None,
+                   help="force a JAX platform (the TPU plugin sitecustomize "
+                        "overrides JAX_PLATFORMS, so the env var alone is "
+                        "not enough)")
     args = p.parse_args()
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
+
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+        from jax._src import xla_bridge
+        if not xla_bridge._backends:
+            jax.config.update("jax_platforms", args.device)
 
     # persistent compilation cache: the first-ever run pays ~100 s of Pallas/
     # XLA compiles for the round-shape classes; subsequent runs hit the cache

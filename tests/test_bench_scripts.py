@@ -1,0 +1,51 @@
+"""Smoke tests for the driver-facing scripts: bench.py must always print one
+valid JSON line (the round driver records it), and benchmarks/run.py must
+produce parseable rows.  Tiny configs on the CPU backend."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=240):
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + ":" + os.environ.get("PYTHONPATH", "")}
+    return subprocess.run([sys.executable, *args], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_bench_prints_one_json_line():
+    rc = _run(["bench.py", "--chain", "3", "--block-dim", "12",
+               "--bandwidth", "1", "--k", "8", "--iters", "1",
+               "--device", "cpu"])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    lines = [ln for ln in rc.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1
+    row = json.loads(lines[0])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(row)
+    assert row["unit"] == "s" and row["value"] > 0
+    # tiny config matches no published scale: must NOT claim a baseline
+    assert row["vs_baseline"] is None
+
+
+def test_bench_single_chain_no_crash():
+    rc = _run(["bench.py", "--chain", "1", "--block-dim", "8",
+               "--bandwidth", "1", "--k", "8", "--iters", "1",
+               "--device", "cpu"])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads([ln for ln in rc.stdout.splitlines()
+                      if ln.startswith("{")][0])
+    assert row["vs_baseline"] is None  # a 1-chain does zero multiplies
+
+
+def test_benchmark_suite_webbase_row(tmp_path):
+    rc = _run([os.path.join("benchmarks", "run.py"), "--config", "webbase-1M",
+               "--device", "cpu", "--virtual-devices", "2"])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads(rc.stdout.strip().splitlines()[-1])
+    assert row["config"] == "webbase-1M"
+    assert row["value_parity"] is True
