@@ -15,7 +15,7 @@ SHARD   ?= none
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test bench clean
+.PHONY: all native run test bench warm clean
 
 all: native
 
@@ -41,6 +41,11 @@ test:
 
 bench:
 	$(PY) bench.py
+
+# AOT-populate the persistent compile cache for the bench's round-shape
+# ladder so a cold cache never contaminates (or zeroes) a timed run.
+warm:
+	$(PY) bench.py --warm
 
 clean:
 	rm -f $(NATIVE_SO)

@@ -51,23 +51,14 @@ def _chain_config(args, rng):
     return mats
 
 
-def main() -> int:
-    p = argparse.ArgumentParser()
-    p.add_argument("--chain", type=int, default=10, help="chain length N")
-    p.add_argument("--block-dim", type=int, default=1111)
-    p.add_argument("--bandwidth", type=int, default=4)
-    p.add_argument("--k", type=int, default=32)
-    p.add_argument("--dist", default="full", choices=["full", "small", "adversarial"])
-    p.add_argument("--backend", default=None, choices=["xla", "pallas"])
-    p.add_argument("--iters", type=int, default=2)
-    p.add_argument("--round-size", type=int, default=None)
-    p.add_argument("--device", default=None,
-                   help="force a JAX platform (the TPU plugin sitecustomize "
-                        "overrides JAX_PLATFORMS, so the env var alone is "
-                        "not enough)")
-    args = p.parse_args()
+def _init_platform(args) -> str:
+    """Fail-soft backend init (round-2 VERDICT #3).
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    The environment's TPU tunnel is flaky: jax.devices() can raise on a cold
+    or recovering chip.  Retry with backoff; if the requested backend stays
+    dead, fall back to CPU so the bench ALWAYS emits its JSON line with the
+    platform honestly tagged -- the driver must never see rc != 0.
+    """
     import jax
 
     if args.device:
@@ -82,7 +73,66 @@ def main() -> int:
                       os.path.expanduser("~/.cache/jax_bench"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
-    platform = jax.devices()[0].platform
+    for attempt in range(3):
+        try:
+            return jax.devices()[0].platform
+        except Exception as e:  # noqa: BLE001 -- any backend-init failure
+            print(f"backend init attempt {attempt + 1} failed: {e!r}",
+                  file=sys.stderr)
+            try:
+                from jax._src import xla_bridge
+                xla_bridge._clear_backends()
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(5 * (attempt + 1))
+    # persistent failure: CPU fallback, shrunk workload (the CPU backend
+    # cannot finish the 100k-tile chain in bench-compatible time)
+    print("backend unreachable after 3 attempts; falling back to cpu",
+          file=sys.stderr)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    args.block_dim = min(args.block_dim, 64)
+    args.chain = min(args.chain, 4)
+    return jax.devices()[0].platform
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--chain", type=int, default=10, help="chain length N")
+    p.add_argument("--block-dim", type=int, default=1111)
+    p.add_argument("--bandwidth", type=int, default=4)
+    p.add_argument("--k", type=int, default=32)
+    p.add_argument("--dist", default="full", choices=["full", "small", "adversarial"])
+    p.add_argument("--backend", default=None, choices=["xla", "pallas"])
+    p.add_argument("--iters", type=int, default=2)
+    p.add_argument("--round-size", type=int, default=None)
+    p.add_argument("--warm", action="store_true",
+                   help="compile-populate the persistent cache (one full "
+                        "chain pass), print a status line, and exit -- run "
+                        "before timing so a cold cache cannot contaminate "
+                        "the measured iterations")
+    p.add_argument("--device", default=None,
+                   help="force a JAX platform (the TPU plugin sitecustomize "
+                        "overrides JAX_PLATFORMS, so the env var alone is "
+                        "not enough)")
+    args = p.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        return _run(args)
+    except Exception as e:  # noqa: BLE001 -- emit the JSON line no matter what
+        import traceback
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "chain_multiply_wall_clock_failed",
+            "value": None, "unit": "s", "vs_baseline": None,
+            "detail": {"error": repr(e)},
+        }))
+        return 0
+
+
+def _run(args) -> int:
+    platform = _init_platform(args)
     from spgemm_tpu.chain import chain_product
     from spgemm_tpu.ops.device import DeviceBlockMatrix
     from spgemm_tpu.ops.spgemm import resolve_backend, spgemm_device
@@ -107,6 +157,14 @@ def main() -> int:
         out.block_until_ready()  # honest completion barrier (8-byte digest)
         return out
 
+    if args.warm:
+        t0 = time.perf_counter()
+        run()
+        print(json.dumps({"warmed": True, "platform": platform,
+                          "backend": backend,
+                          "compile_pass_s": round(time.perf_counter() - t0, 3)}))
+        return 0
+
     times = []
     for _ in range(args.iters):
         t0 = time.perf_counter()
@@ -128,6 +186,29 @@ def main() -> int:
     spgemm_device(a, b, backend=backend).block_until_ready()
     single_s = time.perf_counter() - t0
     single_gflops = pair_flops / single_s / 1e9
+
+    # hardware parity smoke (round-2 VERDICT #5): pallas vs xla vs oracle on
+    # a small SpGEMM, executed on whatever platform is live -- the committed
+    # record that the real-chip kernel agrees with the oracle (unit tests
+    # only ever exercise interpret mode)
+    tpu_parity = None
+    try:
+        from spgemm_tpu.ops.spgemm import spgemm
+        from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+        from spgemm_tpu.utils.gen import random_block_sparse
+        from spgemm_tpu.utils.semantics import spgemm_oracle
+
+        prng = np.random.default_rng(7)
+        pa_m = random_block_sparse(6, 6, args.k, 0.4, prng, "adversarial")
+        pb_m = random_block_sparse(6, 6, args.k, 0.4, prng, "adversarial")
+        want = BlockSparseMatrix.from_dict(
+            pa_m.rows, pb_m.cols, args.k,
+            spgemm_oracle(pa_m.to_dict(), pb_m.to_dict(), args.k))
+        got_p = spgemm(pa_m, pb_m, backend=backend)
+        got_x = spgemm(pa_m, pb_m, backend="xla")
+        tpu_parity = bool(got_p == want and got_x == want)
+    except Exception as e:  # noqa: BLE001 -- parity smoke must not kill the bench
+        tpu_parity = f"error: {e!r}"
 
     # reference Table 1 scales (BASELINE.md): tiles -> total multiply time.
     # Only claim a baseline ratio when the measured workload matches a
@@ -153,6 +234,7 @@ def main() -> int:
             "single_spgemm_gflops": round(single_gflops, 2),
             "single_spgemm_pairs": int(join.pair_ptr[-1]),
             "values_dist": args.dist,
+            "tpu_parity": tpu_parity,
         },
     }))
     return 0

@@ -51,7 +51,9 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
     while len(arr) > 1:
         nxt = []
         for i in range(0, len(arr) - 1, 2):
-            log.info("multiplying %d %d", i, i + 1)  # the reference's :301 progress line
+            # the reference's :301 progress line -- printed unconditionally
+            # to stdout, exactly as sparse_matrix_mult.cu does
+            print(f"multiplying {i} {i + 1}", flush=True)
             nxt.append(multiply(arr[i], arr[i + 1], **kwargs))
         if len(arr) % 2 == 1:
             nxt.append(arr[-1])  # odd element carried (:315-321)

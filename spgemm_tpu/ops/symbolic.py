@@ -73,7 +73,14 @@ def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
     """
     from spgemm_tpu.utils import native  # noqa: PLC0415
 
-    nat = native.symbolic_join_native(a_coords, b_coords)
+    # The native join fuses keys as uint64 row*span + col; beyond uint64's
+    # range that wraps, so dispatch to it only in the provably-safe regime
+    # (the numpy fallback below switches to a stable lexsort there).
+    native_safe = (
+        len(a_coords) == 0 or len(b_coords) == 0
+        or (int(a_coords[:, 0].max()) + 1) * (int(b_coords[:, 1].max()) + 1)
+        <= 1 << 64)
+    nat = native.symbolic_join_native(a_coords, b_coords) if native_safe else None
     if nat is not None:
         keys, pair_ptr, pair_a, pair_b = nat
         return JoinResult(keys=keys, pair_ptr=pair_ptr,
@@ -108,20 +115,35 @@ def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
 
     # Stable sort by output key: within a key, the stream order is ascending
     # inner-coordinate j (A sorted by (i, j)), which stability preserves.
-    # A single fused int64 key + stable argsort hits numpy's radix path --
+    # A single fused uint64 key + stable argsort hits numpy's radix path --
     # several times faster than a two-pass lexsort on multi-million-pair
-    # joins (the chain bench's symbolic phase was lexsort-dominated).
+    # joins (the chain bench's symbolic phase was lexsort-dominated).  uint64
+    # matches the native join (native/symbolic.cpp) bit-for-bit where int64
+    # would silently wrap for max_row * span >= 2^63; beyond even uint64's
+    # range, fall back to a stable lexsort on the coordinate pair.
     span = int(b_coords[:, 1].max()) + 1
-    fused = out_r * span + out_c
-    order = np.argsort(fused, kind="stable")
-    fused = fused[order]
-    a_slot, b_slot = a_slot[order], b_slot[order]
-
-    key_change = np.empty(total, dtype=bool)
-    key_change[0] = True
-    key_change[1:] = fused[1:] != fused[:-1]
-    key_starts = np.flatnonzero(key_change)
-    keys = np.stack([fused[key_starts] // span, fused[key_starts] % span], axis=1)
+    max_row = int(a_coords[:, 0].max())
+    if (max_row + 1) * span <= 1 << 64:
+        fused = out_r.astype(np.uint64) * np.uint64(span) + out_c.astype(np.uint64)
+        order = np.argsort(fused, kind="stable")
+        fused = fused[order]
+        a_slot, b_slot = a_slot[order], b_slot[order]
+        key_change = np.empty(total, dtype=bool)
+        key_change[0] = True
+        key_change[1:] = fused[1:] != fused[:-1]
+        key_starts = np.flatnonzero(key_change)
+        keys = np.stack(
+            [(fused[key_starts] // np.uint64(span)).astype(np.int64),
+             (fused[key_starts] % np.uint64(span)).astype(np.int64)], axis=1)
+    else:
+        order = np.lexsort((out_c, out_r))  # stable, last key primary
+        r_s, c_s = out_r[order], out_c[order]
+        a_slot, b_slot = a_slot[order], b_slot[order]
+        key_change = np.empty(total, dtype=bool)
+        key_change[0] = True
+        key_change[1:] = (r_s[1:] != r_s[:-1]) | (c_s[1:] != c_s[:-1])
+        key_starts = np.flatnonzero(key_change)
+        keys = np.stack([r_s[key_starts], c_s[key_starts]], axis=1)
     pair_ptr = np.append(key_starts, total).astype(np.int64)
 
     return JoinResult(keys=keys, pair_ptr=pair_ptr,
@@ -199,7 +221,12 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
             if P <= 512:
                 cap = max_entries // pad8_p       # (P, K): P sublanes
             else:
-                cap = max(max_entries // P, 1)    # (K, P): K sublanes
+                # (K, P): P rides the lanes and is padded to a 128 multiple
+                # by Mosaic -- budget against the padded footprint, not raw
+                # P, or the shipped arrays overshoot SMEM for non-128-multiple
+                # fanout classes
+                pad128_p = -(-P // 128) * 128
+                cap = max(max_entries // pad128_p, 1)
             chunk_cap = max(1, min(8192, _floor_pow2(cap)))
             chunk_cap = min(chunk_cap, max(round_size, 1))
         for start in range(0, len(members), chunk_cap):

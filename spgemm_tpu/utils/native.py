@@ -154,7 +154,12 @@ def symbolic_join_native(a_coords: np.ndarray, b_coords: np.ndarray):
                                ctypes.byref(pa_p), ctypes.byref(pb_p),
                                ctypes.byref(total))
     if rc != 0:
-        raise MemoryError(f"native symbolic join failed (rc={rc})")
+        # Contract: any native failure (allocation, overflow guard) degrades
+        # to the bit-identical numpy join rather than killing the multiply.
+        import logging
+        logging.getLogger("spgemm_tpu.native").warning(
+            "native symbolic join failed (rc=%d); falling back to numpy", rc)
+        return None
     try:
         n_keys, n_pairs = int(nk.value), int(total.value)
         if n_keys == 0:

@@ -30,7 +30,9 @@ def test_cli_end_to_end(tmp_path, capsys, n, dist):
     rc = run([folder, "--output", out])
     assert rc == 0
     assert open(out, "rb").read() == _expected_bytes(mats, k)
-    assert "time taken " in capsys.readouterr().out  # :679 parity line
+    captured = capsys.readouterr().out
+    assert "time taken " in captured  # :679 parity line
+    assert "multiplying 0 1" in captured  # :301 progress line, unconditional
 
 
 def test_cli_default_output_cwd(tmp_path, monkeypatch, capsys):
