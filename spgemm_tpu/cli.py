@@ -155,6 +155,8 @@ def run(argv: list[str] | None = None) -> int:
             io_text.write_matrix(args.output, result.prune_zeros())
 
     timers.log_report()
+    from spgemm_tpu.utils.timers import ENGINE
+    ENGINE.log_report()  # per-multiply engine phases (symbolic/plan/dispatch/assembly)
     # byte-parity with the reference's only surviving print (sparse_matrix_mult.cu:679)
     print(f"time taken {time.perf_counter() - t_start} seconds")
     return 0

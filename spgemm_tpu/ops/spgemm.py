@@ -113,12 +113,15 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     """
     from spgemm_tpu.ops.device import DeviceBlockMatrix, ensure_device  # noqa: PLC0415
 
+    from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
+
     a = ensure_device(a)
     b = ensure_device(b)
     if a.k != b.k:
         raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
     k = a.k
-    join = symbolic_join(a.coords, b.coords)
+    with timers.phase("symbolic_join"):
+        join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:
         return DeviceBlockMatrix.empty(a.rows, b.cols, k)
 
@@ -163,30 +166,37 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     else:
         raise ValueError(f"unknown backend {backend!r}")
 
-    rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                         round_size=round_size, max_entries=max_entries)
+    with timers.phase("plan_rounds"):
+        rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+                             round_size=round_size, max_entries=max_entries)
 
     # All rounds dispatch asynchronously; outputs are assembled into one
     # key-ordered slab on device (concat + gather), never touching host.
-    outs_h, outs_l, order = [], [], []
-    for rnd in rounds:
-        oh, ol = numeric(a.hi, a.lo, b.hi, b.lo,
-                         jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
-        n_valid = len(rnd.key_index)
-        outs_h.append(oh[:n_valid])
-        outs_l.append(ol[:n_valid])
-        order.append(rnd.key_index)
+    # Timed phases are host-side spans (dispatch, not device completion --
+    # the device tail is the caller's block_until_ready); the reference's
+    # Table-2 analog phases are symbolic_join / plan_rounds /
+    # numeric_dispatch / assembly.
+    with timers.phase("numeric_dispatch"):
+        outs_h, outs_l, order = [], [], []
+        for rnd in rounds:
+            oh, ol = numeric(a.hi, a.lo, b.hi, b.lo,
+                             jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
+            n_valid = len(rnd.key_index)
+            outs_h.append(oh[:n_valid])
+            outs_l.append(ol[:n_valid])
+            order.append(rnd.key_index)
 
     # inv[key] = position of that key in the concatenated round outputs;
     # the extra last entry maps the sentinel slot to the appended zero tile.
-    cat_idx = np.concatenate(order)
-    inv = np.empty(join.num_keys + 1, np.int64)
-    inv[cat_idx] = np.arange(len(cat_idx))
-    inv[-1] = len(cat_idx)
-    take = jnp.asarray(inv)
-    zero = jnp.zeros((1, k, k), jnp.uint32)
-    out_hi = jnp.concatenate(outs_h + [zero], axis=0)[take]
-    out_lo = jnp.concatenate(outs_l + [zero], axis=0)[take]
+    with timers.phase("assembly"):
+        cat_idx = np.concatenate(order)
+        inv = np.empty(join.num_keys + 1, np.int64)
+        inv[cat_idx] = np.arange(len(cat_idx))
+        inv[-1] = len(cat_idx)
+        take = jnp.asarray(inv)
+        zero = jnp.zeros((1, k, k), jnp.uint32)
+        out_hi = jnp.concatenate(outs_h + [zero], axis=0)[take]
+        out_lo = jnp.concatenate(outs_l + [zero], axis=0)[take]
 
     # structured observability (SURVEY.md section 5.5): size, fill-in, work
     total_pairs = int(join.pair_ptr[-1])

@@ -37,6 +37,22 @@ class PhaseTimers:
         for name in self.totals:
             log.info("phase %s: %.4fs (x%d)", name, self.totals[name], self.counts[name])
 
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+
+    def snapshot(self) -> dict[str, float]:
+        """Rounded totals, for embedding in structured bench/CLI output."""
+        return {name: round(t, 4) for name, t in self.totals.items()}
+
+
+# Global registry for the SpGEMM engine's internal phases (symbolic join /
+# round planning / numeric dispatch / assembly) -- the analog of the
+# reference's per-phase chrono spans inside helper() (sparse_matrix_mult.cu:
+# 160-274, report.pdf Table 2).  The engine accumulates here on every
+# multiply; the CLI (--profile) and bench.py reset + report it.
+ENGINE = PhaseTimers()
+
 
 @contextlib.contextmanager
 def maybe_profile(trace_dir: str | None):
