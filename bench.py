@@ -1,8 +1,12 @@
 #!/usr/bin/env python
 """Headline benchmark: end-to-end chain-product wall-clock vs the reference.
 
-Prints ONE JSON line:
+The LAST stdout line is the metric, a single JSON object:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+(earlier stdout lines are the reference-parity `multiplying i i+1` progress
+prints from the chain scheduler, which run inside the timed region exactly
+as the reference's do -- parse the last line, or the last line starting
+with '{').
 
 Workload: the reference report's "Medium" scale -- a chain of N=10 block-sparse
 matrices totalling ~100k k=32 uint64 tiles -- with banded structure (nd24k-like
@@ -84,7 +88,8 @@ def _init_platform(args) -> str:
                 xla_bridge._clear_backends()
             except Exception:  # noqa: BLE001
                 pass
-            time.sleep(5 * (attempt + 1))
+            if attempt < 2:
+                time.sleep(5 * (attempt + 1))
     # persistent failure: CPU fallback, shrunk workload (the CPU backend
     # cannot finish the 100k-tile chain in bench-compatible time)
     print("backend unreachable after 3 attempts; falling back to cpu",
@@ -151,11 +156,14 @@ def _run(args) -> int:
         d.block_until_ready()
 
     def run():
+        """One full chain pass; returns (result, dispatch_seconds_from_t0)."""
+        t0 = time.perf_counter()
         out = chain_product(
             dmats, multiply=spgemm_device, keep_device=True,
             backend=backend, round_size=args.round_size)
+        t_dispatch = time.perf_counter() - t0
         out.block_until_ready()  # honest completion barrier (8-byte digest)
-        return out
+        return out, t_dispatch
 
     if args.warm:
         t0 = time.perf_counter()
@@ -175,16 +183,11 @@ def _run(args) -> int:
     for _ in range(args.iters):
         ENGINE.reset()
         t0 = time.perf_counter()
-        out = chain_product(
-            dmats, multiply=spgemm_device, keep_device=True,
-            backend=backend, round_size=args.round_size)
-        t_dispatch = time.perf_counter()
-        out.block_until_ready()
+        c, t_dispatch = run()
         t1 = time.perf_counter()
-        c = out
         times.append(t1 - t0)
         table = ENGINE.snapshot()
-        table["device_wait"] = round(t1 - t_dispatch, 4)
+        table["device_wait"] = round(t1 - t0 - t_dispatch, 4)
         phase_tables.append(table)
     best = min(times)
     phases = phase_tables[times.index(best)]

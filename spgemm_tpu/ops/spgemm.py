@@ -159,10 +159,19 @@ def spgemm_device(a, b, *, round_size: int | None = None,
         max_entries = None
         round_size = 512 if round_size is None else round_size
     elif backend == "mxu":
-        from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu as numeric  # noqa: PLC0415
+        # Pallas-grid MXU limb kernel on TPU (ops/pallas_mxu.py); the XLA
+        # batched-matmul formulation elsewhere (it is the better CPU lowering
+        # and the cross-check oracle for the kernel).
+        if jax.devices()[0].platform == "tpu":
+            from spgemm_tpu.ops.pallas_mxu import numeric_round_mxu_pallas as numeric  # noqa: PLC0415
 
-        max_entries = None
-        round_size = 512 if round_size is None else round_size
+            max_entries = 64 * 1024  # SMEM budget for the (K, P) index pair
+            round_size = 8192 if round_size is None else round_size
+        else:
+            from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu as numeric  # noqa: PLC0415
+
+            max_entries = None
+            round_size = 512 if round_size is None else round_size
     else:
         raise ValueError(f"unknown backend {backend!r}")
 

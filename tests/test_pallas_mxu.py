@@ -1,0 +1,98 @@
+"""Pallas-grid MXU limb kernel (ops/pallas_mxu.py) vs the XLA limb oracle.
+
+The XLA formulation (ops/mxu_spgemm.py) is property-tested against the
+numpy/oracle semantics in tests/test_mxu.py; here the Pallas kernel is
+cross-checked bit-for-bit against it, in interpret mode (CPU CI).
+
+The split pinned by test_fold_outside_kernel_matches_combine is
+load-bearing: composing the carry-normalize + pack stages after the piece
+sums INSIDE one Mosaic kernel miscompiles on the current toolchain (bisected
+empirically on hardware -- each stage is bit-exact in isolation, the fused
+graph is not), so numeric_round_mxu_pallas ends the kernel at the carry-free
+piece sums and folds outside.  If fold_piece_sums is ever moved back into
+the kernel, re-run the hardware parity smoke (bench.py detail.tpu_parity or
+benchmarks/run.py cage12 --backend mxu) before trusting it.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from spgemm_tpu.ops import u64  # noqa: E402
+from spgemm_tpu.ops.mxu_spgemm import N_LIMBS, _combine_mod_m, numeric_round_mxu  # noqa: E402
+from spgemm_tpu.ops.pallas_mxu import (  # noqa: E402
+    _piece_sums, fold_piece_sums, numeric_round_mxu_pallas)
+
+
+def test_fold_outside_kernel_matches_combine():
+    """piece-sums + outside fold == the proven XLA diagonal fold."""
+    rng = np.random.default_rng(0)
+    k = 8
+    # realistic int32 magnitudes: limb products summed over up to P*k terms
+    S = rng.integers(0, 127 * 127 * 1024, size=(N_LIMBS * k, N_LIMBS * k),
+                     dtype=np.int64).astype(np.int32)
+    limbs = _piece_sums(jnp.asarray(S), k)
+    got_h, got_l = fold_piece_sums(limbs)
+    want_h, want_l = _combine_mod_m(jnp.asarray(S)[None], k)
+    assert np.array_equal(np.asarray(got_h), np.asarray(want_h)[0])
+    assert np.array_equal(np.asarray(got_l), np.asarray(want_l)[0])
+
+
+@pytest.mark.parametrize("k,K,P", [(2, 3, 1), (4, 5, 7), (8, 9, 16), (8, 2, 3)])
+def test_kernel_matches_xla_mxu(k, K, P):
+    rng = np.random.default_rng(100 * k + K + P)
+    nnzb = 11
+    tiles = rng.integers(0, 1 << 64, size=(nnzb + 1, k, k), dtype=np.uint64)
+    tiles[-1] = 0  # sentinel zero tile
+    hi, lo = u64.u64_to_hilo(tiles)
+    hi, lo = jnp.asarray(hi), jnp.asarray(lo)
+    # pair lists with sentinel padding mixed in
+    pa = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    pb = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+
+    want_h, want_l = numeric_round_mxu(hi, lo, hi, lo, pa, pb)
+    got_h, got_l = numeric_round_mxu_pallas(hi, lo, hi, lo, pa, pb,
+                                            interpret=True)
+    assert np.array_equal(np.asarray(want_h), np.asarray(got_h))
+    assert np.array_equal(np.asarray(want_l), np.asarray(got_l))
+
+
+def test_kernel_all_sentinel_rows_are_zero():
+    """A key whose pair list is entirely padding must produce the zero tile
+    (field mode: 0 * x == 0, 0 + 0 == 0)."""
+    k, nnzb = 4, 3
+    rng = np.random.default_rng(7)
+    tiles = rng.integers(0, 1 << 64, size=(nnzb + 1, k, k), dtype=np.uint64)
+    tiles[-1] = 0
+    hi, lo = u64.u64_to_hilo(tiles)
+    hi, lo = jnp.asarray(hi), jnp.asarray(lo)
+    sent = np.int32(nnzb)
+    pa = jnp.asarray(np.array([[sent, sent], [0, 1]], np.int32))
+    pb = jnp.asarray(np.array([[sent, sent], [1, 2]], np.int32))
+    got_h, got_l = numeric_round_mxu_pallas(hi, lo, hi, lo, pa, pb,
+                                            interpret=True)
+    assert not np.asarray(got_h)[0].any()
+    assert not np.asarray(got_l)[0].any()
+    want_h, want_l = numeric_round_mxu(hi, lo, hi, lo, pa, pb)
+    assert np.array_equal(np.asarray(want_h), np.asarray(got_h))
+    assert np.array_equal(np.asarray(want_l), np.asarray(got_l))
+
+
+def test_pair_padding_to_block_multiple():
+    """P not a multiple of the pair-block width R exercises the wrapper's
+    sentinel padding of the pair axis."""
+    k, nnzb, K, P = 8, 9, 4, 11  # R = 8 -> P padded to 16
+    rng = np.random.default_rng(3)
+    tiles = rng.integers(0, 1 << 64, size=(nnzb + 1, k, k), dtype=np.uint64)
+    tiles[-1] = 0
+    hi, lo = u64.u64_to_hilo(tiles)
+    hi, lo = jnp.asarray(hi), jnp.asarray(lo)
+    pa = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+    pb = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+    want_h, want_l = numeric_round_mxu(hi, lo, hi, lo, pa, pb)
+    got_h, got_l = numeric_round_mxu_pallas(hi, lo, hi, lo, pa, pb,
+                                            interpret=True)
+    assert np.array_equal(np.asarray(want_h), np.asarray(got_h))
+    assert np.array_equal(np.asarray(want_l), np.asarray(got_l))

@@ -119,13 +119,25 @@ def test_plan_rounds_34_pow2_classes():
             assert all(v == len(coords) for v in r.pa[row][e - s:])  # sentinel tail
 
 
-def test_symbolic_join_huge_coords_no_int64_wrap():
+def _force_numpy_join(monkeypatch):
+    """Disable the native join so the numpy branch under test actually runs
+    (the native .so is auto-built on any machine with g++, so without this
+    the regression below would silently test the C++ path instead)."""
+    from spgemm_tpu.utils import native
+    monkeypatch.setattr(native, "symbolic_join_native", lambda *a: None)
+
+
+@pytest.mark.parametrize("force_numpy", [True, False])
+def test_symbolic_join_huge_coords_no_int64_wrap(monkeypatch, force_numpy):
     """Regression (round-1 ADVICE): the fused sort key must not wrap.
 
     max_row * span here is exactly 2^63 -- an int64 fused key goes negative
     and sorts the largest output key FIRST; the uint64 key (matching
-    native/symbolic.cpp) keeps the lexicographic order.
+    native/symbolic.cpp) keeps the lexicographic order.  Runs both the
+    numpy branch (forced) and whatever symbolic_join dispatches to.
     """
+    if force_numpy:
+        _force_numpy_join(monkeypatch)
     big_r = 1 << 32
     big_c = (1 << 31) - 1  # span = 2^31
     a_coords = np.array([(0, 0), (big_r, 0)], dtype=np.int64)
@@ -136,10 +148,16 @@ def test_symbolic_join_huge_coords_no_int64_wrap():
     assert list(np.diff(join.pair_ptr)) == [1, 1, 1, 1]
 
 
-def test_symbolic_join_beyond_uint64_lexsort_fallback():
+def test_symbolic_join_beyond_uint64_lexsort_fallback(monkeypatch):
     """Even uint64 fusing would wrap here ((max_row+1)*span > 2^64): the
     numpy path must take the stable-lexsort branch and the native path must
     not be consulted (it would wrap silently)."""
+    from spgemm_tpu.utils import native
+
+    def _fail(*a):
+        raise AssertionError("native join consulted beyond its safe range")
+
+    monkeypatch.setattr(native, "symbolic_join_native", _fail)
     big_r = 1 << 40
     big_c = (1 << 31) - 1
     a_coords = np.array([(0, 0), (big_r, 0)], dtype=np.int64)
@@ -147,10 +165,11 @@ def test_symbolic_join_beyond_uint64_lexsort_fallback():
     join = symbolic_join(a_coords, b_coords)
     expect = [(0, 5), (0, big_c), (big_r, 5), (big_r, big_c)]
     assert [tuple(k) for k in join.keys] == expect
-    # pair order within each key is still j-ascending (single-pair keys here;
-    # add a shared key to check stability across the lexsort branch)
+    # stability across the lexsort branch: shared key, j-ascending pairs
+    # (span stays > 2^24 so (max_row+1)*span > 2^64 keeps this branch)
+    big_c2 = (1 << 30) + 7
     a2 = np.array([(big_r, 0), (big_r, 1)], dtype=np.int64)
-    b2 = np.array([(0, 7), (1, 7)], dtype=np.int64)
+    b2 = np.array([(0, big_c2), (1, big_c2)], dtype=np.int64)
     j2 = symbolic_join(a2, b2)
     assert j2.num_keys == 1
     assert list(a2[j2.pair_a, 1]) == [0, 1]
