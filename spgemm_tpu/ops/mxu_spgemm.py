@@ -54,13 +54,16 @@ N_LIMBS = 10  # ceil(64 / 7)
 _M32 = np.uint32(0xFFFFFFFF)
 
 
-def limbs7(hi, lo):
-    """Split (hi, lo) uint32 planes into 10 int8 limbs of 7 bits each.
+def limbs7(hi, lo, n_limbs: int = N_LIMBS, dtype=jnp.int8):
+    """Split (hi, lo) uint32 planes into n_limbs limbs of 7 bits each.
 
     Limb l covers bits [7l, 7l+7) of the 64-bit value; limb 9 is 1 bit.
+    n_limbs < 10 is valid when every value is < 2^(7*n_limbs) (the dropped
+    high planes would be all zero).  dtype is the output cast: int8 for the
+    XLA batched matmul here, bf16 (via int32/f32) for the Pallas kernel.
     """
     out = []
-    for l in range(N_LIMBS):
+    for l in range(n_limbs):
         o = 7 * l
         if o + 7 <= 32:
             v = lo >> o
@@ -68,7 +71,12 @@ def limbs7(hi, lo):
             v = (lo >> o) | (hi << (32 - o))
         else:
             v = hi >> (o - 32)
-        out.append((v & np.uint32(0x7F)).astype(jnp.int8))
+        v = (v & np.uint32(0x7F)).astype(jnp.int32)
+        if dtype == jnp.bfloat16:
+            # u32 -> i32 -> f32 -> bf16: 0..127 is exact at every step
+            out.append(v.astype(jnp.float32).astype(jnp.bfloat16))
+        else:
+            out.append(v.astype(dtype))
     return out
 
 

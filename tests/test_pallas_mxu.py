@@ -96,3 +96,40 @@ def test_pair_padding_to_block_multiple():
                                             interpret=True)
     assert np.array_equal(np.asarray(want_h), np.asarray(got_h))
     assert np.array_equal(np.asarray(want_l), np.asarray(got_l))
+
+
+@pytest.mark.parametrize("bits_a,bits_b", [(32, 32), (14, 64), (7, 7), (50, 21)])
+def test_adaptive_limb_counts(bits_a, bits_b):
+    """Bounded operands with shrunk limb grids must match the full 10x10."""
+    from spgemm_tpu.ops.pallas_mxu import limbs_for_bound
+
+    k, nnzb, K, P = 4, 7, 3, 5
+    rng = np.random.default_rng(bits_a * 100 + bits_b)
+    a_t = rng.integers(0, 1 << bits_a, size=(nnzb + 1, k, k), dtype=np.uint64)
+    b_t = rng.integers(0, 1 << bits_b, size=(nnzb + 1, k, k), dtype=np.uint64)
+    a_t[-1] = 0
+    b_t[-1] = 0
+    ah, al = map(jnp.asarray, u64.u64_to_hilo(a_t))
+    bh, bl = map(jnp.asarray, u64.u64_to_hilo(b_t))
+    pa = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+    pb = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+
+    La = limbs_for_bound((1 << bits_a) - 1)
+    Lb = limbs_for_bound((1 << bits_b) - 1)
+    assert La == -(-bits_a // 7) or bits_a >= 64
+    want = numeric_round_mxu(ah, al, bh, bl, pa, pb)
+    got = numeric_round_mxu_pallas(ah, al, bh, bl, pa, pb, interpret=True,
+                                   a_limbs=La, b_limbs=Lb)
+    assert np.array_equal(np.asarray(want[0]), np.asarray(got[0]))
+    assert np.array_equal(np.asarray(want[1]), np.asarray(got[1]))
+
+
+def test_limbs_for_bound():
+    from spgemm_tpu.ops.pallas_mxu import limbs_for_bound
+
+    assert limbs_for_bound(None) == 10
+    assert limbs_for_bound((1 << 64) - 2) == 10
+    assert limbs_for_bound((1 << 32) - 1) == 5
+    assert limbs_for_bound(127) == 1
+    assert limbs_for_bound(128) == 2
+    assert limbs_for_bound(0) == 1
