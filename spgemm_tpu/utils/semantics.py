@@ -98,6 +98,21 @@ def tile_pair_mac_np(acc: np.ndarray, a_tile: np.ndarray, b_tile: np.ndarray) ->
     return acc
 
 
+def tile_mac_oracle(a_tiles: np.ndarray, b_tiles: np.ndarray) -> np.ndarray:
+    """Fold an ordered list of (A, B) tile pairs into one output tile.
+
+    a_tiles/b_tiles: (p, k, k) uint64, already in the engine's j-ascending
+    pair order for a single output key.  This is the per-key oracle used for
+    sampled parity on configs too large for the full spgemm_oracle
+    (benchmarks/run.py cage12/nd24k).
+    """
+    k = a_tiles.shape[-1]
+    acc = np.zeros((k, k), dtype=np.uint64)
+    for a_t, b_t in zip(a_tiles, b_tiles):
+        acc = tile_pair_mac_np(acc, a_t, b_t)
+    return acc
+
+
 def spgemm_oracle(a_blocks: dict, b_blocks: dict, k: int) -> dict:
     """Reference-semantics block-sparse matmul on dicts {(r,c): (k,k) uint64}.
 
