@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Kernel-variant sweep on real hardware: the evidence behind RESULTS.md.
+
+Times the numeric-phase kernels head to head at bench-realistic shapes
+(k=32 tiles, medium-chain fanouts) and prints one JSON line per variant:
+
+  * VPU exact kernel (ops/pallas_spgemm.py): colbcast (the round-1 layout)
+    vs vecj (vectorized-j, round-3) -- the round-2 VERDICT #2 tuning item.
+  * MXU limb kernel (ops/pallas_mxu.py) vs the XLA limb formulation
+    (ops/mxu_spgemm.py) at 10x10 and bounded 3x3 limb grids -- VERDICT #1.
+
+Run: python benchmarks/kernel_sweep.py [--quick]
+Each timing uses a compile+digest warm-up, then times one dispatch with a
+digest completion barrier (jax.block_until_ready is acknowledged at enqueue
+by this environment's TPU tunnel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _digest(x):
+    """8-byte completion fetch: device-side ravel, one element to host
+    (np.asarray would D2H-copy the whole buffer inside the timed region)."""
+    import jax.numpy as jnp
+
+    return int(jnp.asarray(x).ravel()[0])
+
+
+def _time_round(fn, args, flops):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    _digest(out[0])  # warm-up completion barrier
+    t0 = time.perf_counter()
+    out = fn(*args)
+    _digest(out[0])
+    _digest(out[1])
+    dt = time.perf_counter() - t0
+    return dt, flops / dt / 1e9
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="single shape instead of the full sweep")
+    p.add_argument("--k", type=int, default=32)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+    from spgemm_tpu.ops import u64
+    from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu
+    from spgemm_tpu.ops.pallas_mxu import numeric_round_mxu_pallas
+    from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas
+
+    platform = jax.devices()[0].platform
+    k, nnzb = args.k, 4000
+    rng = np.random.default_rng(0)
+    tiles = rng.integers(0, 1 << 64, size=(nnzb + 1, k, k), dtype=np.uint64)
+    tiles[-1] = 0
+    hi, lo = map(jnp.asarray, u64.u64_to_hilo(tiles))
+    # bounded-value slab for the adaptive-limb MXU rows (< 2^16)
+    tiles16 = rng.integers(0, 1 << 16, size=(nnzb + 1, k, k), dtype=np.uint64)
+    tiles16[-1] = 0
+    hi16, lo16 = map(jnp.asarray, u64.u64_to_hilo(tiles16))
+
+    shapes = [(1024, 8), (256, 16)] if not args.quick else [(256, 16)]
+    rows = []
+    for K, P in shapes:
+        pa = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+        pb = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+        flops = 2.0 * K * P * k ** 3
+        variants = [
+            ("vpu-colbcast", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb), {"algo": "colbcast"}),
+            ("vpu-vecj", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb), {"algo": "vecj"}),
+            ("mxu-xla-10x10", numeric_round_mxu,
+             (hi, lo, hi, lo, pa, pb), {}),
+            ("mxu-pallas-10x10", numeric_round_mxu_pallas,
+             (hi, lo, hi, lo, pa, pb), {}),
+            ("mxu-pallas-3x3-bounded", numeric_round_mxu_pallas,
+             (hi16, lo16, hi16, lo16, pa, pb), {"a_limbs": 3, "b_limbs": 3}),
+        ]
+        for name, fn, fargs, kw in variants:
+            try:
+                if kw:
+                    from functools import partial
+                    fn = partial(fn, **kw)
+                dt, gflops = _time_round(fn, fargs, flops)
+                row = {"variant": name, "K": K, "P": P, "k": k,
+                       "platform": platform, "wall_ms": round(dt * 1e3, 2),
+                       "effective_gflops": round(gflops, 1)}
+            except Exception as e:  # noqa: BLE001 -- record, keep sweeping
+                row = {"variant": name, "K": K, "P": P, "k": k,
+                       "platform": platform, "error": repr(e)[:200]}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,7 +39,7 @@ from jax.experimental.pallas import tpu as pltpu
 from spgemm_tpu.ops import u64
 
 
-def _kernel(pa_ref, pb_ref, *refs, k: int, G: int):
+def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str):
     # refs layout: ah x G, al x G, bh x G, bl x G, out_hi, out_lo
     ahs = [r[0] for r in refs[0 * G : 1 * G]]          # each (k, k) uint32
     als = [r[0] for r in refs[1 * G : 2 * G]]
@@ -57,27 +57,65 @@ def _kernel(pa_ref, pb_ref, *refs, k: int, G: int):
     acc_h = out_hi_ref[0]                              # (k, G*k)
     acc_l = out_lo_ref[0]
 
-    # B rows pack once per step: group tiles side by side along lanes.
-    bh_cat = jnp.concatenate(bhs, axis=1)              # (k, G*k)
-    bl_cat = jnp.concatenate(bls, axis=1)
+    if algo == "colbcast":
+        # B rows pack once per step: group tiles side by side along lanes.
+        bh_cat = jnp.concatenate(bhs, axis=1)          # (k, G*k)
+        bl_cat = jnp.concatenate(bls, axis=1)
 
-    # The reference's j-loop (sparse_matrix_mult.cu:56-62), unrolled (k is
-    # static): fold the outer product of A's column j with B's row j.
-    for j in range(k):
-        a_h = jnp.concatenate(
-            [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in ahs], axis=1)
-        a_l = jnp.concatenate(
-            [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in als], axis=1)
-        b_h = jnp.broadcast_to(bh_cat[j : j + 1, :], (k, G * k))
-        b_l = jnp.broadcast_to(bl_cat[j : j + 1, :], (k, G * k))
-        acc_h, acc_l = u64.mac(acc_h, acc_l, a_h, a_l, b_h, b_l)
+        # The reference's j-loop (sparse_matrix_mult.cu:56-62), unrolled (k
+        # is static): fold the outer product of A's column j with B's row j.
+        for j in range(k):
+            a_h = jnp.concatenate(
+                [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in ahs], axis=1)
+            a_l = jnp.concatenate(
+                [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in als], axis=1)
+            b_h = jnp.broadcast_to(bh_cat[j : j + 1, :], (k, G * k))
+            b_l = jnp.broadcast_to(bl_cat[j : j + 1, :], (k, G * k))
+            acc_h, acc_l = u64.mac(acc_h, acc_l, a_h, a_l, b_h, b_l)
+    elif algo == "vecj":
+        # Vectorized-j layout: compute a BLOCK of j's products at once in a
+        # ((j, i) sublanes, (g, n) lanes) arrangement, then fold the j axis
+        # with cheap sublane slices.  The colbcast variant runs 2*G*k
+        # lane-extract+broadcast ops per step (A's column j per key per
+        # plane) -- the dominant instruction count; here A is transposed
+        # once per tile and every per-j access is a sublane slice.  The j
+        # axis is chunked (JB) so the six (JB*k, G*k) uint32 intermediates
+        # plus mulmod's limb temporaries stay well under VMEM (~3 MB at
+        # k=32, G=16, JB=8, vs ~12+ MB unchunked).  The mod fold stays
+        # sequential over j (SURVEY.md 2.9).
+        # (JB*k, G*k) uint32 <= 512 KB per intermediate
+        JB = max(1, min(k, 131072 // (k * G * k)))
+        ats_h = [t.T for t in ahs]                     # (j, i), once per tile
+        ats_l = [t.T for t in als]
+
+        def expand_a(at, j0):
+            c = at[j0:j0 + JB]                         # (JB, i) sublane slice
+            return jnp.broadcast_to(c[:, :, None], (JB, k, k)).reshape(JB * k, k)
+
+        def expand_b(t, j0):
+            c = t[j0:j0 + JB]                          # (JB, n) sublane slice
+            return jnp.broadcast_to(c[:, None, :], (JB, k, k)).reshape(JB * k, k)
+
+        for j0 in range(0, k, JB):
+            a_h = jnp.concatenate([expand_a(t, j0) for t in ats_h], axis=1)
+            a_l = jnp.concatenate([expand_a(t, j0) for t in ats_l], axis=1)
+            b_h = jnp.concatenate([expand_b(t, j0) for t in bhs], axis=1)
+            b_l = jnp.concatenate([expand_b(t, j0) for t in bls], axis=1)
+            prod_h, prod_l = u64.mulmod(a_h, a_l, b_h, b_l)  # (JB*k, G*k)
+            for jj in range(min(JB, k - j0)):
+                acc_h, acc_l = u64.addmod(
+                    acc_h, acc_l,
+                    prod_h[jj * k:(jj + 1) * k, :], prod_l[jj * k:(jj + 1) * k, :])
+    else:
+        raise ValueError(f"unknown algo {algo!r}")
 
     out_hi_ref[0] = acc_h
     out_lo_ref[0] = acc_l
 
 
-@partial(jax.jit, static_argnames=("interpret",))
-def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None):
+@partial(jax.jit, static_argnames=("interpret", "algo"))
+def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
+                         algo: str = "colbcast"):
     """Same contract as ops.spgemm.numeric_round_impl, as a Pallas kernel.
 
     a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
@@ -145,7 +183,7 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None):
         jax.ShapeDtypeStruct((KG, k, G * k), jnp.uint32),
     ]
     packed_hi, packed_lo = pl.pallas_call(
-        partial(_kernel, k=k, G=G),
+        partial(_kernel, k=k, G=G, algo=algo),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,

@@ -34,3 +34,28 @@ def test_pallas_multi_round_and_padding():
     got = spgemm(a, b, backend="pallas", round_size=4)
     want = spgemm(a, b, backend="xla")
     assert got == want
+
+
+@pytest.mark.parametrize("dist", ["full", "adversarial"])
+def test_vecj_algo_matches_colbcast(dist):
+    """The vectorized-j kernel layout must be bit-identical to the unrolled
+    column-broadcast layout (same fold order, different vector arrangement)."""
+    import jax.numpy as jnp
+
+    from spgemm_tpu.ops import u64
+    from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas
+    from spgemm_tpu.utils.gen import random_values
+
+    rng = np.random.default_rng(len(dist))
+    k, nnzb, K, P = 8, 9, 20, 7
+    tiles = random_values((nnzb + 1, k, k), rng, dist)
+    tiles[-1] = 0
+    hi, lo = map(jnp.asarray, u64.u64_to_hilo(tiles))
+    pa = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    pb = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    w = numeric_round_pallas(hi, lo, hi, lo, pa, pb, interpret=True,
+                             algo="colbcast")
+    g = numeric_round_pallas(hi, lo, hi, lo, pa, pb, interpret=True,
+                             algo="vecj")
+    assert np.array_equal(np.asarray(w[0]), np.asarray(g[0]))
+    assert np.array_equal(np.asarray(w[1]), np.asarray(g[1]))
