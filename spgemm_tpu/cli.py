@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                    help="snapshot chain partials after each reduction pass and "
                         "resume from the newest snapshot on restart")
+    p.add_argument("--failover", action="store_true",
+                   help="failure detection + recovery: if the device dies "
+                        "mid-chain, restart the current pass on the host-only "
+                        "oracle (keeps host copies of each pass -- one extra "
+                        "D2H per pass)")
     p.add_argument("--ranks", type=int, default=1, metavar="P",
                    help="emulate `mpirun -np P` chain partitioning semantics "
                         "(reference sparse_matrix_mult.cu:438-456)")
@@ -144,6 +149,8 @@ def run(argv: list[str] | None = None) -> int:
                     kwargs["backend"] = args.backend
                 if args.checkpoint_dir:
                     kwargs["checkpoint_dir"] = args.checkpoint_dir
+                if args.failover:
+                    kwargs["failover"] = True
                 if args.ranks > 1:
                     from spgemm_tpu.parallel.chainpart import chain_product_partitioned
                     result = chain_product_partitioned(

@@ -36,3 +36,56 @@ def test_single_matrix_chain():
     mats = random_chain(1, 3, 2, 0.5, rng)
     got = chain_product(mats)
     assert got == mats[0]
+
+
+def _expected(mats, k):
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    return BlockSparseMatrix.from_dict(mats[0].rows, mats[-1].cols, k, want)
+
+
+class _DyingMultiply:
+    """Succeeds for `ok` calls, then raises (simulates device/tunnel death)."""
+
+    def __init__(self, ok):
+        from spgemm_tpu.ops.spgemm import spgemm
+        self.ok = ok
+        self.calls = 0
+        self.inner = spgemm
+
+    def __call__(self, a, b, **kw):
+        self.calls += 1
+        if self.calls > self.ok:
+            raise RuntimeError("injected device loss")
+        return self.inner(a, b, **kw)
+
+
+def test_failover_to_oracle_without_checkpoint():
+    """Device dies mid-pass: failover restarts the pass on the host oracle
+    from the host copies taken while the device was alive."""
+    rng = np.random.default_rng(90)
+    k = 2
+    mats = random_chain(5, 4, k, 0.5, rng, "full")
+    dying = _DyingMultiply(ok=2)  # pass 1 has 2 multiplies; die in pass 2
+    got = chain_product(mats, multiply=dying, failover=True)
+    want = _expected(mats, k)
+    assert np.array_equal(got.coords, want.coords)
+    assert np.array_equal(got.tiles, want.tiles)
+
+
+def test_failover_resumes_from_checkpoint(tmp_path):
+    rng = np.random.default_rng(91)
+    k = 2
+    mats = random_chain(4, 4, k, 0.5, rng, "adversarial")
+    dying = _DyingMultiply(ok=2)
+    got = chain_product(mats, multiply=dying, failover=True,
+                        checkpoint_dir=str(tmp_path))
+    want = _expected(mats, k)
+    assert np.array_equal(got.coords, want.coords)
+    assert np.array_equal(got.tiles, want.tiles)
+
+
+def test_no_failover_raises():
+    rng = np.random.default_rng(92)
+    mats = random_chain(4, 4, 2, 0.5, rng, "small")
+    with pytest.raises(RuntimeError, match="injected device loss"):
+        chain_product(mats, multiply=_DyingMultiply(ok=1))
