@@ -322,6 +322,10 @@ def write_table(rows):
              "| config | backend | platform | wall s | eff. GFLOP/s | parity |",
              "|---|---|---|---|---|---|"]
     for r in rows:
+        if "error" in r:
+            err = r["error"][:60].replace("|", "\\|")
+            lines.append(f"| {r['config']} | — | — | — | — | ERROR: {err} |")
+            continue
         par = ""
         if "value_parity" in r:
             par = "bit-exact" if r["value_parity"] else "MISMATCH"
@@ -375,12 +379,19 @@ def main() -> int:
     names = [args.config] if args.config else list(CONFIGS)
     rows = []
     for name in names:
-        row = CONFIGS[name]()
+        try:
+            row = CONFIGS[name]()
+        except Exception as e:  # noqa: BLE001 -- keep sweeping, record the row
+            import traceback
+            traceback.print_exc()
+            row = {"config": name, "error": repr(e)[:300]}
         rows.append(row)
         print(json.dumps(row), flush=True)
     if args.write_table:
         print("wrote", write_table(rows))
-    return 0
+    # error rows are recorded AND surfaced in the exit code, so automation
+    # checking only rc still detects a broken config
+    return 1 if any("error" in r for r in rows) else 0
 
 
 if __name__ == "__main__":
