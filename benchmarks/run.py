@@ -303,6 +303,42 @@ def config_ffn():
             "sparse_tflops": round(sparse_flops / wall / 1e12, 2)}
 
 
+def config_loader_scaling():
+    """Loader thread scaling -- the analog of the reference's OpenMP Table 3
+    (report.pdf p.3: 1.8x/2.9x/4.1x/4.3x at 4/8/16/32 threads for its
+    omp-task file loads).  Times read_chain over a generated on-disk chain
+    at 1/4/16 threads; the native GIL-released tokenizer is what makes
+    thread scaling real."""
+    from spgemm_tpu.utils import io_text
+    from spgemm_tpu.utils.gen import random_chain
+
+    rng = np.random.default_rng(4)
+    k = 32
+    # ~20k tiles over 16 files: big enough that parse time dominates the
+    # pool overhead (the reference's Table 3 ran at its 100k-tile scale)
+    mats = random_chain(16, 64, k, 0.3, rng, "full")
+    with tempfile.TemporaryDirectory() as td:
+        folder = os.path.join(td, "in")
+        io_text.write_chain_dir(folder, mats, k)
+        # warmup: native-library ctypes load, page cache, pool code paths --
+        # must not land inside the first timed point
+        io_text.read_chain(folder, 0, len(mats) - 1, k, max_workers=2)
+        times = {}
+        for threads in (1, 4, 16):
+            t0 = time.perf_counter()
+            got = io_text.read_chain(folder, 0, len(mats) - 1, k,
+                                     max_workers=threads)
+            times[threads] = time.perf_counter() - t0
+            assert len(got) == len(mats)
+    best = min(times.values())
+    return {"config": "loader-scaling", "backend": "native+threads",
+            "platform": "host", "files": len(mats),
+            "host_cores": os.cpu_count(),
+            "wall_s": round(best, 4),
+            "wall_s_by_threads": {str(t): round(s, 4) for t, s in times.items()},
+            "speedup_best_vs_1": round(times[1] / best, 2)}
+
+
 CONFIGS = {
     "random-1pct": config_random_1pct,
     "cage12": config_cage12,
@@ -311,6 +347,7 @@ CONFIGS = {
     "nd24k-mxu": config_nd24k_mxu,
     "webbase-1M": config_webbase,
     "ffn": config_ffn,
+    "loader-scaling": config_loader_scaling,
 }
 
 

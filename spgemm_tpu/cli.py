@@ -50,8 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max output tiles per numeric launch (default: auto -- "
                         "SMEM-bounded on the Pallas backend, 512 on XLA; the "
                         "reference's small_size=500)")
-    p.add_argument("--threads", type=int, default=16,
-                   help="file-loader thread pool size (reference num_threads(16))")
+    p.add_argument("--threads", type=int, default=None,
+                   help="file-loader thread pool size (default: min(16, 4x "
+                        "host cores); the reference hardcodes num_threads(16))")
     p.add_argument("--shard", choices=["none", "keys", "inner", "ring"], default="none",
                    help="shard the numeric phase over the visible device mesh: "
                         "'keys' = output-tile sharding (bit-exact), 'inner' = "
