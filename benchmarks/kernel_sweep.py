@@ -87,10 +87,16 @@ def main() -> int:
         pb = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
         flops = 2.0 * K * P * k ** 3
         variants = [
-            ("vpu-colbcast", numeric_round_pallas,
+            ("vpu-colbcast-g16", numeric_round_pallas,
              (hi, lo, hi, lo, pa, pb), {"algo": "colbcast"}),
-            ("vpu-vecj", numeric_round_pallas,
+            ("vpu-colbcast-g8", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb), {"algo": "colbcast", "group": 8}),
+            ("vpu-colbcast-g32", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb), {"algo": "colbcast", "group": 32}),
+            ("vpu-vecj-g16", numeric_round_pallas,
              (hi, lo, hi, lo, pa, pb), {"algo": "vecj"}),
+            ("vpu-vecj-g8", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb), {"algo": "vecj", "group": 8}),
             ("mxu-xla-10x10", numeric_round_mxu,
              (hi, lo, hi, lo, pa, pb), {}),
             ("mxu-pallas-10x10", numeric_round_mxu_pallas,
@@ -98,8 +104,11 @@ def main() -> int:
             ("mxu-pallas-3x3-bounded", numeric_round_mxu_pallas,
              (hi16, lo16, hi16, lo16, pa, pb), {"a_limbs": 3, "b_limbs": 3}),
         ]
+        from spgemm_tpu.ops.pallas_spgemm import resolve_group
+
         for name, fn, fargs, kw in variants:
             try:
+                is_vpu = fn is numeric_round_pallas
                 if kw:
                     from functools import partial
                     fn = partial(fn, **kw)
@@ -107,6 +116,9 @@ def main() -> int:
                 row = {"variant": name, "K": K, "P": P, "k": k,
                        "platform": platform, "wall_ms": round(dt * 1e3, 2),
                        "effective_gflops": round(gflops, 1)}
+                if is_vpu:
+                    # the RESOLVED group width (lane caps clamp requests)
+                    row["G"] = resolve_group(k, K, kw.get("group"))
             except Exception as e:  # noqa: BLE001 -- record, keep sweeping
                 row = {"variant": name, "K": K, "P": P, "k": k,
                        "platform": platform, "error": repr(e)[:200]}

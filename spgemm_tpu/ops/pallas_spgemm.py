@@ -113,13 +113,25 @@ def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str):
     out_lo_ref[0] = acc_l
 
 
-@partial(jax.jit, static_argnames=("interpret", "algo"))
+def resolve_group(k: int, K: int, group: int | None = None) -> int:
+    """The key-group width G the kernel will actually run.
+
+    Default 16, bounded by 512 accumulator lanes (1024 for an explicit
+    override) and by K.  Exposed so benchmark labels report the RESOLVED
+    width, not the requested one (they differ when lane caps clamp)."""
+    lane_cap = 1024 if group else 512
+    return max(1, min(group or 16, lane_cap // k, K))
+
+
+@partial(jax.jit, static_argnames=("interpret", "algo", "group"))
 def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
-                         algo: str = "colbcast"):
+                         algo: str = "colbcast", group: int | None = None):
     """Same contract as ops.spgemm.numeric_round_impl, as a Pallas kernel.
 
     a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
     pa, pb  : (K, P) int32 slab indices, per-key j-ascending, sentinel-padded.
+    group   : override the key-group width G (benchmarks/kernel_sweep.py
+              measures the ladder; default below is the tuned value).
     Returns (out_hi, out_lo): (K, k, k) uint32.
     """
     K, P = pa.shape
@@ -128,9 +140,9 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
         interpret = jax.devices()[0].platform == "cpu"
 
     # group width: wider groups amortize per-grid-step overhead (~10% win
-    # from G=4 to G=16 at k=32, measured); bounded by 512 lanes of
-    # accumulator width and 4*G input refs per step
-    G = max(1, min(16, 512 // k, K))
+    # from G=4 to G=16 at k=32, measured); bounded by the accumulator lane
+    # cap and 4*G input refs per step
+    G = resolve_group(k, K, group)
     K_pad = -(-K // G) * G
     if K_pad != K:
         pad = ((0, K_pad - K), (0, 0))
