@@ -1,0 +1,37 @@
+#!/bin/bash
+# One-shot TPU evidence capture: run everything the RESULTS/bench artifacts
+# need in one pass (the chip behind the axon tunnel can vanish for hours --
+# see round-3 notes -- so when it IS up, capture it all).
+#
+# Usage: bash benchmarks/tpu_evidence.sh [outdir]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-benchmarks/evidence}
+mkdir -p "$OUT"
+
+probe() {
+  timeout 120 python -c "
+import jax, jax.numpy as jnp
+(jnp.ones((512,512), jnp.bfloat16) @ jnp.ones((512,512), jnp.bfloat16)).block_until_ready()
+print('tpu ok')" 2>&1 | tail -1
+}
+
+echo "[1/5] probe"
+if [ "$(probe)" != "tpu ok" ]; then
+  echo "TPU unreachable; aborting (nothing written)"
+  exit 2
+fi
+
+echo "[2/5] bench warm (compile cache)"
+timeout 900 python bench.py --warm 2>&1 | tail -2 | tee "$OUT/warm.txt"
+
+echo "[3/5] bench headline"
+timeout 900 python bench.py 2>&1 | tee "$OUT/bench.txt" | tail -1
+
+echo "[4/5] benchmark suite -> RESULTS.md"
+timeout 2400 python benchmarks/run.py --write-table 2>&1 | tee "$OUT/suite.txt" | tail -3
+
+echo "[5/5] kernel sweep"
+timeout 2400 python benchmarks/kernel_sweep.py 2>&1 | tee "$OUT/sweep.txt" | tail -10
+
+echo "done; evidence in $OUT"
