@@ -4,7 +4,7 @@
 # see round-3 notes -- so when it IS up, capture it all).
 #
 # Usage: bash benchmarks/tpu_evidence.sh [outdir]
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 OUT=${1:-benchmarks/evidence}
 mkdir -p "$OUT"
@@ -12,6 +12,7 @@ mkdir -p "$OUT"
 probe() {
   timeout 120 python -c "
 import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu', jax.devices()
 (jnp.ones((512,512), jnp.bfloat16) @ jnp.ones((512,512), jnp.bfloat16)).block_until_ready()
 print('tpu ok')" 2>&1 | tail -1
 }
@@ -22,16 +23,22 @@ if [ "$(probe)" != "tpu ok" ]; then
   exit 2
 fi
 
+fail=0
+
 echo "[2/5] bench warm (compile cache)"
-timeout 900 python bench.py --warm 2>&1 | tail -2 | tee "$OUT/warm.txt"
+timeout 900 python bench.py --warm 2>&1 | tee "$OUT/warm.txt" | tail -2 || fail=1
 
 echo "[3/5] bench headline"
-timeout 900 python bench.py 2>&1 | tee "$OUT/bench.txt" | tail -1
+timeout 900 python bench.py 2>&1 | tee "$OUT/bench.txt" | tail -1 || fail=1
 
 echo "[4/5] benchmark suite -> RESULTS.md"
-timeout 2400 python benchmarks/run.py --write-table 2>&1 | tee "$OUT/suite.txt" | tail -3
+timeout 2400 python benchmarks/run.py --write-table 2>&1 | tee "$OUT/suite.txt" | tail -3 || fail=1
 
 echo "[5/5] kernel sweep"
-timeout 2400 python benchmarks/kernel_sweep.py 2>&1 | tee "$OUT/sweep.txt" | tail -10
+timeout 2400 python benchmarks/kernel_sweep.py 2>&1 | tee "$OUT/sweep.txt" | tail -10 || fail=1
 
+if [ "$fail" -ne 0 ]; then
+  echo "done WITH FAILURES; partial evidence in $OUT"
+  exit 1
+fi
 echo "done; evidence in $OUT"

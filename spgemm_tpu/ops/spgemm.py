@@ -146,7 +146,15 @@ def spgemm_device(a, b, *, round_size: int | None = None,
         else:
             backend = resolve_backend(None)
     if backend == "pallas":
-        from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas as numeric  # noqa: PLC0415
+        import os  # noqa: PLC0415
+
+        from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas  # noqa: PLC0415
+
+        # manual A/B hook: SPGEMM_TPU_VPU_ALGO=vecj runs the whole engine
+        # (CLI, bench) on the alternate kernel layout; default is the tuned
+        # one.  jit caches per static algo value, so this costs nothing.
+        numeric = partial(numeric_round_pallas,
+                          algo=os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast"))
 
         # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
         # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to 8),
