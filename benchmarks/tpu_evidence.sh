@@ -27,9 +27,13 @@ fail=0
 
 echo "[2/5] bench warm (compile cache)"
 timeout 900 python bench.py --warm 2>&1 | tee "$OUT/warm.txt" | tail -2 || fail=1
+# bench.py's driver contract forces rc=0 even on internal failure -- detect
+# the failure through the emitted JSON instead
+grep -q '"warmed": true' "$OUT/warm.txt" || fail=1
 
 echo "[3/5] bench headline"
 timeout 900 python bench.py 2>&1 | tee "$OUT/bench.txt" | tail -1 || fail=1
+grep -q 'chain_multiply_wall_clock_failed' "$OUT/bench.txt" && fail=1
 
 echo "[4/5] benchmark suite -> RESULTS.md"
 timeout 2400 python benchmarks/run.py --write-table 2>&1 | tee "$OUT/suite.txt" | tail -3 || fail=1

@@ -202,6 +202,8 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
     a memory budget (the Pallas kernel's scalar-prefetch arrays live in SMEM)
     rather than by gather-materialization size (the XLA backend's constraint).
     """
+    if round_size < 1:
+        raise ValueError(f"round_size must be >= 1, got {round_size}")
     rounds: list[Round] = []
     if join.num_keys == 0:
         return rounds

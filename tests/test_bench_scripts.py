@@ -49,3 +49,48 @@ def test_benchmark_suite_webbase_row(tmp_path):
     row = json.loads(rc.stdout.strip().splitlines()[-1])
     assert row["config"] == "webbase-1M"
     assert row["value_parity"] is True
+
+
+def test_bench_warm_flag():
+    rc = _run(["bench.py", "--chain", "2", "--block-dim", "8",
+               "--bandwidth", "1", "--k", "8", "--device", "cpu", "--warm"])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads([ln for ln in rc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert row["warmed"] is True and row["compile_pass_s"] > 0
+
+
+def test_bench_emits_json_and_rc0_on_internal_failure():
+    """The driver contract: rc must stay 0 and a JSON line must appear even
+    when the run blows up mid-way (here: an invalid round size forces an
+    engine error after backend init)."""
+    rc = _run(["bench.py", "--chain", "2", "--block-dim", "8",
+               "--bandwidth", "1", "--k", "8", "--device", "cpu",
+               "--round-size", "-3"])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    lines = [ln for ln in rc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, rc.stdout
+    row = json.loads(lines[-1])
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(row)
+    # the failure branch must actually have fired (else this test is vacuous)
+    assert row["metric"] == "chain_multiply_wall_clock_failed", row
+    assert "error" in row["detail"]
+
+
+def test_suite_rc_nonzero_on_config_error(tmp_path):
+    """A crashing config yields an error row AND a nonzero exit."""
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import benchmarks.run as R\n"
+        "R._pin_platform('cpu')\n"
+        "def boom(): raise RuntimeError('config exploded')\n"
+        "R.CONFIGS = {'boom': boom}\n"
+        "sys.exit(R.main())\n" % REPO
+    )
+    script = tmp_path / "suite_err.py"
+    script.write_text(code)
+    rc = _run([str(script)])
+    assert rc.returncode != 0
+    row = json.loads([ln for ln in rc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert row["config"] == "boom" and "config exploded" in row["error"]
