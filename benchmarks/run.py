@@ -375,9 +375,49 @@ def write_table(rows):
             gf = f"{r['sparse_tflops']} TF/s"
         lines.append(f"| {r['config']} | {r['backend']} | {r['platform']} | "
                      f"{r['wall_s']} | {gf or ''} | {par} |")
+    sweep = _sweep_section()
+    if sweep:
+        lines += [""] + sweep
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
     return path
+
+
+def _sweep_section():
+    """Kernel-variant table from the newest kernel_sweep evidence, if any
+    (written by tpu_evidence.sh, which runs the sweep BEFORE the suite so
+    this table is from the same capture; SPGEMM_TPU_EVIDENCE_DIR overrides
+    the directory for custom-outdir runs)."""
+    ev_dir = os.environ.get("SPGEMM_TPU_EVIDENCE_DIR",
+                            os.path.join(REPO, "benchmarks", "evidence"))
+    path = os.path.join(ev_dir, "sweep.txt")
+    if not os.path.exists(path):
+        return []
+    rows = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    rows.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass
+    if not rows:
+        return []
+    lines = ["## Kernel variants (benchmarks/kernel_sweep.py)",
+             "",
+             "| variant | K | P | G | platform | wall ms | eff. GFLOP/s |",
+             "|---|---|---|---|---|---|---|"]
+    for r in rows:
+        if "error" in r:
+            err = r["error"][:50].replace("|", "\\|")
+            lines.append(f"| {r['variant']} | {r['K']} | {r['P']} | "
+                         f"{r.get('G', '')} | {r['platform']} | ERROR | {err} |")
+        else:
+            lines.append(f"| {r['variant']} | {r['K']} | {r['P']} | "
+                         f"{r.get('G', '')} | {r['platform']} | "
+                         f"{r['wall_ms']} | {r['effective_gflops']} |")
+    return lines
 
 
 def _pin_platform(platform: str | None, n_virtual: int = 0) -> None:

@@ -35,11 +35,14 @@ echo "[3/5] bench headline"
 timeout 900 python bench.py 2>&1 | tee "$OUT/bench.txt" | tail -1 || fail=1
 grep -q 'chain_multiply_wall_clock_failed' "$OUT/bench.txt" && fail=1
 
-echo "[4/5] benchmark suite -> RESULTS.md"
-timeout 2400 python benchmarks/run.py --write-table 2>&1 | tee "$OUT/suite.txt" | tail -3 || fail=1
-
-echo "[5/5] kernel sweep"
+# sweep BEFORE the suite: run.py --write-table embeds $OUT/sweep.txt into
+# RESULTS.md, so the sweep must come from the same capture
+echo "[4/5] kernel sweep"
 timeout 2400 python benchmarks/kernel_sweep.py 2>&1 | tee "$OUT/sweep.txt" | tail -10 || fail=1
+
+echo "[5/5] benchmark suite -> RESULTS.md"
+SPGEMM_TPU_EVIDENCE_DIR="$(cd "$OUT" && pwd)" \
+  timeout 2400 python benchmarks/run.py --write-table 2>&1 | tee "$OUT/suite.txt" | tail -3 || fail=1
 
 if [ "$fail" -ne 0 ]; then
   echo "done WITH FAILURES; partial evidence in $OUT"
