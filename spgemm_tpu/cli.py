@@ -53,12 +53,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--threads", type=int, default=None,
                    help="file-loader thread pool size (default: min(16, 4x "
                         "host cores); the reference hardcodes num_threads(16))")
-    p.add_argument("--shard", choices=["none", "keys", "inner", "ring"], default="none",
-                   help="shard the numeric phase over the visible device mesh: "
-                        "'keys' = output-tile sharding (bit-exact), 'inner' = "
-                        "contraction sharding + ICI all-reduce, 'ring' = rotate B "
-                        "around the ring, O(1/n) operand memory ('inner'/'ring' use "
-                        "clean mod-(2^64-1) arithmetic, see parallel/)")
+    p.add_argument("--shard", choices=["none", "keys", "inner", "ring", "chain"],
+                   default="none",
+                   help="shard over the visible device mesh: 'keys' = output-"
+                        "tile sharding per multiply (bit-exact), 'inner' = "
+                        "contraction sharding + ICI all-reduce, 'ring' = rotate "
+                        "B around the ring, O(1/n) operand memory ('inner'/"
+                        "'ring' use clean mod-(2^64-1) arithmetic, see "
+                        "parallel/), 'chain' = one chain rank per device "
+                        "executing concurrently (bit-exact, the reference's "
+                        "MPI data parallelism at P = n_devices)")
     p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                    help="snapshot chain partials after each reduction pass and "
                         "resume from the newest snapshot on restart")
@@ -137,6 +141,17 @@ def run(argv: list[str] | None = None) -> int:
                 blocks = chain_oracle([m.to_dict() for m in matrices], k)
                 result = BlockSparseMatrix.from_dict(
                     matrices[0].rows, matrices[-1].cols, k, blocks)
+            elif args.shard == "chain":
+                from spgemm_tpu.parallel.chainpart import chain_product_on_devices
+                kwargs = {"round_size": args.round_size,
+                          "backend": args.backend}
+                if args.checkpoint_dir:
+                    kwargs["checkpoint_dir"] = args.checkpoint_dir
+                if args.failover:
+                    kwargs["failover"] = True
+                if args.ranks > 1:
+                    kwargs["num_parts"] = args.ranks  # parity needs exact P
+                result = chain_product_on_devices(matrices, **kwargs)
             else:
                 multiply, kwargs = None, {"round_size": args.round_size}
                 if args.shard == "keys":

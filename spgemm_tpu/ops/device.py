@@ -56,11 +56,15 @@ class DeviceBlockMatrix:
         return len(self.coords)
 
     @classmethod
-    def from_host(cls, m: BlockSparseMatrix) -> "DeviceBlockMatrix":
-        """Upload a host matrix: one H2D of the (hi, lo) planes + sentinel."""
+    def from_host(cls, m: BlockSparseMatrix, device=None) -> "DeviceBlockMatrix":
+        """Upload a host matrix: one H2D of the (hi, lo) planes + sentinel.
+
+        device: explicit placement (e.g. per-rank devices in
+        parallel/chainpart.chain_product_on_devices); default placement
+        otherwise."""
         from spgemm_tpu.ops.spgemm import pack_tiles  # noqa: PLC0415
 
-        hi, lo = pack_tiles(m)
+        hi, lo = pack_tiles(m, device=device)
         bound = int(m.tiles.max()) if m.nnzb else 0
         return cls(rows=m.rows, cols=m.cols, k=m.k, coords=m.coords,
                    hi=hi, lo=lo, _host=m, val_bound=bound)

@@ -33,12 +33,18 @@ from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 log = logging.getLogger("spgemm_tpu.spgemm")
 
 
-def pack_tiles(m: BlockSparseMatrix):
+def pack_tiles(m: BlockSparseMatrix, device=None):
     """Tile slab -> device (hi, lo) uint32 planes with an all-zero sentinel
-    tile appended at index nnzb (padding target for the round planner)."""
+    tile appended at index nnzb (padding target for the round planner).
+
+    device: target placement -- a direct host->device transfer (the default
+    placement otherwise; an explicit non-default device must NOT stage
+    through device 0)."""
     k = m.k
     slab = np.concatenate([m.tiles, np.zeros((1, k, k), np.uint64)], axis=0)
     hi, lo = u64.u64_to_hilo(slab)
+    if device is not None:
+        return jax.device_put(hi, device), jax.device_put(lo, device)
     return jnp.asarray(hi), jnp.asarray(lo)
 
 

@@ -70,3 +70,80 @@ def chain_product_partitioned(matrices: list[BlockSparseMatrix], num_parts: int,
         return partials[0] if keep_device else _to_host(partials[0])
     return chain_product(partials, multiply=multiply, keep_device=keep_device,
                          checkpoint_dir=sub("combine"), **kwargs)
+
+
+def chain_product_on_devices(matrices: list[BlockSparseMatrix],
+                             devices=None, num_parts: int | None = None,
+                             **kwargs) -> BlockSparseMatrix:
+    """The reference's MPI data parallelism actually EXECUTING in parallel:
+    one device per rank, concurrent sub-chain reductions.
+
+    `chain_product_partitioned` replicates `mpirun -np P` *semantics* on one
+    device; here each rank's sub-chain is placed on its own mesh device
+    (committed placement, so jit runs each rank's multiplies where its tiles
+    live) and JAX's async dispatch overlaps the per-rank reductions across
+    the mesh -- the TPU-native version of P MPI processes computing
+    concurrently (sparse_matrix_mult.cu:438-456).  Partials then converge to
+    devices[0] and reduce with the same helper2 combine tree as rank 0
+    (:557-571), so the result is bit-identical to
+    `chain_product_partitioned(matrices, P)` at the same P.
+
+    num_parts: P (default len(devices); parity requires matching the
+    reference's P, so an explicit P cycles ranks over the devices).  Idle
+    ranks (N < P) get no device work, mirroring the reference's :612
+    degenerate branch.  NOTE: checkpoint_dir serializes the ranks -- each
+    pass snapshot is a blocking D2H, so rank idx finishes before rank idx+1
+    dispatches; recoverability costs the overlap.
+    """
+    import os
+
+    import jax
+
+    from spgemm_tpu.ops.device import DeviceBlockMatrix
+    from spgemm_tpu.ops.spgemm import spgemm_device
+
+    if devices is None:
+        devices = jax.devices()
+    p = num_parts or len(devices)
+    checkpoint_dir = kwargs.pop("checkpoint_dir", None)
+
+    def sub(name):
+        return os.path.join(checkpoint_dir, name) if checkpoint_dir else None
+
+    parts = partition_chain(len(matrices), p)
+    partials = []
+    for idx, part in enumerate(parts):
+        if part is None:
+            continue
+        start, end = part
+        dev = devices[idx % len(devices)]
+        dmats = [DeviceBlockMatrix.from_host(m, device=dev)
+                 for m in matrices[start:end + 1]]
+        # async dispatch: rank idx's whole reduction enqueues on its device
+        # before rank idx+1's begins -- the ranks execute concurrently
+        # (unless checkpointing, see docstring)
+        partials.append(chain_product(dmats, multiply=spgemm_device,
+                                      keep_device=True,
+                                      checkpoint_dir=sub(f"rank{idx}"),
+                                      **kwargs))
+    if len(partials) == 1:
+        return _to_host(partials[0])
+    if any(not isinstance(d, DeviceBlockMatrix) for d in partials):
+        # a rank failed over to the host oracle (failover=True): finish the
+        # combine tree on the host too -- the device cannot be trusted
+        from spgemm_tpu.chain import oracle_multiply  # noqa: PLC0415
+
+        return chain_product([_to_host(d) for d in partials],
+                             multiply=oracle_multiply,
+                             checkpoint_dir=sub("combine"))
+    # gather: partial slabs converge on devices[0] (the rank-0 combine);
+    # coords stay host-side, only tile planes move over ICI/PCIe
+    gathered = [
+        DeviceBlockMatrix(rows=d.rows, cols=d.cols, k=d.k, coords=d.coords,
+                          hi=jax.device_put(d.hi, devices[0]),
+                          lo=jax.device_put(d.lo, devices[0]),
+                          val_bound=d.val_bound)
+        for d in partials
+    ]
+    return chain_product(gathered, multiply=spgemm_device, keep_device=False,
+                         checkpoint_dir=sub("combine"), **kwargs)
