@@ -42,8 +42,8 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="numeric-phase implementation (default: pallas on "
                         "TPU, xla elsewhere; mxu = field-mode limb matmul on "
-                        "the systolic array, hybrid = mxu only when provably "
-                        "bit-exact)")
+                        "the systolic array, hybrid = per-round mxu where "
+                        "provably bit-exact, exact kernel elsewhere)")
     p.add_argument("--output", default="matrix",
                    help="output path (reference writes ./matrix)")
     p.add_argument("--round-size", type=int, default=None,

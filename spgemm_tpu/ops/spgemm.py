@@ -100,12 +100,54 @@ def resolve_backend(backend: str | None) -> str:
     interpret mode on CPU, which is correct but slow -- tests opt in).
 
     Other values: 'mxu' = field-mode limb matmul on the systolic array
-    (clean mod-(2^64-1) semantics, ops/mxu_spgemm.py); 'hybrid' = per-multiply
-    choice of 'mxu' when provably bit-exact vs the reference fold, exact VPU
-    backend otherwise."""
+    (clean mod-(2^64-1) semantics, ops/pallas_mxu.py on TPU); 'hybrid' =
+    per-ROUND choice within each multiply -- fanout classes whose
+    bit-exactness proof holds run 'mxu', the rest run the exact kernel, and
+    the mixed result is always reference-bit-exact."""
     if backend is not None:
         return backend
     return "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+
+
+def _select_numeric(backend: str, a, b):
+    """Resolve a concrete backend name to (numeric_fn, max_entries,
+    default_round_size) for operands a, b (their val_bounds parameterize
+    the MXU limb grids)."""
+    if backend == "pallas":
+        import os  # noqa: PLC0415
+
+        from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas  # noqa: PLC0415
+
+        # manual A/B hook: SPGEMM_TPU_VPU_ALGO=vecj runs the whole engine
+        # (CLI, bench) on the alternate kernel layout; default is the tuned
+        # one.  jit caches per static algo value, so this costs nothing.
+        numeric = partial(numeric_round_pallas,
+                          algo=os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast"))
+        # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
+        # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to 8),
+        # not by gather materialization: merge key chunks into fewer, bigger
+        # launches.  An explicit round_size still caps the key axis.
+        return numeric, 64 * 1024, 8192
+    if backend == "xla":
+        return _numeric_round, None, 512
+    if backend == "mxu":
+        # Pallas-grid MXU limb kernel on TPU (ops/pallas_mxu.py); the XLA
+        # batched-matmul formulation elsewhere (it is the better CPU lowering
+        # and the cross-check oracle for the kernel).
+        if jax.devices()[0].platform == "tpu":
+            from spgemm_tpu.ops.pallas_mxu import (  # noqa: PLC0415
+                limbs_for_bound, numeric_round_mxu_pallas)
+
+            # proven value bounds shrink the limb grid (5x5 for 32-bit
+            # values vs 10x10 unbounded): 4x less dot + epilogue work
+            numeric = partial(numeric_round_mxu_pallas,
+                              a_limbs=limbs_for_bound(a.val_bound),
+                              b_limbs=limbs_for_bound(b.val_bound))
+            return numeric, 64 * 1024, 8192
+        from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu  # noqa: PLC0415
+
+        return numeric_round_mxu, None, 512
+    raise ValueError(f"unknown backend {backend!r}")
 
 
 def spgemm_device(a, b, *, round_size: int | None = None,
@@ -133,67 +175,37 @@ def spgemm_device(a, b, *, round_size: int | None = None,
 
     backend = resolve_backend(backend)
     out_bound = (1 << 64) - 2  # any backend's outputs are mod-collapsed
+    choose_numeric = None  # per-round dispatcher (hybrid only)
     if backend == "hybrid":
-        # MXU field mode when provably bit-exact for these operands
-        # (no product or partial sum can reach 2^64-1), VPU exact otherwise
+        # Per-ROUND dispatch: rounds are bucketed by fanout class
+        # (plan_rounds) and the bit-exactness proof depends on the fanout,
+        # so each round independently runs MXU field mode when provably
+        # equal to the reference fold (no product or partial sum can reach
+        # 2^64-1 at that fanout) and the exact VPU/XLA kernel otherwise.
+        # One huge-fanout key no longer forces the whole multiply off the
+        # MXU.  Every key is computed whole by one kernel, so the mixed
+        # result is bit-exact regardless of the split.
         from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
 
-        from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
+        exact_name = resolve_backend(None)
+        numeric_exact, max_entries, default_rs = _select_numeric(exact_name, a, b)
+        numeric_mxu, mxu_entries, _ = _select_numeric("mxu", a, b)
+        # plan under the tighter budget so both kernels accept every round
+        if mxu_entries is not None and (max_entries is None
+                                        or mxu_entries < max_entries):
+            max_entries = mxu_entries
+        bounds_ok = a.val_bound is not None and b.val_bound is not None
 
-        proven = None
-        if a.val_bound is not None and b.val_bound is not None:
-            proven = safe_exact_bound(a.val_bound, b.val_bound,
-                                      int(join.fanouts.max()), k)
-        # the MXU kernel's int32 accumulator caps the padded pair axis
-        if proven is not None and _shape_class(int(join.fanouts.max())) * k > 1 << 17:
-            proven = None
-        if proven is not None:
-            backend, out_bound = "mxu", proven
-        else:
-            backend = resolve_backend(None)
-    if backend == "pallas":
-        import os  # noqa: PLC0415
+        def choose_numeric(P):  # noqa: F811 -- the hybrid dispatcher
+            if (not bounds_ok or P * k > 1 << 17
+                    or safe_exact_bound(a.val_bound, b.val_bound, P, k) is None):
+                return numeric_exact, False
+            return numeric_mxu, True
 
-        from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas  # noqa: PLC0415
-
-        # manual A/B hook: SPGEMM_TPU_VPU_ALGO=vecj runs the whole engine
-        # (CLI, bench) on the alternate kernel layout; default is the tuned
-        # one.  jit caches per static algo value, so this costs nothing.
-        numeric = partial(numeric_round_pallas,
-                          algo=os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast"))
-
-        # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
-        # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to 8),
-        # not by gather materialization: merge key chunks into fewer, bigger
-        # launches.  An explicit round_size still caps the key axis.
-        max_entries = 64 * 1024
-        round_size = 8192 if round_size is None else round_size
-    elif backend == "xla":
-        numeric = _numeric_round
-        max_entries = None
-        round_size = 512 if round_size is None else round_size
-    elif backend == "mxu":
-        # Pallas-grid MXU limb kernel on TPU (ops/pallas_mxu.py); the XLA
-        # batched-matmul formulation elsewhere (it is the better CPU lowering
-        # and the cross-check oracle for the kernel).
-        if jax.devices()[0].platform == "tpu":
-            from spgemm_tpu.ops.pallas_mxu import (  # noqa: PLC0415
-                limbs_for_bound, numeric_round_mxu_pallas)
-
-            # proven value bounds shrink the limb grid (5x5 for 32-bit
-            # values vs 10x10 unbounded): 4x less dot + epilogue work
-            numeric = partial(numeric_round_mxu_pallas,
-                              a_limbs=limbs_for_bound(a.val_bound),
-                              b_limbs=limbs_for_bound(b.val_bound))
-            max_entries = 64 * 1024  # SMEM budget for the (K, P) index pair
-            round_size = 8192 if round_size is None else round_size
-        else:
-            from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu as numeric  # noqa: PLC0415
-
-            max_entries = None
-            round_size = 512 if round_size is None else round_size
+        numeric = numeric_exact  # placeholder; per-round choice below
     else:
-        raise ValueError(f"unknown backend {backend!r}")
+        numeric, max_entries, default_rs = _select_numeric(backend, a, b)
+    round_size = default_rs if round_size is None else round_size
 
     with timers.phase("plan_rounds"):
         rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
@@ -205,11 +217,16 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     # the device tail is the caller's block_until_ready); the reference's
     # Table-2 analog phases are symbolic_join / plan_rounds /
     # numeric_dispatch / assembly.
+    mxu_rounds = 0
     with timers.phase("numeric_dispatch"):
         outs_h, outs_l, order = [], [], []
         for rnd in rounds:
-            oh, ol = numeric(a.hi, a.lo, b.hi, b.lo,
-                             jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
+            fn = numeric
+            if choose_numeric is not None:
+                fn, used_mxu = choose_numeric(rnd.pa.shape[1])
+                mxu_rounds += used_mxu
+            oh, ol = fn(a.hi, a.lo, b.hi, b.lo,
+                        jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
             n_valid = len(rnd.key_index)
             outs_h.append(oh[:n_valid])
             outs_l.append(ol[:n_valid])
@@ -229,8 +246,20 @@ def spgemm_device(a, b, *, round_size: int | None = None,
 
     # structured observability (SURVEY.md section 5.5): size, fill-in, work
     total_pairs = int(join.pair_ptr[-1])
+    tag = backend
+    if choose_numeric is not None:
+        tag = f"hybrid mxu={mxu_rounds}/{len(rounds)}"
+        if mxu_rounds == len(rounds):
+            # every round ran under a proof: the tighter propagated bound
+            # feeds the NEXT multiply's proof (chain products stay on the
+            # MXU as long as the bounds hold); safe_exact_bound is already
+            # in scope from the hybrid branch above
+            proven = safe_exact_bound(a.val_bound, b.val_bound,
+                                      int(join.fanouts.max()), k)
+            if proven is not None:
+                out_bound = proven
     log.info("spgemm[%s]: nnzb %d x %d -> keys=%d pairs=%d rounds=%d work=%.3f GFLOP",
-             backend, a.nnzb, b.nnzb, join.num_keys, total_pairs, len(rounds),
+             tag, a.nnzb, b.nnzb, join.num_keys, total_pairs, len(rounds),
              2.0 * total_pairs * k ** 3 / 1e9)
 
     return DeviceBlockMatrix(rows=a.rows, cols=b.cols, k=k,

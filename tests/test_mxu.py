@@ -6,6 +6,8 @@ be bit-exact against the REFERENCE oracle whenever it claims safety.
 """
 
 import jax.numpy as jnp
+import re
+
 import numpy as np
 import pytest
 
@@ -106,7 +108,8 @@ def test_hybrid_small_values_bit_exact_and_uses_mxu(caplog):
     b = random_block_sparse(8, 8, 8, 0.5, rng, "small")
     with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
         c = spgemm(a, b, backend="hybrid")
-    assert "spgemm[mxu]" in caplog.text  # the proof fired
+    m = re.search(r"spgemm\[hybrid mxu=(\d+)/(\d+)\]", caplog.text)
+    assert m and m.group(1) == m.group(2) != "0"  # every round ran the proof
     want = BlockSparseMatrix.from_dict(
         a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
     assert c == want  # bit-exact REFERENCE semantics via the MXU path
@@ -119,7 +122,8 @@ def test_hybrid_full_values_falls_back_to_exact(caplog):
     b = random_block_sparse(6, 6, 8, 0.4, rng, "full")
     with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
         c = spgemm(a, b, backend="hybrid")
-    assert "spgemm[mxu]" not in caplog.text
+    m = re.search(r"spgemm\[hybrid mxu=(\d+)/(\d+)\]", caplog.text)
+    assert m and m.group(1) == "0"  # no round provable at full range
     want = BlockSparseMatrix.from_dict(
         a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
     assert c == want
@@ -159,3 +163,38 @@ def test_pxk_cap_raises():
     pa = jnp.zeros((1, 8192), jnp.int32)
     with pytest.raises(ValueError, match="int32-exact bound"):
         numeric_round_mxu(hi, hi, hi, hi, pa, pa)
+
+
+def test_hybrid_mixed_fanout_per_round_dispatch(caplog):
+    """A single huge-fanout key must no longer force every round off the
+    MXU: rounds whose fanout class proves safe run field mode, the heavy
+    round runs exact -- and the mixed result is still reference-bit-exact."""
+    import logging
+
+    rng = np.random.default_rng(5)
+    k = 4
+    a = random_block_sparse(12, 12, k, 0.25, rng, "small")
+    b = random_block_sparse(12, 12, k, 0.25, rng, "small")
+    # every tile gets value bound 2^30-1 (with_blocks below rebuilds tiles),
+    # chosen so the per-fanout proof passes only for small fanout classes;
+    # a dense A-row against a dense B-column adds fanout-12 keys that fail it
+    big = np.uint64((1 << 30) - 1)
+    dense_a = np.array([(0, j) for j in range(12)], np.int64)
+    dense_b = np.array([(j, 0) for j in range(12)], np.int64)
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix as BSM
+    def with_blocks(m, extra):
+        coords = np.unique(np.concatenate([m.coords, extra]), axis=0)
+        tiles = np.full((len(coords), k, k), big, np.uint64)
+        return BSM.from_blocks(m.rows, m.cols, k, coords, tiles)
+    a2, b2 = with_blocks(a, dense_a), with_blocks(b, dense_b)
+    # proof math: bound=2^30-1 -> bound^2*k*fanout < 2^64-1 iff fanout <= 3;
+    # fanout-12 rounds must go exact, small-fanout rounds stay mxu
+    with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
+        c = spgemm(a2, b2, backend="hybrid")
+    m = re.search(r"spgemm\[hybrid mxu=(\d+)/(\d+)\]", caplog.text)
+    assert m, caplog.text
+    n_mxu, n_rounds = int(m.group(1)), int(m.group(2))
+    assert 0 < n_mxu < n_rounds, (n_mxu, n_rounds)  # genuinely mixed
+    want = BlockSparseMatrix.from_dict(
+        a2.rows, b2.cols, k, spgemm_oracle(a2.to_dict(), b2.to_dict(), k))
+    assert c == want  # bit-exact reference semantics from the mixed dispatch
