@@ -237,46 +237,75 @@ def config_nd24k_mxu():
                           parity=False, sampled_parity=64)
 
 
-def config_webbase(n_dev=4):
-    """Row-partitioned over a mesh; re-execs onto a virtual CPU mesh when
-    fewer than n_dev real chips are visible (the BASELINE config asks for 4)."""
+def _webbase_config(config_name, dist, strategy, backend_label, n_dev=4):
+    """Shared scaffold for the power-law (webbase-like) mesh configs:
+    re-exec onto a virtual CPU mesh when fewer than n_dev chips are visible,
+    generate the matrix pair, run the strategy, check full value parity.
+
+    strategy(a, b, devices) -> result BlockSparseMatrix.
+    """
     import jax
 
     if len(jax.devices()) < n_dev:
         env = {**os.environ,
                "PYTHONPATH": REPO + ":" + os.environ.get("PYTHONPATH", "")}
         rc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--config", "webbase-1M",
+            [sys.executable, os.path.abspath(__file__), "--config", config_name,
              "--device", "cpu", "--virtual-devices", str(n_dev)],
             capture_output=True, text=True, env=env, cwd=REPO)
         assert rc.returncode == 0, rc.stderr[-2000:]
         return json.loads(rc.stdout.strip().splitlines()[-1])
 
-    from spgemm_tpu.parallel.rowshard import spgemm_sharded
+    from spgemm_tpu.ops.symbolic import symbolic_join
     from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
     from spgemm_tpu.utils.gen import powerlaw_block_sparse
     from spgemm_tpu.utils.semantics import spgemm_oracle
-    from spgemm_tpu.ops.symbolic import symbolic_join
 
     rng = np.random.default_rng(3)
-    a = powerlaw_block_sparse(256, 32, 3.0, rng, "full")
-    b = powerlaw_block_sparse(256, 32, 3.0, rng, "full")
+    a = powerlaw_block_sparse(256, 32, 3.0, rng, dist)
+    b = powerlaw_block_sparse(256, 32, 3.0, rng, dist)
     join = symbolic_join(a.coords, b.coords)
     flops = 2.0 * int(join.pair_ptr[-1]) * a.k ** 3
+    devices = jax.devices()[:n_dev]
 
-    spgemm_sharded(a, b)  # warm/compile
+    strategy(a, b, devices)  # warm/compile
     t0 = time.perf_counter()
-    got = spgemm_sharded(a, b)
+    got = strategy(a, b, devices)
     wall = time.perf_counter() - t0
     want = BlockSparseMatrix.from_dict(
         a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
-    return {"config": "webbase-1M", "backend": f"rowshard x{n_dev}",
+    return {"config": config_name, "backend": f"{backend_label} x{n_dev}",
             "platform": jax.devices()[0].platform,
             "nnzb_a": a.nnzb, "nnzb_b": b.nnzb, "out_keys": join.num_keys,
             "tile_pairs": int(join.pair_ptr[-1]), "wall_s": round(wall, 4),
             "effective_gflops": round(flops / wall / 1e9, 2),
             "nnz_parity": bool(got.nnz == want.nnz),
             "value_parity": bool(got == want)}
+
+
+def config_webbase(n_dev=4):
+    """Row-partitioned over a mesh (bit-exact output sharding, full-range
+    values); the BASELINE config asks for 4 chips."""
+    def rowshard(a, b, devices):
+        from spgemm_tpu.parallel.rowshard import spgemm_sharded
+        return spgemm_sharded(a, b)
+
+    return _webbase_config("webbase-1M", "full", rowshard, "rowshard", n_dev)
+
+
+def config_webbase_ring(n_dev=4):
+    """The webbase structure through the ring strategy (B rotates around the
+    mesh, O(1/n) operand memory).  Ring arithmetic is field mode, which is
+    reference-bit-exact for bounded values (safe_exact_bound) -- so this
+    config uses the 'small' distribution and still checks full value parity."""
+    def ring(a, b, devices):
+        import jax
+
+        from spgemm_tpu.parallel.ring import spgemm_ring
+        mesh = jax.make_mesh((len(devices),), ("ring",), devices=devices)
+        return spgemm_ring(a, b, mesh=mesh)
+
+    return _webbase_config("webbase-ring", "small", ring, "ring", n_dev)
 
 
 def config_ffn():
@@ -346,6 +375,7 @@ CONFIGS = {
     "cage12-mxu": config_cage12_mxu,
     "nd24k-mxu": config_nd24k_mxu,
     "webbase-1M": config_webbase,
+    "webbase-ring": config_webbase_ring,
     "ffn": config_ffn,
     "loader-scaling": config_loader_scaling,
 }

@@ -196,9 +196,13 @@ def spgemm_device(a, b, *, round_size: int | None = None,
             max_entries = mxu_entries
         bounds_ok = a.val_bound is not None and b.val_bound is not None
 
-        def choose_numeric(P):  # noqa: F811 -- the hybrid dispatcher
-            if (not bounds_ok or P * k > 1 << 17
-                    or safe_exact_bound(a.val_bound, b.val_bound, P, k) is None):
+        def choose_numeric(rnd):  # noqa: F811 -- the hybrid dispatcher
+            # proof at the round's REAL max fanout (padded sentinel pairs
+            # contribute exactly 0); the padded width only gates the MXU
+            # kernel's own int32-accumulator check (P*k <= 2^17)
+            if (not bounds_ok or rnd.pa.shape[1] * k > 1 << 17
+                    or safe_exact_bound(a.val_bound, b.val_bound,
+                                        rnd.max_fanout, k) is None):
                 return numeric_exact, False
             return numeric_mxu, True
 
@@ -223,7 +227,7 @@ def spgemm_device(a, b, *, round_size: int | None = None,
         for rnd in rounds:
             fn = numeric
             if choose_numeric is not None:
-                fn, used_mxu = choose_numeric(rnd.pa.shape[1])
+                fn, used_mxu = choose_numeric(rnd)
                 mxu_rounds += used_mxu
             oh, ol = fn(a.hi, a.lo, b.hi, b.lo,
                         jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))

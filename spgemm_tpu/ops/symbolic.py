@@ -160,6 +160,9 @@ class Round:
     key_index: np.ndarray  # (n,) int64 -- positions into JoinResult.keys
     pa: np.ndarray         # (K_pad, P) int32 -- A slab indices (sentinel-padded)
     pb: np.ndarray         # (K_pad, P) int32
+    max_fanout: int = 0    # real (unpadded) max fanout among the round's keys
+                           # -- the hybrid exactness proof uses this, not the
+                           # padded class width (sentinel pairs contribute 0)
 
 
 def _ceil_pow2(x: int) -> int:
@@ -252,5 +255,6 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
             src = np.repeat(join.pair_ptr[chunk], lens) + cols
             pa[rows, cols] = join.pair_a[src]
             pb[rows, cols] = join.pair_b[src]
-            rounds.append(Round(key_index=chunk, pa=pa, pb=pb))
+            rounds.append(Round(key_index=chunk, pa=pa, pb=pb,
+                                max_fanout=int(lens.max())))
     return rounds

@@ -187,8 +187,9 @@ def test_hybrid_mixed_fanout_per_round_dispatch(caplog):
         tiles = np.full((len(coords), k, k), big, np.uint64)
         return BSM.from_blocks(m.rows, m.cols, k, coords, tiles)
     a2, b2 = with_blocks(a, dense_a), with_blocks(b, dense_b)
-    # proof math: bound=2^30-1 -> bound^2*k*fanout < 2^64-1 iff fanout <= 3;
-    # fanout-12 rounds must go exact, small-fanout rounds stay mxu
+    # proof math: bound=2^30-1, k=4 -> bound^2*k*fanout < 2^64-1 iff
+    # fanout <= 4; the fanout-12 dense rounds must go exact, the small-
+    # fanout rounds stay mxu
     with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
         c = spgemm(a2, b2, backend="hybrid")
     m = re.search(r"spgemm\[hybrid mxu=(\d+)/(\d+)\]", caplog.text)
