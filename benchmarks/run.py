@@ -21,12 +21,16 @@ BASELINE.json "nnz/Frobenius parity") are the north star's:
   5. ffn         : block-sparse Transformer FFN forward, d=4096, 90% block
                    sparsity, bf16 on the MXU (models/ffn.py).
 
-Plus two MXU-limb-kernel variants beyond the five BASELINE configs:
+Plus four rows beyond the five BASELINE configs:
 
   6. cage12-mxu / 7. nd24k-mxu : the same structures with 16-bit-bounded
                    values through backend='mxu' (ops/pallas_mxu.py on TPU) --
                    field mode is provably bit-exact vs the reference fold at
                    these bounds, so sampled parity still checks 2.9 semantics.
+  8. webbase-ring : the power-law structure through the ring strategy
+                   (O(1/n) operand memory), bounded values, full parity.
+  9. loader-scaling : file-loader thread scaling, the reference report's
+                   OpenMP Table 3 analog.
 
 Each config prints one JSON line; --write-table also refreshes
 benchmarks/RESULTS.md.  Run: python benchmarks/run.py [--config NAME]
