@@ -55,51 +55,12 @@ def _chain_config(args, rng):
     return mats
 
 
-def _probe_backend_subprocess(timeout_s: float | None = None) -> bool:
-    """Can the default backend actually initialize AND compute?
-
-    Probed in a SUBPROCESS with a hard timeout: the failure mode observed on
-    this environment's TPU tunnel is a HANG inside backend init or the first
-    device op -- not an exception -- so an in-process try/except can never
-    fail soft.  The main process must not touch the backend until the probe
-    has passed.
-    """
-    import subprocess
-    if timeout_s is None:
-        timeout_s = float(os.environ.get("SPGEMM_TPU_PROBE_TIMEOUT", "150"))
-    code = ("import jax, jax.numpy as jnp; "
-            "x = jnp.ones((64, 64), jnp.bfloat16); "
-            "(x @ x).block_until_ready(); "
-            "print(jax.devices()[0].platform)")
-    try:
-        rc = subprocess.run([sys.executable, "-c", code],
-                            capture_output=True, text=True, timeout=timeout_s)
-        # a probe that silently fell back to CPU is NOT a healthy
-        # accelerator: the full-size workload would then run on the CPU
-        # backend and blow the driver's time budget
-        plat = rc.stdout.strip().splitlines()[-1] if rc.stdout.strip() else ""
-        return rc.returncode == 0 and plat not in ("", "cpu")
-    except subprocess.TimeoutExpired:
-        return False
-
-
-def _pin(platform: str) -> None:
-    """Pin the JAX platform in-process.  The env var alone is ineffective
-    here: the TPU plugin's sitecustomize imports jax at interpreter start
-    and snapshots JAX_PLATFORMS, so the config must be updated before any
-    backend initializes."""
-    import jax
-
-    os.environ["JAX_PLATFORMS"] = platform
-    from jax._src import xla_bridge
-    if not xla_bridge._backends:
-        jax.config.update("jax_platforms", platform)
-
-
 def _shrink_to_cpu(args) -> None:
     """Pin CPU and shrink the workload (the CPU backend cannot finish the
     100k-tile chain in bench-compatible time)."""
-    _pin("cpu")
+    from spgemm_tpu.utils.backend_probe import pin
+
+    pin("cpu")
     args.block_dim = min(args.block_dim, 64)
     args.chain = min(args.chain, 4)
 
@@ -117,20 +78,22 @@ def _init_platform(args) -> str:
     """
     import jax
 
+    from spgemm_tpu.utils.backend_probe import pin, probe_default_backend
+
     if args.device:
-        _pin(args.device)
+        pin(args.device)
     else:
-        ok = False
+        outcome = None
         for attempt in range(3):
-            if _probe_backend_subprocess():
-                ok = True
-                break
-            print(f"backend probe attempt {attempt + 1} failed/hung",
+            outcome = probe_default_backend()
+            if outcome in ("ok", "cpu"):
+                break  # 'cpu' is deterministic -- retrying cannot change it
+            print(f"backend probe attempt {attempt + 1}: {outcome}",
                   file=sys.stderr)
             if attempt < 2:
                 time.sleep(5 * (attempt + 1))
-        if not ok:
-            print("backend unreachable after 3 probes; falling back to cpu",
+        if outcome != "ok":
+            print(f"no accelerator (probe: {outcome}); falling back to cpu",
                   file=sys.stderr)
             _shrink_to_cpu(args)
 
@@ -139,18 +102,21 @@ def _init_platform(args) -> str:
     jax.config.update("jax_compilation_cache_dir",
                       os.path.expanduser("~/.cache/jax_bench"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
-    try:
-        return jax.devices()[0].platform
-    except Exception as e:  # noqa: BLE001 -- init raced past the probe
-        print(f"backend init raised after a passing probe: {e!r}; "
-              "falling back to cpu", file=sys.stderr)
+    for attempt in range(3):
         try:
-            from jax._src import xla_bridge
-            xla_bridge._clear_backends()
-        except Exception:  # noqa: BLE001
-            pass
-        _shrink_to_cpu(args)
-        return jax.devices()[0].platform
+            return jax.devices()[0].platform
+        except Exception as e:  # noqa: BLE001 -- init raced past the probe
+            print(f"backend init raised (attempt {attempt + 1}): {e!r}",
+                  file=sys.stderr)
+            try:
+                from jax._src import xla_bridge
+                xla_bridge._clear_backends()
+            except Exception:  # noqa: BLE001
+                pass
+            if attempt < 2:
+                time.sleep(5 * (attempt + 1))
+    _shrink_to_cpu(args)
+    return jax.devices()[0].platform
 
 
 def main() -> int:

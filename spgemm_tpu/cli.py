@@ -98,6 +98,19 @@ def run(argv: list[str] | None = None) -> int:
             from jax._src import xla_bridge
             if not xla_bridge._backends:
                 jax.config.update("jax_platforms", args.device)
+    elif args.failover:
+        # Maximum-survivability mode: the observed accelerator failure mode
+        # is a HANG at backend init (utils/backend_probe), which no
+        # in-process handler can escape -- probe in a subprocess first and
+        # start on CPU if the accelerator is dead.
+        import sys as _sys
+
+        from spgemm_tpu.utils.backend_probe import pin, probe_default_backend
+        if probe_default_backend() != "ok":
+            # stderr: stdout keeps reference parity (`multiplying`/`time taken`)
+            print("accelerator unreachable; --failover starts on cpu",
+                  file=_sys.stderr, flush=True)
+            pin("cpu")
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,
         format="%(name)s %(message)s",
