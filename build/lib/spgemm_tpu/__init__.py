@@ -1,0 +1,40 @@
+"""tpu-spgemm: a TPU-native block-sparse matrix multiplication framework.
+
+Built from scratch in JAX/XLA/Pallas with the capabilities of the reference
+OpenMP+MPI+CUDA implementation (see SURVEY.md): chain products of block-sparse
+matrices whose nonzeros are dense k x k tiles of uint64, with the reference's
+exact wrap-then-mod-(2^64-1) arithmetic (SURVEY.md section 2.9, reference
+sparse_matrix_mult.cu:48,59-61), read/written in the reference's text directory
+format, scaled over a TPU device mesh with `shard_map` + XLA collectives in
+place of the reference's MPI layer.
+
+Layering (mirrors SURVEY.md section 1, redesigned TPU-first):
+
+  cli            -- the `a4`-compatible driver (folder -> ./matrix)   [L6]
+  parallel/      -- mesh partitioning + collectives (replaces MPI)    [L5]
+  utils/io_text  -- reference text format reader/writer               [L4]
+  chain          -- order-preserving pairwise chain reduction         [L3]
+  ops/spgemm     -- two-phase SpGEMM engine (symbolic + numeric)      [L2]
+  ops/pallas_*   -- Pallas TPU kernels (numeric phase)                [L1]
+  (memory: JAX/HBM managed -- the reference's 8 GB arena disappears)  [L0]
+
+Top-level imports are lazy so that importing the package does not pull in
+jax -- the CLI must be able to pin JAX_PLATFORMS before jax is imported.
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["BlockSparseMatrix", "spgemm", "chain_product", "__version__"]
+
+
+def __getattr__(name):
+    if name == "BlockSparseMatrix":
+        from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+        return BlockSparseMatrix
+    if name == "spgemm":
+        from spgemm_tpu.ops.spgemm import spgemm
+        return spgemm
+    if name == "chain_product":
+        from spgemm_tpu.chain import chain_product
+        return chain_product
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

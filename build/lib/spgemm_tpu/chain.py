@@ -1,0 +1,106 @@
+"""Chain scheduler (L3): order-preserving pairwise reduction of a matrix chain.
+
+The reference's helper2() (sparse_matrix_mult.cu:287-327) halves the array each
+pass, multiplying adjacent pairs left-to-right and carrying the odd trailing
+element; correctness for the non-commutative product relies only on preserving
+left-to-right adjacency, but because the arithmetic is also non-*associative*
+(SURVEY.md section 2.9), parity requires this exact reduction tree, not just
+any ordered fold.
+
+Dispatch is a plain Python loop: each multiply is a jitted device program, so
+host-side control flow costs nothing by comparison (SURVEY.md C11).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+log = logging.getLogger("spgemm_tpu.chain")
+
+
+def _to_host(m):
+    return m.to_host() if hasattr(m, "to_host") else m
+
+
+def oracle_multiply(a: BlockSparseMatrix, b: BlockSparseMatrix,
+                    **_ignored) -> BlockSparseMatrix:
+    """Host-only multiply with reference semantics (utils/semantics oracle).
+
+    The failover path: needs no accelerator, no XLA backend -- survives a
+    dead device.  Slow; correctness over speed by construction.
+    """
+    from spgemm_tpu.utils.semantics import spgemm_oracle  # noqa: PLC0415
+
+    a, b = _to_host(a), _to_host(b)
+    return BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+
+
+def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
+                  checkpoint_dir: str | None = None, resume: bool = True,
+                  keep_device: bool = False, failover: bool = False,
+                  **kwargs) -> BlockSparseMatrix:
+    """Reduce [M1, ..., MN] to M1 x M2 x ... x MN with helper2's pairing.
+
+    multiply: binary op (defaults to ops.spgemm.spgemm_device, which keeps
+    every partial product in HBM -- tile data crosses the host boundary only
+    at the final result, or never with keep_device=True); kwargs forwarded.
+    checkpoint_dir: if set, snapshot the surviving partials after each pass
+    (utils/checkpoint.py) and resume from the newest snapshot on restart.
+    failover: failure detection + recovery (SURVEY.md section 5.3; the
+    reference has none -- any rank failure kills the MPI job).  If a
+    multiply raises (device/tunnel death mid-chain), restart the current
+    pass from the newest checkpoint -- or from the last completed pass's
+    host copies -- on the host-only oracle, which needs no device at all.
+    """
+    if multiply is None:
+        from spgemm_tpu.ops.spgemm import spgemm_device as multiply  # noqa: PLC0415
+    if not matrices:
+        raise ValueError("empty chain")
+    arr = list(matrices)
+    pass_idx = 0
+    if checkpoint_dir and resume:
+        from spgemm_tpu.utils import checkpoint  # noqa: PLC0415
+        found = checkpoint.latest_pass(checkpoint_dir)
+        if found is not None:
+            pass_idx, arr = found
+            log.info("resumed from checkpoint pass %d (%d partials)",
+                     pass_idx, len(arr))
+    # Host-side copies of the current pass input: the failover restart point
+    # (device partials are unfetchable once the device is gone, so copies
+    # must be taken while it is alive -- inside the try, one D2H per pass,
+    # shared with the checkpoint writer and the final return).
+    need_host = failover or bool(checkpoint_dir)
+    arr_host = [_to_host(m) for m in arr] if failover else None
+    while len(arr) > 1:
+        try:
+            nxt = []
+            for i in range(0, len(arr) - 1, 2):
+                # the reference's :301 progress line -- printed
+                # unconditionally to stdout, as sparse_matrix_mult.cu does
+                print(f"multiplying {i} {i + 1}", flush=True)
+                nxt.append(multiply(arr[i], arr[i + 1], **kwargs))
+            if len(arr) % 2 == 1:
+                nxt.append(arr[-1])  # odd element carried (:315-321)
+            nxt_host = [_to_host(m) for m in nxt] if need_host else None
+        except Exception as e:  # noqa: BLE001 -- device loss is the use case
+            if not failover or multiply is oracle_multiply:
+                raise
+            # arr_host snapshots the exact input of the failed pass (within
+            # a run it equals the newest checkpoint, and unlike the on-disk
+            # dir it cannot belong to a previous unrelated run)
+            log.warning("multiply failed (%r); failing over to the host "
+                        "oracle from pass %d", e, pass_idx)
+            arr = arr_host
+            multiply, kwargs, keep_device = oracle_multiply, {}, False
+            continue
+        arr, arr_host = nxt, nxt_host
+        pass_idx += 1
+        if checkpoint_dir:
+            from spgemm_tpu.utils import checkpoint  # noqa: PLC0415
+            checkpoint.save_pass(checkpoint_dir, pass_idx, arr_host)
+    if arr_host is not None and not keep_device:
+        return arr_host[0]
+    return arr[0] if keep_device else _to_host(arr[0])

@@ -1,0 +1,206 @@
+"""CLI driver (L6): the `a4`-compatible entrypoint.
+
+Reference contract (sparse_matrix_mult.cu:402-682):
+
+    mpirun -np P ./a4 <folder>
+
+reads `<folder>/size` (N, k) and `<folder>/matrix1..matrixN`, computes the
+chain product, prunes all-zero tiles, writes `./matrix`, prints
+`time taken X seconds`.
+
+TPU-native contract (north star, BASELINE.json): same positional argument,
+same files, same output, no MPI launcher --
+
+    python -m spgemm_tpu.cli <folder> [--device tpu|cpu] [--backend xla|pallas]
+                             [--output matrix] [--round-size N] [--threads 16]
+
+The reference's hard-coded globals become flags with the same defaults
+(SURVEY.md section 5.6).  Multi-chip sharding is picked up automatically from
+the visible mesh (see parallel/), replacing the mpirun -np P contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu",
+        description="TPU-native block-sparse matrix chain product (reference-compatible)",
+    )
+    p.add_argument("folder", help="input directory containing `size` and `matrix1..N`")
+    p.add_argument("--device", default=None, metavar="PLATFORM",
+                   help="force a JAX platform, e.g. tpu or cpu "
+                        "(default: whatever JAX selects)")
+    p.add_argument("--backend",
+                   choices=["xla", "pallas", "mxu", "hybrid", "oracle"],
+                   default=None,
+                   help="numeric-phase implementation (default: pallas on "
+                        "TPU, xla elsewhere; mxu = field-mode limb matmul on "
+                        "the systolic array, hybrid = per-round mxu where "
+                        "provably bit-exact, exact kernel elsewhere)")
+    p.add_argument("--output", default="matrix",
+                   help="output path (reference writes ./matrix)")
+    p.add_argument("--round-size", type=int, default=None,
+                   help="max output tiles per numeric launch (default: auto -- "
+                        "SMEM-bounded on the Pallas backend, 512 on XLA; the "
+                        "reference's small_size=500)")
+    p.add_argument("--threads", type=int, default=None,
+                   help="file-loader thread pool size (default: min(16, 4x "
+                        "host cores); the reference hardcodes num_threads(16))")
+    p.add_argument("--shard", choices=["none", "keys", "inner", "ring", "chain"],
+                   default="none",
+                   help="shard over the visible device mesh: 'keys' = output-"
+                        "tile sharding per multiply (bit-exact), 'inner' = "
+                        "contraction sharding + ICI all-reduce, 'ring' = rotate "
+                        "B around the ring, O(1/n) operand memory ('inner'/"
+                        "'ring' use clean mod-(2^64-1) arithmetic, see "
+                        "parallel/), 'chain' = one chain rank per device "
+                        "executing concurrently (bit-exact, the reference's "
+                        "MPI data parallelism at P = n_devices)")
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="snapshot chain partials after each reduction pass and "
+                        "resume from the newest snapshot on restart")
+    p.add_argument("--failover", action="store_true",
+                   help="failure detection + recovery: if the device dies "
+                        "mid-chain, restart the current pass on the host-only "
+                        "oracle (keeps host copies of each pass -- one extra "
+                        "D2H per pass)")
+    p.add_argument("--ranks", type=int, default=1, metavar="P",
+                   help="emulate `mpirun -np P` chain partitioning semantics "
+                        "(reference sparse_matrix_mult.cu:438-456)")
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host mode: partition the chain across JAX "
+                        "processes (set JAX_COORDINATOR/JAX_NUM_PROCESSES/"
+                        "JAX_PROCESS_ID per host; replaces `mpirun -np P`)")
+    p.add_argument("--verbose", "-v", action="store_true")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace to DIR")
+    return p
+
+
+def run(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.device:
+        os.environ["JAX_PLATFORMS"] = args.device
+        # If an embedding (e.g. a TPU plugin's sitecustomize) already imported
+        # jax, the env var alone is too late -- the config default was
+        # snapshotted at import.  Updating the config still works as long as
+        # no backend has been initialized.
+        import sys as _sys
+        if "jax" in _sys.modules:
+            import jax
+            from jax._src import xla_bridge
+            if not xla_bridge._backends:
+                jax.config.update("jax_platforms", args.device)
+    elif args.failover:
+        # Maximum-survivability mode: the observed accelerator failure mode
+        # is a HANG at backend init (utils/backend_probe), which no
+        # in-process handler can escape -- probe in a subprocess first and
+        # start on CPU if the accelerator is dead.
+        import sys as _sys
+
+        from spgemm_tpu.utils.backend_probe import pin, probe_default_backend
+        if probe_default_backend() != "ok":
+            # stderr: stdout keeps reference parity (`multiplying`/`time taken`)
+            print("accelerator unreachable; --failover starts on cpu",
+                  file=_sys.stderr, flush=True)
+            pin("cpu")
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(name)s %(message)s",
+    )
+
+    t_start = time.perf_counter()
+
+    # imports after JAX_PLATFORMS is pinned
+    from spgemm_tpu.chain import chain_product
+    from spgemm_tpu.utils import io_text
+    from spgemm_tpu.utils.timers import PhaseTimers, maybe_profile
+
+    if args.distributed:
+        from spgemm_tpu.parallel import multihost
+
+        multihost.init_from_env()
+        import jax
+
+        n, k = io_text.read_size(args.folder)
+        result = multihost.run_distributed(
+            args.folder, k, n,
+            loader=lambda s, e: io_text.read_chain(
+                args.folder, s, e, k, max_workers=args.threads),
+            round_size=args.round_size)
+        if jax.process_index() == 0:
+            io_text.write_matrix(args.output, result.prune_zeros())
+        print(f"time taken {time.perf_counter() - t_start} seconds")
+        return 0
+
+    timers = PhaseTimers()
+    with maybe_profile(args.profile):
+        with timers.phase("load"):
+            n, k = io_text.read_size(args.folder)
+            matrices = io_text.read_chain(args.folder, 0, n - 1, k,
+                                          max_workers=args.threads)
+
+        with timers.phase("chain"):
+            if args.backend == "oracle":
+                from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+                from spgemm_tpu.utils.semantics import chain_oracle
+                blocks = chain_oracle([m.to_dict() for m in matrices], k)
+                result = BlockSparseMatrix.from_dict(
+                    matrices[0].rows, matrices[-1].cols, k, blocks)
+            elif args.shard == "chain":
+                from spgemm_tpu.parallel.chainpart import chain_product_on_devices
+                kwargs = {"round_size": args.round_size,
+                          "backend": args.backend}
+                if args.checkpoint_dir:
+                    kwargs["checkpoint_dir"] = args.checkpoint_dir
+                if args.failover:
+                    kwargs["failover"] = True
+                if args.ranks > 1:
+                    kwargs["num_parts"] = args.ranks  # parity needs exact P
+                result = chain_product_on_devices(matrices, **kwargs)
+            else:
+                multiply, kwargs = None, {"round_size": args.round_size}
+                if args.shard == "keys":
+                    from spgemm_tpu.parallel.rowshard import spgemm_sharded as multiply
+                elif args.shard == "inner":
+                    from spgemm_tpu.parallel.innershard import spgemm_inner as multiply
+                elif args.shard == "ring":
+                    from spgemm_tpu.parallel.ring import spgemm_ring as multiply
+                    kwargs.pop("round_size")
+                else:
+                    kwargs["backend"] = args.backend
+                if args.checkpoint_dir:
+                    kwargs["checkpoint_dir"] = args.checkpoint_dir
+                if args.failover:
+                    kwargs["failover"] = True
+                if args.ranks > 1:
+                    from spgemm_tpu.parallel.chainpart import chain_product_partitioned
+                    result = chain_product_partitioned(
+                        matrices, args.ranks, multiply=multiply, **kwargs)
+                else:
+                    result = chain_product(matrices, multiply=multiply, **kwargs)
+
+        with timers.phase("prune+write"):
+            io_text.write_matrix(args.output, result.prune_zeros())
+
+    timers.log_report()
+    from spgemm_tpu.utils.timers import ENGINE
+    ENGINE.log_report()  # per-multiply engine phases (symbolic/plan/dispatch/assembly)
+    # byte-parity with the reference's only surviving print (sparse_matrix_mult.cu:679)
+    print(f"time taken {time.perf_counter() - t_start} seconds")
+    return 0
+
+
+def main() -> None:
+    sys.exit(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""Model-level consumers of the block-sparse machinery.
+
+The reference has no models (it is an SpGEMM program), but the north star's
+benchmark configs (BASELINE.json) include a block-sparse Transformer FFN
+(d=4096, 90% sparse, 8 chips) -- the float/MXU counterpart of the exact-u64
+parity path.  models/ holds that: block-sparse layers whose tiles feed the
+MXU in bf16/f32, sharded dp x tp x sp over a mesh.
+"""

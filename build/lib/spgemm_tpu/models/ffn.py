@@ -1,0 +1,213 @@
+"""Block-sparse Transformer FFN: the float/MXU path (BASELINE.json config 5).
+
+The u64 parity engine (ops/spgemm.py) is VPU-bound by necessity (exact modular
+arithmetic); this module is where the MXU earns its keep: a two-layer FFN
+whose weight matrices are block-sparse -- dense k x k tiles at ~10% block
+density -- contracted against dense activations as batched MXU matmuls over
+gathered tile slabs.
+
+Weight layouts (regular structure => static shapes, no padding waste):
+  * W1 (d_model -> d_ff) is column-major block-sparse: each output
+    block-column owns `rpc` nonzero block-rows -- a gather + einsum.
+  * W2 (d_ff -> d_model) is row-major block-sparse: each input block-row owns
+    `cpc` nonzero block-columns -- an einsum + segment-sum scatter.
+
+Sharding (SPMD over a (dp, tp) mesh, see make_sharded_train_step):
+  * batch      -> dp
+  * sequence   -> tp at rest (sequence parallelism); all-gathered to enter
+                  the FFN -- the standard SP pattern
+  * W1         -> tp by output block-column (column parallel)
+  * W2         -> tp by input block-row (row parallel, aligned with W1's
+                  output sharding so no resharding of activations)
+  * second matmul produces partial sums -> psum over tp (over ICI)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class BlockSparseFFNConfig:
+    d_model: int = 4096
+    d_ff: int = 16384
+    k: int = 128            # tile edge (MXU-native)
+    block_density: float = 0.1
+    dtype: str = "bfloat16"
+
+    @property
+    def nb_model(self) -> int:  # block count along d_model
+        return self.d_model // self.k
+
+    @property
+    def nb_ff(self) -> int:     # block count along d_ff
+        return self.d_ff // self.k
+
+    @property
+    def rpc(self) -> int:       # nonzero block-rows per W1 block-column
+        return max(1, int(round(self.nb_model * self.block_density)))
+
+    @property
+    def cpc(self) -> int:       # nonzero block-cols per W2 block-row
+        return max(1, int(round(self.nb_model * self.block_density)))
+
+
+def init_params(cfg: BlockSparseFFNConfig, key) -> dict:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    dtype = jnp.dtype(cfg.dtype)
+
+    def choice_rows(key_r, n_lists, n_from, m):
+        return jax.vmap(
+            lambda s: jax.random.choice(s, n_from, shape=(m,), replace=False)
+        )(jax.random.split(key_r, n_lists)).astype(jnp.int32)
+
+    s1 = 1.0 / np.sqrt(cfg.rpc * cfg.k)
+    s2 = 1.0 / np.sqrt(cfg.block_density * cfg.nb_ff * cfg.k)
+    return {
+        "w1": {  # column-major: (nb_ff, rpc) block-rows + tiles
+            "rows": choice_rows(k1, cfg.nb_ff, cfg.nb_model, cfg.rpc),
+            "tiles": (jax.random.normal(k2, (cfg.nb_ff, cfg.rpc, cfg.k, cfg.k)) * s1).astype(dtype),
+        },
+        "w2": {  # row-major: (nb_ff, cpc) block-cols + tiles
+            "cols": choice_rows(k3, cfg.nb_ff, cfg.nb_model, cfg.cpc),
+            "tiles": (jax.random.normal(k4, (cfg.nb_ff, cfg.cpc, cfg.k, cfg.k)) * s2).astype(dtype),
+        },
+    }
+
+
+def bsmm_gather(x_blocks, w) -> jax.Array:
+    """Column-parallel block-sparse matmul: (B, nbr, k) -> (B, nbc, k).
+
+    Gathers each output block-column's nonzero input block-rows, contracts on
+    the MXU: einsum (B, nbc, rpc, k) x (nbc, rpc, k, k)."""
+    gathered = x_blocks[:, w["rows"], :]            # (B, nbc, rpc, k)
+    return jnp.einsum("bcrk,crkj->bcj", gathered, w["tiles"])
+
+
+def bsmm_scatter(x_blocks, w, n_out_blocks: int) -> jax.Array:
+    """Row-parallel block-sparse matmul: (B, nbr, k) -> (B, n_out_blocks, k).
+
+    Each input block-row contributes to its `cpc` output block-columns;
+    contributions are scatter-added with a segment sum."""
+    B = x_blocks.shape[0]
+    k = x_blocks.shape[-1]
+    contrib = jnp.einsum("brk,rckj->brcj", x_blocks, w["tiles"])  # (B, R, C, k)
+    R, C = w["cols"].shape
+    flat = contrib.reshape(B, R * C, k).transpose(1, 0, 2)        # (R*C, B, k)
+    segs = w["cols"].reshape(R * C)
+    out = jax.ops.segment_sum(flat, segs, num_segments=n_out_blocks)
+    return out.transpose(1, 0, 2)                                 # (B, nbo, k)
+
+
+def ffn_forward(params, x, cfg: BlockSparseFFNConfig) -> jax.Array:
+    """x: (batch, seq, d_model) -> (batch, seq, d_model)."""
+    B, S, D = x.shape
+    xb = x.reshape(B * S, cfg.nb_model, cfg.k)
+    h = jax.nn.gelu(bsmm_gather(xb, params["w1"]))   # (B*S, nb_ff, k)
+    y = bsmm_scatter(h, params["w2"], cfg.nb_model)  # (B*S, nb_model, k)
+    return y.reshape(B, S, D).astype(x.dtype)
+
+
+def prepare_pallas_params(params, cfg: BlockSparseFFNConfig) -> dict:
+    """One-time host-side prep for the Pallas forward: convert W2 to
+    column-major (ops/pallas_bsmm.w2_to_column_major)."""
+    from spgemm_tpu.ops.pallas_bsmm import w2_to_column_major
+
+    rows2, tiles2 = w2_to_column_major(
+        params["w2"]["cols"], params["w2"]["tiles"], cfg.nb_model)
+    return {"w1": params["w1"], "w2cm": {"rows": rows2, "tiles": tiles2}}
+
+
+def ffn_forward_pallas(pparams, x, cfg: BlockSparseFFNConfig,
+                       block_m: int = 128) -> jax.Array:
+    """ffn_forward with both matmuls as Pallas MXU kernels (single chip).
+
+    pparams: output of prepare_pallas_params.  The batch*seq axis is padded to
+    a block_m multiple; weights stream through VMEM via scalar-prefetch index
+    maps (no gather materialization)."""
+    from spgemm_tpu.ops.pallas_bsmm import bsmm_pallas
+
+    B, S, D = x.shape
+    M = B * S
+    M_pad = -(-M // block_m) * block_m
+    xf = x.reshape(M, D)
+    if M_pad != M:
+        xf = jnp.concatenate(
+            [xf, jnp.zeros((M_pad - M, D), x.dtype)], axis=0)
+    h = jax.nn.gelu(bsmm_pallas(xf, pparams["w1"]["rows"],
+                                pparams["w1"]["tiles"], block_m=block_m))
+    y = bsmm_pallas(h, pparams["w2cm"]["rows"], pparams["w2cm"]["tiles"],
+                    block_m=block_m)
+    return y[:M].reshape(B, S, D).astype(x.dtype)
+
+
+def loss_fn(params, x, y, cfg: BlockSparseFFNConfig):
+    pred = ffn_forward(params, x, cfg)
+    return jnp.mean(jnp.square(pred.astype(jnp.float32) - y.astype(jnp.float32)))
+
+
+# ---------------------------------------------------------------------------
+# Sharded training step.
+# ---------------------------------------------------------------------------
+
+def make_sharded_train_step(mesh: Mesh, cfg: BlockSparseFFNConfig, lr: float = 1e-3):
+    """Returns jitted train_step(params, x, y) -> (params, loss).
+
+    Every weight array is tp-sharded on axis 0 (W1 block-cols / W2 block-rows,
+    both the d_ff axis -- aligned, so h never reshards); x and y are
+    dp-sharded on batch and tp-sharded on sequence (SP at rest)."""
+
+    def per_shard_loss(tiles, idx, x, y):
+        w1 = {"rows": idx["w1"], "tiles": tiles["w1"]}
+        w2 = {"cols": idx["w2"], "tiles": tiles["w2"]}
+        # enter FFN: all-gather the sequence shards (SP -> full activations)
+        x_full = jax.lax.all_gather(x, "tp", axis=1, tiled=True)
+        y_full = jax.lax.all_gather(y, "tp", axis=1, tiled=True)
+        B, S, D = x_full.shape
+        xb = x_full.reshape(B * S, cfg.nb_model, cfg.k)
+        h = jax.nn.gelu(bsmm_gather(xb, w1))         # local d_ff block-cols
+        y_part = bsmm_scatter(h, w2, cfg.nb_model)   # partial over local d_ff
+        y_pred = jax.lax.psum(y_part, "tp")          # row-parallel reduce (ICI)
+        pred = y_pred.reshape(B, S, D)
+        sq = jnp.square(pred.astype(jnp.float32) - y_full.astype(jnp.float32))
+        total = jax.lax.psum(jnp.sum(sq), "dp")      # mean over global batch
+        count = jax.lax.psum(jnp.asarray(sq.size, jnp.float32), "dp")
+        return total / count
+
+    def per_shard_step(params, x, y):
+        tiles = {"w1": params["w1"]["tiles"], "w2": params["w2"]["tiles"]}
+        idx = {"w1": params["w1"]["rows"], "w2": params["w2"]["cols"]}
+        loss, grads = jax.value_and_grad(per_shard_loss)(tiles, idx, x, y)
+        # tile grads are tp-local (weight sharding); dp needs an explicit mean
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        new_tiles = jax.tree.map(
+            lambda p, g: p - lr * g.astype(jnp.float32).astype(p.dtype),
+            tiles, grads)
+        return ({"w1": {"rows": idx["w1"], "tiles": new_tiles["w1"]},
+                 "w2": {"cols": idx["w2"], "tiles": new_tiles["w2"]}}, loss)
+
+    pspec = {"w1": {"rows": P("tp"), "tiles": P("tp")},
+             "w2": {"cols": P("tp"), "tiles": P("tp")}}
+    data_spec = P("dp", "tp")  # batch dp-sharded, seq tp-sharded (SP at rest)
+
+    step = jax.shard_map(
+        per_shard_step,
+        mesh=mesh,
+        in_specs=(pspec, data_spec, data_spec),
+        out_specs=(pspec, P()),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def shard_params(params, mesh: Mesh):
+    """Place params with their tp shardings (axis 0 of every weight array)."""
+    from jax.sharding import NamedSharding
+
+    spec = NamedSharding(mesh, P("tp"))
+    return jax.tree.map(lambda a: jax.device_put(a, spec), params)

@@ -1,0 +1,1 @@
+"""Device-side compute: u64 limb arithmetic, SpGEMM symbolic/numeric phases, Pallas kernels."""

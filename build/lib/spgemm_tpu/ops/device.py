@@ -1,0 +1,103 @@
+"""Device-resident block-sparse matrix: tiles live in HBM between multiplies.
+
+The reference round-trips every partial product through host maps — pack,
+H2D, kernel, D2H, unpack (sparse_matrix_mult.cu:189-269) — and its report
+attributes 27% of total time to those copies (BASELINE.md phase table).  The
+TPU-native design keeps tile data in HBM for the *entire* chain product:
+only block coordinates (tiny) live on host, because the symbolic phase
+(ops/symbolic.py) is host-side index arithmetic.  Tile values cross the
+PCIe/tunnel boundary exactly twice per job: input load and final write.
+
+Representation: (hi, lo) uint32 planes of shape (nnzb + 1, k, k) with an
+all-zero sentinel tile at index nnzb — the padding target the round planner
+(ops/symbolic.plan_rounds) points dead pair slots at.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spgemm_tpu.ops import u64
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+
+@dataclass
+class DeviceBlockMatrix:
+    """Block-sparse matrix with host coords and device-resident tile planes.
+
+    rows, cols : element dimensions (carried through, like the reference's).
+    k          : tile edge.
+    coords     : (nnzb, 2) int64 on HOST, sorted lexicographically.
+    hi, lo     : (nnzb + 1, k, k) uint32 on DEVICE; sentinel zero tile last.
+    """
+
+    rows: int
+    cols: int
+    k: int
+    coords: np.ndarray
+    hi: jax.Array
+    lo: jax.Array
+    # cached host materialization: repeated to_host (e.g. a partial carried
+    # unchanged across checkpointed chain passes) must not re-cross the
+    # device boundary
+    _host: "BlockSparseMatrix | None" = None
+    # inclusive upper bound on element values, when known (python int --
+    # may exceed 2^64 for propagated bounds).  None = unknown.  Drives the
+    # hybrid backend's proof that MXU field mode is bit-exact here
+    # (ops/mxu_spgemm.safe_exact_bound).
+    val_bound: "int | None" = None
+
+    @property
+    def nnzb(self) -> int:
+        return len(self.coords)
+
+    @classmethod
+    def from_host(cls, m: BlockSparseMatrix, device=None) -> "DeviceBlockMatrix":
+        """Upload a host matrix: one H2D of the (hi, lo) planes + sentinel.
+
+        device: explicit placement (e.g. per-rank devices in
+        parallel/chainpart.chain_product_on_devices); default placement
+        otherwise."""
+        from spgemm_tpu.ops.spgemm import pack_tiles  # noqa: PLC0415
+
+        hi, lo = pack_tiles(m, device=device)
+        bound = int(m.tiles.max()) if m.nnzb else 0
+        return cls(rows=m.rows, cols=m.cols, k=m.k, coords=m.coords,
+                   hi=hi, lo=lo, _host=m, val_bound=bound)
+
+    @classmethod
+    def empty(cls, rows: int, cols: int, k: int) -> "DeviceBlockMatrix":
+        zero = jnp.zeros((1, k, k), jnp.uint32)
+        return cls(rows=rows, cols=cols, k=k,
+                   coords=np.zeros((0, 2), np.int64), hi=zero, lo=zero,
+                   val_bound=0)
+
+    def to_host(self) -> BlockSparseMatrix:
+        """Fetch tiles to host (the one D2H of the pipeline) and reassemble."""
+        if self._host is None:
+            hi = np.asarray(self.hi[: self.nnzb])
+            lo = np.asarray(self.lo[: self.nnzb])
+            self._host = BlockSparseMatrix(
+                rows=self.rows, cols=self.cols, k=self.k,
+                coords=self.coords, tiles=u64.hilo_to_u64(hi, lo))
+        return self._host
+
+    def block_until_ready(self) -> "DeviceBlockMatrix":
+        """True completion barrier.
+
+        Some transports (the axon tunnel in this environment) acknowledge
+        jax.block_until_ready at enqueue time, before the device has executed
+        — so timing code must force a value fetch.  An 8-byte digest transfer
+        is the cheapest honest barrier.
+        """
+        _ = int(jnp.sum(self.hi[-1]) + jnp.sum(self.lo[-1])
+                + self.hi.ravel()[0] + self.lo.ravel()[0])
+        return self
+
+
+def ensure_device(m) -> DeviceBlockMatrix:
+    return DeviceBlockMatrix.from_host(m) if isinstance(m, BlockSparseMatrix) else m

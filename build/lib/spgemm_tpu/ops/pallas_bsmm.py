@@ -1,0 +1,106 @@
+"""Pallas block-sparse matmul for the float/MXU path (models/ffn).
+
+Dense activations x (M, d_in) times a column-major block-sparse weight
+(each output block-column owns `rpc` nonzero k x k tiles): the classic
+TPU block-sparse matmul -- grid (M tiles, output block-cols, pairs), the
+scalar-prefetched `rows` table steering which x block each step reads, a
+float32 VMEM scratch accumulator, and `jnp.dot` on the MXU per step.  This is
+the Pallas counterpart of models/ffn.bsmm_gather's gather-einsum, with the
+gather folded into the pipeline's DMAs (no (M, nbc, rpc, k) materialization).
+
+k = 128 tiles are MXU-native; any multiple of the dtype tile works.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(rows_ref, x_ref, w_ref, out_ref, acc_ref, *, rpc: int):
+    r = pl.program_id(2)
+
+    @pl.when(r == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(
+        x_ref[...], w_ref[0, 0],
+        preferred_element_type=jnp.float32)
+
+    @pl.when(r == rpc - 1)
+    def _flush():
+        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+
+
+@partial(jax.jit, static_argnames=("block_m", "interpret"))
+def bsmm_pallas(x, rows, tiles, *, block_m: int = 128, interpret=None):
+    """x (M, d_in) @ column-major block-sparse W -> (M, nbc * k).
+
+    rows  : (nbc, rpc) int32 -- nonzero input block-rows per output block-col.
+    tiles : (nbc, rpc, k, k) -- weight tiles, same dtype as x.
+    M must be a multiple of block_m; d_in a multiple of k.
+    """
+    M, d_in = x.shape
+    nbc, rpc, k, _ = tiles.shape
+    if M % block_m:
+        raise ValueError(f"M={M} not a multiple of block_m={block_m}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # rows
+        grid=(M // block_m, nbc, rpc),
+        in_specs=[
+            pl.BlockSpec((block_m, k), lambda m, c, r, rows: (m, rows[c, r])),
+            pl.BlockSpec((1, 1, k, k), lambda m, c, r, rows: (c, r, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, k), lambda m, c, r, rows: (m, c)),
+        scratch_shapes=[pltpu.VMEM((block_m, k), jnp.float32)],
+    )
+    return pl.pallas_call(
+        partial(_kernel, rpc=rpc),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((M, nbc * k), x.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(rows, x, tiles)
+
+
+def w2_to_column_major(cols, tiles, nb_out: int):
+    """Row-major W2 (each input block-row owns block-cols) -> column-major
+    (each output block-col owns block-rows), for the pallas forward path.
+
+    Column fan-in can be ragged; pads with an appended zero tile.  Host-side,
+    done once per weight."""
+    import numpy as np
+
+    cols_np = np.asarray(cols)
+    tiles_np = np.asarray(tiles)
+    nbr, cpc, k, _ = tiles_np.shape
+    fan = np.zeros(nb_out, np.int64)
+    for r in range(nbr):
+        for c in cols_np[r]:
+            fan[c] += 1
+    rpc = max(1, int(fan.max()))
+    # index of an all-zero pad tile appended at flat slot nbr*cpc
+    flat_tiles = np.concatenate(
+        [tiles_np.reshape(nbr * cpc, k, k),
+         np.zeros((1, k, k), tiles_np.dtype)], axis=0)
+    rows_out = np.zeros((nb_out, rpc), np.int32)       # x block-row to read
+    tile_idx = np.full((nb_out, rpc), nbr * cpc, np.int64)  # pad tile default
+    fill = np.zeros(nb_out, np.int64)
+    for r in range(nbr):
+        for ci, c in enumerate(cols_np[r]):
+            slot = fill[c]
+            rows_out[c, slot] = r
+            tile_idx[c, slot] = r * cpc + ci
+            fill[c] += 1
+    tiles_out = flat_tiles[tile_idx]                   # (nb_out, rpc, k, k)
+    return jnp.asarray(rows_out), jnp.asarray(tiles_out)

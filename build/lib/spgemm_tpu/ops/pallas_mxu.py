@@ -1,0 +1,218 @@
+"""Pallas-grid MXU limb kernel: field-mode SpGEMM at systolic-array rates.
+
+The XLA limb path (ops/mxu_spgemm.py) proves the method -- exact uint64
+arithmetic mod (2^64-1) via 7-bit limb convolutions computed as one batched
+int8 matmul -- but XLA lowers the per-key batched matmuls at ~250 us each,
+~11x below the reference kernel's throughput (round-2 VERDICT #1).  This
+kernel is the same arithmetic placed directly on the MXU by a Pallas grid:
+
+  * grid = (keys, pair_blocks): scalar-prefetched pair indices pa/pb drive
+    the BlockSpec index maps, exactly like the VPU exact kernel
+    (ops/pallas_spgemm.py) -- tiles stream HBM -> VMEM per step with no
+    host packing;
+  * each step loads R tile pairs, splits them into N_LIMBS=10 planes of
+    7 bits IN-KERNEL (VPU shifts/masks -- no 2.5x HBM blowup from
+    precomputed limb slabs), lays them out as one (10k, R*k) x (R*k, 10k)
+    bf16 matmul, and accumulates the f32 MXU product into an int32 VMEM
+    scratch.  bf16 holds 0..127 exactly (8-bit mantissa) and each f32 dot
+    entry is <= 127^2 * R*k < 2^24, so every step is exact; the int32
+    scratch is exact for 127^2 * P*k < 2^31 (P*k <= 2^17, enforced by the
+    caller -- same bound as the XLA path);
+  * on the last pair block, a VPU epilogue splits every limb-product block
+    into 16-bit pieces at its 2^(7d mod 64) weight (2^64 === 1 mod 2^64-1)
+    and sums them into EIGHT carry-free uint32 limb planes (each sum stays
+    < ~2^22: no wraps, no carry compares) written as the kernel output; the
+    final normalize / pack / mod-(2^64-1) fold runs OUTSIDE the kernel as
+    plain vectorized XLA over all keys.
+
+The split point is deliberate: composing the carry-normalize + 32-bit pack
+stages after the piece sums inside one Mosaic kernel miscompiles on this
+toolchain (each stage is bit-exact in isolation and the composition is not
+-- an empirically bisected Mosaic codegen instability; see
+tests/test_pallas_mxu.py for the pinned regression).  The carry-free piece
+sums are the verified-good graph, so the kernel ends there.
+
+Semantics: clean mod-(2^64-1) "field mode" (associative); bit-exact vs the
+reference's wrap-then-mod fold whenever the hybrid dispatcher's
+safe_exact_bound proof holds (ops/mxu_spgemm.py docstring).
+
+Reference equivalent: matrix_multiplyKernel (sparse_matrix_mult.cu:44-66),
+the reference's perf-critical component.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from spgemm_tpu.ops import u64
+from spgemm_tpu.ops.mxu_spgemm import N_LIMBS
+
+_M32_U32 = jnp.uint32(0xFFFFFFFF)
+
+
+def _limb_planes_bf16(hi, lo, n_limbs: int = N_LIMBS):
+    """n_limbs bf16 planes of 7 bits each -- mxu_spgemm.limbs7, bf16 cast."""
+    from spgemm_tpu.ops.mxu_spgemm import limbs7  # noqa: PLC0415
+
+    return limbs7(hi, lo, n_limbs, jnp.bfloat16)
+
+
+def _piece_sums(S, k: int, la_limbs: int = N_LIMBS, lb_limbs: int = N_LIMBS):
+    """(La*k, Lb*k) int32 limb products -> 8 carry-free uint32 limb planes.
+
+    Every (la, lb) block carries weight 2^(7(la+lb) mod 64) (2^64 === 1 mod
+    2^64-1).  Each block value s < 2^31 splits into 16-bit pieces at its
+    weight's (q, r) = divmod(sh, 16) position; piece sums accumulate in
+    uint32 with no possible wrap (300 pieces x 2^16 < 2^26), so the graph
+    contains no carry compares -- the part of the fold Mosaic compiles
+    correctly (see module docstring).
+    """
+    M16 = jnp.uint32(0xFFFF)
+    limbs = [jnp.zeros((k, k), jnp.uint32) for _ in range(8)]
+    for la in range(la_limbs):
+        for lb in range(lb_limbs):
+            sh = 7 * (la + lb)
+            if sh >= 64:
+                sh -= 64  # 2^64 === 1 (mod 2^64-1)
+            q, r = divmod(sh, 16)
+            s = S[la * k:(la + 1) * k, lb * k:(lb + 1) * k].astype(jnp.uint32)
+            limbs[q] = limbs[q] + ((s << r) & M16)
+            if r == 0:
+                limbs[q + 1] = limbs[q + 1] + (s >> 16)
+            else:
+                limbs[q + 1] = limbs[q + 1] + ((s >> (16 - r)) & M16)
+                limbs[q + 2] = limbs[q + 2] + (s >> (32 - r))
+    return limbs
+
+
+def fold_piece_sums(limbs):
+    """8 carry-free uint32 16-bit-piece sums -> (hi, lo) mod (2^64-1).
+
+    Vectorized XLA post-pass (any leading batch shape): one carry-normalize
+    sweep, pack into four u32 words, fold hi64 + lo64 (2^64 === 1).
+    """
+    M16 = jnp.uint32(0xFFFF)
+    limbs = list(limbs)
+    for i in range(7):
+        limbs[i + 1] = limbs[i + 1] + (limbs[i] >> 16)
+        limbs[i] = limbs[i] & M16
+    acc = [limbs[2 * j] | (limbs[2 * j + 1] << 16) for j in range(4)]
+    return u64.addmod_field(acc[3], acc[2], acc[1], acc[0])
+
+
+def _kernel(pa_ref, pb_ref, *refs, k: int, R: int, blocks: int,
+            La: int, Lb: int):
+    # refs layout: ah x R, al x R, bh x R, bl x R, out_limbs | scratch
+    ahs = [r[0] for r in refs[0 * R:1 * R]]            # each (k, k) uint32
+    als = [r[0] for r in refs[1 * R:2 * R]]
+    bhs = [r[0] for r in refs[2 * R:3 * R]]
+    bls = [r[0] for r in refs[3 * R:4 * R]]
+    out_ref = refs[4 * R]                              # (1, 8, k, k) uint32
+    acc_ref = refs[4 * R + 1]                          # (La*k, Lb*k) int32 VMEM
+
+    pb = pl.program_id(1)
+
+    # A limbs: plane la is (i, j) -> rows (la, i); R pairs side by side in j.
+    a_cat = jnp.concatenate(
+        [jnp.concatenate(_limb_planes_bf16(h, l, La), axis=0)   # (La*k, k)
+         for h, l in zip(ahs, als)], axis=1)                    # (La*k, R*k)
+    # B limbs: plane lb is (j, n) -> cols (lb, n); R pairs stacked in j.
+    b_cat = jnp.concatenate(
+        [jnp.concatenate(_limb_planes_bf16(h, l, Lb), axis=1)   # (k, Lb*k)
+         for h, l in zip(bhs, bls)], axis=0)                    # (R*k, Lb*k)
+
+    # The MXU step: every one of the La*Lb limb-pair blocks in one dot.
+    s = jax.lax.dot_general(a_cat, b_cat, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(pb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += s.astype(jnp.int32)
+
+    @pl.when(pb == blocks - 1)
+    def _done():
+        limbs = _piece_sums(acc_ref[...], k, La, Lb)
+        for i in range(8):
+            out_ref[0, i] = limbs[i]
+
+
+def limbs_for_bound(val_bound: int | None) -> int:
+    """Limbs needed to represent values <= val_bound (7 bits per limb)."""
+    if val_bound is None:
+        return N_LIMBS
+    return min(N_LIMBS, max(1, -(-int(val_bound).bit_length() // 7)))
+
+
+@partial(jax.jit, static_argnames=("interpret", "a_limbs", "b_limbs"))
+def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
+                             a_limbs: int = N_LIMBS, b_limbs: int = N_LIMBS):
+    """Same contract as ops.spgemm.numeric_round_impl, field-mode semantics.
+
+    a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
+    pa, pb  : (K, P) int32 slab indices, sentinel-padded (zero tiles
+              contribute exactly 0 in field mode).
+    a_limbs/b_limbs: per-operand limb counts (limbs_for_bound of the proven
+              value bound) -- 32-bit-bounded operands need 5x5 limb blocks
+              instead of 10x10, a 4x cut in dot flops and epilogue work.
+    Returns (out_hi, out_lo): (K, k, k) uint32, residues mod 2^64-1.
+    """
+    K, P = pa.shape
+    k = a_hi.shape[-1]
+    La, Lb = a_limbs, b_limbs
+    if P * k > 1 << 17:
+        raise ValueError(f"P*k = {P * k} exceeds the int32-exact bound 2^17")
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    # pair-block width: R*k is the MXU contraction size; 127^2 * R*k < 2^24
+    # keeps each f32 dot exact (R*k <= 1024)
+    R = max(1, min(8, P, 1024 // max(k, 1)))
+    P_pad = -(-P // R) * R
+    if P_pad != P:
+        a_sent = jnp.int32(a_hi.shape[0] - 1)
+        b_sent = jnp.int32(b_hi.shape[0] - 1)
+        pa = jnp.concatenate(
+            [pa, jnp.full((K, P_pad - P), a_sent, jnp.int32)], axis=1)
+        pb = jnp.concatenate(
+            [pb, jnp.full((K, P_pad - P), b_sent, jnp.int32)], axis=1)
+    blocks = P_pad // R
+
+    def a_map(r):
+        return lambda kk, pblk, pa, pb: (pa[kk, pblk * R + r], 0, 0)
+
+    def b_map(r):
+        return lambda kk, pblk, pa, pb: (pb[kk, pblk * R + r], 0, 0)
+
+    tile_spec_a = [pl.BlockSpec((1, k, k), a_map(r)) for r in range(R)]
+    tile_spec_b = [pl.BlockSpec((1, k, k), b_map(r)) for r in range(R)]
+    out_spec = pl.BlockSpec((1, 8, k, k), lambda kk, pblk, pa, pb: (kk, 0, 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # pa, pb
+        grid=(K, blocks),
+        in_specs=tile_spec_a + tile_spec_a + tile_spec_b + tile_spec_b,
+        out_specs=[out_spec],
+        scratch_shapes=[pltpu.VMEM((La * k, Lb * k), jnp.int32)],
+    )
+    out_shape = [jax.ShapeDtypeStruct((K, 8, k, k), jnp.uint32)]
+    (limb_sums,) = pl.pallas_call(
+        partial(_kernel, k=k, R=R, blocks=blocks, La=La, Lb=Lb),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            # pair axis must be sequential (scratch accumulation); the key
+            # axis revisits the scratch too, so both stay "arbitrary"
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )(pa, pb,
+      *([a_hi] * R), *([a_lo] * R), *([b_hi] * R), *([b_lo] * R))
+    # final fold outside the kernel (see module docstring), batched over keys
+    return fold_piece_sums([limb_sums[:, i] for i in range(8)])

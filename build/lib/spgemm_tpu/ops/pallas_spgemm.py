@@ -1,0 +1,214 @@
+"""Pallas TPU kernel for the SpGEMM numeric phase (L1 -- the reference's C7).
+
+The reference's CUDA kernel (matrix_multiplyKernel, sparse_matrix_mult.cu:44-66)
+launches one thread block per output tile with k x k threads, each thread
+folding its pair list sequentially.  The TPU-native shape of the same work:
+
+  * grid = (key_groups, max_pairs): the pair axis is the innermost grid
+    dimension, and TPU grids execute sequentially, so each output tile's
+    pairs accumulate in exactly the reference's order (SURVEY.md section 2.9
+    -- the arithmetic is non-associative, so this ordering is load-bearing).
+  * scalar-prefetched index arrays pa/pb drive the BlockSpec index_maps:
+    the pipeline DMAs exactly the (A, B) tile pairs each step needs from HBM
+    into VMEM -- the TPU equivalent of the reference's host-side pack+H2D
+    staging (sparse_matrix_mult.cu:189-238), with zero host involvement.
+  * lane packing: a k x k tile only fills k of the VPU's 128 lanes, so each
+    grid step processes a GROUP of G = min(16, 512 // k) output tiles side
+    by side in a (k, G*k) accumulator (512 lanes at k = 32) -- wider groups
+    amortize per-grid-step overhead, measured ~10% over G = 4.
+  * the k x k tile contraction is k unrolled VPU steps of (hi, lo) uint32
+    limb arithmetic (ops/u64.py) -- TPUs have no native u64, and the MXU
+    cannot do exact wrap-then-mod integer arithmetic, so this is VPU work
+    by design (SURVEY.md section 7).
+  * the output block revisits the same VMEM buffer across the pair axis
+    (accumulator-in-output pattern); it is initialized at pair 0.
+
+Sentinel pairs (padding) index an all-zero tile, contributing exactly 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from spgemm_tpu.ops import u64
+
+
+def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str):
+    # refs layout: ah x G, al x G, bh x G, bl x G, out_hi, out_lo
+    ahs = [r[0] for r in refs[0 * G : 1 * G]]          # each (k, k) uint32
+    als = [r[0] for r in refs[1 * G : 2 * G]]
+    bhs = [r[0] for r in refs[2 * G : 3 * G]]
+    bls = [r[0] for r in refs[3 * G : 4 * G]]
+    out_hi_ref, out_lo_ref = refs[4 * G], refs[4 * G + 1]
+
+    pair = pl.program_id(1)
+
+    @pl.when(pair == 0)
+    def _init():
+        out_hi_ref[...] = jnp.zeros_like(out_hi_ref)
+        out_lo_ref[...] = jnp.zeros_like(out_lo_ref)
+
+    acc_h = out_hi_ref[0]                              # (k, G*k)
+    acc_l = out_lo_ref[0]
+
+    if algo == "colbcast":
+        # B rows pack once per step: group tiles side by side along lanes.
+        bh_cat = jnp.concatenate(bhs, axis=1)          # (k, G*k)
+        bl_cat = jnp.concatenate(bls, axis=1)
+
+        # The reference's j-loop (sparse_matrix_mult.cu:56-62), unrolled (k
+        # is static): fold the outer product of A's column j with B's row j.
+        for j in range(k):
+            a_h = jnp.concatenate(
+                [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in ahs], axis=1)
+            a_l = jnp.concatenate(
+                [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in als], axis=1)
+            b_h = jnp.broadcast_to(bh_cat[j : j + 1, :], (k, G * k))
+            b_l = jnp.broadcast_to(bl_cat[j : j + 1, :], (k, G * k))
+            acc_h, acc_l = u64.mac(acc_h, acc_l, a_h, a_l, b_h, b_l)
+    elif algo == "vecj":
+        # Vectorized-j layout: compute a BLOCK of j's products at once in a
+        # ((j, i) sublanes, (g, n) lanes) arrangement, then fold the j axis
+        # with cheap sublane slices.  The colbcast variant runs 2*G*k
+        # lane-extract+broadcast ops per step (A's column j per key per
+        # plane) -- the dominant instruction count; here A is transposed
+        # once per tile and every per-j access is a sublane slice.  The j
+        # axis is chunked (JB) so the six (JB*k, G*k) uint32 intermediates
+        # plus mulmod's limb temporaries stay well under VMEM (~3 MB at
+        # k=32, G=16, JB=8, vs ~12+ MB unchunked).  The mod fold stays
+        # sequential over j (SURVEY.md 2.9).
+        # (JB*k, G*k) uint32 <= 512 KB per intermediate
+        JB = max(1, min(k, 131072 // (k * G * k)))
+        ats_h = [t.T for t in ahs]                     # (j, i), once per tile
+        ats_l = [t.T for t in als]
+
+        def expand_a(at, j0):
+            c = at[j0:j0 + JB]                         # (JB, i) sublane slice
+            return jnp.broadcast_to(c[:, :, None], (JB, k, k)).reshape(JB * k, k)
+
+        def expand_b(t, j0):
+            c = t[j0:j0 + JB]                          # (JB, n) sublane slice
+            return jnp.broadcast_to(c[:, None, :], (JB, k, k)).reshape(JB * k, k)
+
+        for j0 in range(0, k, JB):
+            a_h = jnp.concatenate([expand_a(t, j0) for t in ats_h], axis=1)
+            a_l = jnp.concatenate([expand_a(t, j0) for t in ats_l], axis=1)
+            b_h = jnp.concatenate([expand_b(t, j0) for t in bhs], axis=1)
+            b_l = jnp.concatenate([expand_b(t, j0) for t in bls], axis=1)
+            prod_h, prod_l = u64.mulmod(a_h, a_l, b_h, b_l)  # (JB*k, G*k)
+            for jj in range(min(JB, k - j0)):
+                acc_h, acc_l = u64.addmod(
+                    acc_h, acc_l,
+                    prod_h[jj * k:(jj + 1) * k, :], prod_l[jj * k:(jj + 1) * k, :])
+    else:
+        raise ValueError(f"unknown algo {algo!r}")
+
+    out_hi_ref[0] = acc_h
+    out_lo_ref[0] = acc_l
+
+
+def resolve_group(k: int, K: int, group: int | None = None) -> int:
+    """The key-group width G the kernel will actually run.
+
+    Default 16, bounded by 512 accumulator lanes (1024 for an explicit
+    override) and by K.  Exposed so benchmark labels report the RESOLVED
+    width, not the requested one (they differ when lane caps clamp)."""
+    lane_cap = 1024 if group else 512
+    return max(1, min(group or 16, lane_cap // k, K))
+
+
+@partial(jax.jit, static_argnames=("interpret", "algo", "group"))
+def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
+                         algo: str = "colbcast", group: int | None = None):
+    """Same contract as ops.spgemm.numeric_round_impl, as a Pallas kernel.
+
+    a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
+    pa, pb  : (K, P) int32 slab indices, per-key j-ascending, sentinel-padded.
+    group   : override the key-group width G (benchmarks/kernel_sweep.py
+              measures the ladder; default below is the tuned value).
+    Returns (out_hi, out_lo): (K, k, k) uint32.
+    """
+    K, P = pa.shape
+    k = a_hi.shape[-1]
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    # group width: wider groups amortize per-grid-step overhead (~10% win
+    # from G=4 to G=16 at k=32, measured); bounded by the accumulator lane
+    # cap and 4*G input refs per step
+    G = resolve_group(k, K, group)
+    K_pad = -(-K // G) * G
+    if K_pad != K:
+        pad = ((0, K_pad - K), (0, 0))
+        a_sent = jnp.int32(a_hi.shape[0] - 1)
+        b_sent = jnp.int32(b_hi.shape[0] - 1)
+        pa = jnp.concatenate(
+            [pa, jnp.full((K_pad - K, P), a_sent, jnp.int32)], axis=0)
+        pb = jnp.concatenate(
+            [pb, jnp.full((K_pad - K, P), b_sent, jnp.int32)], axis=0)
+    KG = K_pad // G
+
+    # Prefetch arrays are SMEM-resident, lane-padded to 128 in the last
+    # dimension and sublane-padded to 8 in the first: ship whichever
+    # orientation has the smaller footprint (normally (P, K) -- the long key
+    # axis rides the lane padding; for huge fanout classes P > K the
+    # untransposed (K, P) wins).
+    def pad8(x):
+        return -(-x // 8) * 8
+
+    transpose = pad8(P) * max(K_pad, 128) <= pad8(K_pad) * max(P, 128)
+    if transpose:
+        pa_t, pb_t = pa.T, pb.T
+
+        def a_map(g):
+            return lambda kg, p, pa, pb: (pa[p, kg * G + g], 0, 0)
+
+        def b_map(g):
+            return lambda kg, p, pa, pb: (pb[p, kg * G + g], 0, 0)
+    else:
+        pa_t, pb_t = pa, pb
+
+        def a_map(g):
+            return lambda kg, p, pa, pb: (pa[kg * G + g, p], 0, 0)
+
+        def b_map(g):
+            return lambda kg, p, pa, pb: (pb[kg * G + g, p], 0, 0)
+
+    tile_spec_a = [pl.BlockSpec((1, k, k), a_map(g)) for g in range(G)]
+    tile_spec_b = [pl.BlockSpec((1, k, k), b_map(g)) for g in range(G)]
+    out_spec = pl.BlockSpec((1, k, G * k), lambda kg, p, pa, pb: (kg, 0, 0))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # pa, pb
+        grid=(KG, P),
+        in_specs=tile_spec_a + tile_spec_a + tile_spec_b + tile_spec_b,
+        out_specs=[out_spec, out_spec],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((KG, k, G * k), jnp.uint32),
+        jax.ShapeDtypeStruct((KG, k, G * k), jnp.uint32),
+    ]
+    packed_hi, packed_lo = pl.pallas_call(
+        partial(_kernel, k=k, G=G, algo=algo),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),  # sequential: order matters
+        ),
+    )(pa_t, pb_t,
+      *([a_hi] * G), *([a_lo] * G), *([b_hi] * G), *([b_lo] * G))
+
+    def unpack(x):
+        # (KG, ty, g*k+tx) -> (K, ty, tx)
+        return (x.reshape(KG, k, G, k)
+                 .transpose(0, 2, 1, 3)
+                 .reshape(K_pad, k, k)[:K])
+
+    return unpack(packed_hi), unpack(packed_lo)

@@ -1,0 +1,282 @@
+"""SpGEMM engine: the TPU-native equivalent of the reference's helper() (L2).
+
+Two phases, mirroring the reference's design but not its data movement:
+
+  1. symbolic (host, ops/symbolic.py): sorted merge-join -> output structure +
+     fixed-shape index rounds.  The reference's equivalent is its hash-map join
+     plus the 8 GB host staging copy (sparse_matrix_mult.cu:141-226); here no
+     tile is ever copied on host -- tiles live in HBM and the numeric phase
+     gathers them by index.
+  2. numeric (device, this file): for each round, gather (A, B) tile pairs and
+     fold them into output tiles with the exact wrap-then-mod u64 arithmetic
+     of SURVEY.md section 2.9, sequential over (pair, j) to preserve the
+     reference's accumulation order (matrix_multiplyKernel,
+     sparse_matrix_mult.cu:44-66).
+
+The XLA path below is the always-available implementation; ops/pallas_spgemm.py
+provides the Pallas TPU kernel for the same contract (selected via backend=).
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spgemm_tpu.ops import u64
+from spgemm_tpu.ops.symbolic import plan_rounds, symbolic_join
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+log = logging.getLogger("spgemm_tpu.spgemm")
+
+
+def pack_tiles(m: BlockSparseMatrix, device=None):
+    """Tile slab -> device (hi, lo) uint32 planes with an all-zero sentinel
+    tile appended at index nnzb (padding target for the round planner).
+
+    device: target placement -- a direct host->device transfer (the default
+    placement otherwise; an explicit non-default device must NOT stage
+    through device 0)."""
+    k = m.k
+    slab = np.concatenate([m.tiles, np.zeros((1, k, k), np.uint64)], axis=0)
+    hi, lo = u64.u64_to_hilo(slab)
+    if device is not None:
+        return jax.device_put(hi, device), jax.device_put(lo, device)
+    return jnp.asarray(hi), jnp.asarray(lo)
+
+
+def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
+    """One fixed-shape numeric round (unjitted impl -- wrapped by _numeric_round
+    and by parallel/rowshard's shard_map).
+
+    a_*/b_* : (nnzb + 1, k, k) uint32 tile slabs (sentinel zero tile last).
+    pa, pb  : (K, P) int32 slab indices; per-key pair lists in j-ascending
+              order, padded with the sentinel.
+    Returns (out_hi, out_lo): (K, k, k) uint32.
+
+    The fold runs sequentially over the flattened (pair, j) axis -- P*k steps
+    of vectorized (K, k, k) limb arithmetic -- because addmod is not
+    associative (SURVEY.md section 2.9).  Sentinel pairs contribute exactly 0.
+    """
+    K, P = pa.shape
+    k = a_hi.shape[-1]
+
+    ah, al = a_hi[pa], a_lo[pa]  # (K, P, k, k)
+    bh, bl = b_hi[pb], b_lo[pb]
+
+    # Walk order: for pair p, for j in 0..k-1.  The pair axis is a fori_loop
+    # (dynamic-index slice per step); the j fold is unrolled (k is static), so
+    # each loop body is ~k fused vector MACs instead of one.
+    ath = jnp.transpose(ah, (1, 0, 2, 3))  # (P, K, ty, j)
+    atl = jnp.transpose(al, (1, 0, 2, 3))
+    bth = jnp.transpose(bh, (1, 0, 2, 3))  # (P, K, j, tx)
+    btl = jnp.transpose(bl, (1, 0, 2, 3))
+
+    def body(p, acc):
+        acc_h, acc_l = acc
+        pah, pal = ath[p], atl[p]  # (K, k, k)
+        pbh, pbl = bth[p], btl[p]
+        for j in range(k):
+            acc_h, acc_l = u64.mac(
+                acc_h, acc_l,
+                pah[:, :, j : j + 1], pal[:, :, j : j + 1],
+                pbh[:, j : j + 1, :], pbl[:, j : j + 1, :],
+            )
+        return acc_h, acc_l
+
+    zero = jnp.zeros((K, k, k), jnp.uint32)
+    out_h, out_l = jax.lax.fori_loop(0, P, body, (zero, zero))
+    return out_h, out_l
+
+
+_numeric_round = jax.jit(numeric_round_impl)
+
+
+def resolve_backend(backend: str | None) -> str:
+    """None -> 'pallas' on TPU, 'xla' elsewhere (the Pallas kernel runs in
+    interpret mode on CPU, which is correct but slow -- tests opt in).
+
+    Other values: 'mxu' = field-mode limb matmul on the systolic array
+    (clean mod-(2^64-1) semantics, ops/pallas_mxu.py on TPU); 'hybrid' =
+    per-ROUND choice within each multiply -- fanout classes whose
+    bit-exactness proof holds run 'mxu', the rest run the exact kernel, and
+    the mixed result is always reference-bit-exact."""
+    if backend is not None:
+        return backend
+    return "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+
+
+def _select_numeric(backend: str, a, b):
+    """Resolve a concrete backend name to (numeric_fn, max_entries,
+    default_round_size) for operands a, b (their val_bounds parameterize
+    the MXU limb grids)."""
+    if backend == "pallas":
+        import os  # noqa: PLC0415
+
+        from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas  # noqa: PLC0415
+
+        # manual A/B hook: SPGEMM_TPU_VPU_ALGO=vecj runs the whole engine
+        # (CLI, bench) on the alternate kernel layout; default is the tuned
+        # one.  jit caches per static algo value, so this costs nothing.
+        numeric = partial(numeric_round_pallas,
+                          algo=os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast"))
+        # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
+        # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to 8),
+        # not by gather materialization: merge key chunks into fewer, bigger
+        # launches.  An explicit round_size still caps the key axis.
+        return numeric, 64 * 1024, 8192
+    if backend == "xla":
+        return _numeric_round, None, 512
+    if backend == "mxu":
+        # Pallas-grid MXU limb kernel on TPU (ops/pallas_mxu.py); the XLA
+        # batched-matmul formulation elsewhere (it is the better CPU lowering
+        # and the cross-check oracle for the kernel).
+        if jax.devices()[0].platform == "tpu":
+            from spgemm_tpu.ops.pallas_mxu import (  # noqa: PLC0415
+                limbs_for_bound, numeric_round_mxu_pallas)
+
+            # proven value bounds shrink the limb grid (5x5 for 32-bit
+            # values vs 10x10 unbounded): 4x less dot + epilogue work
+            numeric = partial(numeric_round_mxu_pallas,
+                              a_limbs=limbs_for_bound(a.val_bound),
+                              b_limbs=limbs_for_bound(b.val_bound))
+            return numeric, 64 * 1024, 8192
+        from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu  # noqa: PLC0415
+
+        return numeric_round_mxu, None, 512
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def spgemm_device(a, b, *, round_size: int | None = None,
+                  backend: str | None = None):
+    """C = A x B with reference-exact semantics, tiles staying in HBM.
+
+    a, b: DeviceBlockMatrix (or host BlockSparseMatrix -- uploaded on entry).
+    Returns a DeviceBlockMatrix; no tile data crosses the device boundary,
+    which inverts the reference's pack/H2D/D2H round-trip per multiply
+    (sparse_matrix_mult.cu:189-269, 27% of its report's total time).
+    """
+    from spgemm_tpu.ops.device import DeviceBlockMatrix, ensure_device  # noqa: PLC0415
+
+    from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
+
+    a = ensure_device(a)
+    b = ensure_device(b)
+    if a.k != b.k:
+        raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
+    k = a.k
+    with timers.phase("symbolic_join"):
+        join = symbolic_join(a.coords, b.coords)
+    if join.num_keys == 0:
+        return DeviceBlockMatrix.empty(a.rows, b.cols, k)
+
+    backend = resolve_backend(backend)
+    out_bound = (1 << 64) - 2  # any backend's outputs are mod-collapsed
+    choose_numeric = None  # per-round dispatcher (hybrid only)
+    if backend == "hybrid":
+        # Per-ROUND dispatch: rounds are bucketed by fanout class
+        # (plan_rounds) and the bit-exactness proof depends on the fanout,
+        # so each round independently runs MXU field mode when provably
+        # equal to the reference fold (no product or partial sum can reach
+        # 2^64-1 at that fanout) and the exact VPU/XLA kernel otherwise.
+        # One huge-fanout key no longer forces the whole multiply off the
+        # MXU.  Every key is computed whole by one kernel, so the mixed
+        # result is bit-exact regardless of the split.
+        from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
+
+        exact_name = resolve_backend(None)
+        numeric_exact, max_entries, default_rs = _select_numeric(exact_name, a, b)
+        numeric_mxu, mxu_entries, _ = _select_numeric("mxu", a, b)
+        # plan under the tighter budget so both kernels accept every round
+        if mxu_entries is not None and (max_entries is None
+                                        or mxu_entries < max_entries):
+            max_entries = mxu_entries
+        bounds_ok = a.val_bound is not None and b.val_bound is not None
+
+        def choose_numeric(rnd):  # noqa: F811 -- the hybrid dispatcher
+            # proof at the round's REAL max fanout (padded sentinel pairs
+            # contribute exactly 0); the padded width only gates the MXU
+            # kernel's own int32-accumulator check (P*k <= 2^17)
+            if (not bounds_ok or rnd.pa.shape[1] * k > 1 << 17
+                    or safe_exact_bound(a.val_bound, b.val_bound,
+                                        rnd.max_fanout, k) is None):
+                return numeric_exact, False
+            return numeric_mxu, True
+
+        numeric = numeric_exact  # placeholder; per-round choice below
+    else:
+        numeric, max_entries, default_rs = _select_numeric(backend, a, b)
+    round_size = default_rs if round_size is None else round_size
+
+    with timers.phase("plan_rounds"):
+        rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+                             round_size=round_size, max_entries=max_entries)
+
+    # All rounds dispatch asynchronously; outputs are assembled into one
+    # key-ordered slab on device (concat + gather), never touching host.
+    # Timed phases are host-side spans (dispatch, not device completion --
+    # the device tail is the caller's block_until_ready); the reference's
+    # Table-2 analog phases are symbolic_join / plan_rounds /
+    # numeric_dispatch / assembly.
+    mxu_rounds = 0
+    with timers.phase("numeric_dispatch"):
+        outs_h, outs_l, order = [], [], []
+        for rnd in rounds:
+            fn = numeric
+            if choose_numeric is not None:
+                fn, used_mxu = choose_numeric(rnd)
+                mxu_rounds += used_mxu
+            oh, ol = fn(a.hi, a.lo, b.hi, b.lo,
+                        jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
+            n_valid = len(rnd.key_index)
+            outs_h.append(oh[:n_valid])
+            outs_l.append(ol[:n_valid])
+            order.append(rnd.key_index)
+
+    # inv[key] = position of that key in the concatenated round outputs;
+    # the extra last entry maps the sentinel slot to the appended zero tile.
+    with timers.phase("assembly"):
+        cat_idx = np.concatenate(order)
+        inv = np.empty(join.num_keys + 1, np.int64)
+        inv[cat_idx] = np.arange(len(cat_idx))
+        inv[-1] = len(cat_idx)
+        take = jnp.asarray(inv)
+        zero = jnp.zeros((1, k, k), jnp.uint32)
+        out_hi = jnp.concatenate(outs_h + [zero], axis=0)[take]
+        out_lo = jnp.concatenate(outs_l + [zero], axis=0)[take]
+
+    # structured observability (SURVEY.md section 5.5): size, fill-in, work
+    total_pairs = int(join.pair_ptr[-1])
+    tag = backend
+    if choose_numeric is not None:
+        tag = f"hybrid mxu={mxu_rounds}/{len(rounds)}"
+        if mxu_rounds == len(rounds):
+            # every round ran under a proof: the tighter propagated bound
+            # feeds the NEXT multiply's proof (chain products stay on the
+            # MXU as long as the bounds hold); safe_exact_bound is already
+            # in scope from the hybrid branch above
+            proven = safe_exact_bound(a.val_bound, b.val_bound,
+                                      int(join.fanouts.max()), k)
+            if proven is not None:
+                out_bound = proven
+    log.info("spgemm[%s]: nnzb %d x %d -> keys=%d pairs=%d rounds=%d work=%.3f GFLOP",
+             tag, a.nnzb, b.nnzb, join.num_keys, total_pairs, len(rounds),
+             2.0 * total_pairs * k ** 3 / 1e9)
+
+    return DeviceBlockMatrix(rows=a.rows, cols=b.cols, k=k,
+                             coords=join.keys, hi=out_hi, lo=out_lo,
+                             val_bound=min(out_bound, (1 << 64) - 2))
+
+
+def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
+           round_size: int | None = None,
+           backend: str | None = None) -> BlockSparseMatrix:
+    """C = A x B with reference-exact semantics, host-to-host.  Result keeps
+    all-zero output tiles (pruning happens only at final output,
+    sparse_matrix_mult.cu:577-592) and carries rows=a.rows, cols=b.cols
+    (:281-282).  One fused D2H at the end; use spgemm_device to chain
+    multiplies without leaving HBM."""
+    return spgemm_device(a, b, round_size=round_size, backend=backend).to_host()

@@ -1,0 +1,260 @@
+"""Symbolic phase: output-structure join + round bucketing (C5, C6 -- host side).
+
+The reference builds `m2_index: rowB -> [colsB]` then joins A's blocks against
+it with hash maps (sparse_matrix_mult.cu:141-156), producing per-output-tile
+lists of inner block coordinates; the round packer (:167-226) then memcpys
+tile pairs into an 8 GB staging buffer in rounds of <= 500 output keys.
+
+Here the join is a vectorized sorted merge-join over the (already sorted)
+block-coordinate arrays -- O(nnzb + pairs) numpy, no hashing -- and "packing"
+is just index arithmetic: the numeric phase gathers tiles in HBM by index, so
+no staging copy exists.  Rounds become fixed-shape (num_keys, max_pairs)
+buckets, padded with a sentinel index that points at an all-zero tile
+(mulmod(0, x) == 0 and addmod(acc, 0) == acc, so padding is exact) -- this is
+how dynamic sparsity meets XLA's static shapes (SURVEY.md section 7).
+
+Ordering contract (parity-critical, SURVEY.md section 2.9): each output key's
+pair list is ordered by ascending inner block-coordinate j, which is exactly
+the order the reference's sorted-map traversal produces.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class JoinResult:
+    """Output structure of A x B, in CSR-over-sorted-keys form.
+
+    keys     : (num_keys, 2) int64, sorted lexicographically -- output tile coords.
+    pair_ptr : (num_keys + 1,) int64 -- segment boundaries into pair_a/pair_b.
+    pair_a   : (total_pairs,) int32 -- A tile slab indices, per key in j-ascending order.
+    pair_b   : (total_pairs,) int32 -- B tile slab indices, aligned with pair_a.
+    """
+
+    keys: np.ndarray
+    pair_ptr: np.ndarray
+    pair_a: np.ndarray
+    pair_b: np.ndarray
+
+    @property
+    def num_keys(self) -> int:
+        return len(self.keys)
+
+    @property
+    def fanouts(self) -> np.ndarray:
+        return np.diff(self.pair_ptr)
+
+
+def _segment_expand(counts: np.ndarray):
+    """Ragged expansion: for segments of the given lengths, return
+    (segment_id, within_segment_offset) arrays of total length counts.sum()."""
+    total = int(counts.sum())
+    seg_id = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    seg_start = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    offs = np.arange(total, dtype=np.int64) - np.repeat(seg_start, counts)
+    return seg_id, offs
+
+
+def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
+    """Structure join: which (A-tile, B-tile) pairs feed which output tile.
+
+    Both coord arrays must be lexicographically sorted by (row, col) --
+    the BlockSparseMatrix invariant.
+
+    Dispatches to the native C++ join (native/symbolic.cpp: searchsorted
+    ranges + stable LSD radix sort) when the library is available -- the
+    host runtime is native where the reference's is (its hash-join was "CPU
+    hot loop #1", SURVEY.md section 3.2).  The numpy path below is the
+    always-available fallback, kept bit-identical (tests cross-check).
+    """
+    from spgemm_tpu.utils import native  # noqa: PLC0415
+
+    # The native join fuses keys as uint64 row*span + col; beyond uint64's
+    # range that wraps, so dispatch to it only in the provably-safe regime
+    # (the numpy fallback below switches to a stable lexsort there).
+    native_safe = (
+        len(a_coords) == 0 or len(b_coords) == 0
+        or (int(a_coords[:, 0].max()) + 1) * (int(b_coords[:, 1].max()) + 1)
+        <= 1 << 64)
+    nat = native.symbolic_join_native(a_coords, b_coords) if native_safe else None
+    if nat is not None:
+        keys, pair_ptr, pair_a, pair_b = nat
+        return JoinResult(keys=keys, pair_ptr=pair_ptr,
+                          pair_a=pair_a, pair_b=pair_b)
+    empty = JoinResult(
+        keys=np.zeros((0, 2), np.int64),
+        pair_ptr=np.zeros(1, np.int64),
+        pair_a=np.zeros(0, np.int32),
+        pair_b=np.zeros(0, np.int32),
+    )
+    if len(a_coords) == 0 or len(b_coords) == 0:
+        return empty
+
+    b_rows = b_coords[:, 0]  # sorted (lex order on (row, col))
+    # For each A block (i, j): B blocks with row == j form the contiguous
+    # range [lo, hi) in the sorted B slab.
+    a_cols = a_coords[:, 1]
+    lo = np.searchsorted(b_rows, a_cols, side="left")
+    hi = np.searchsorted(b_rows, a_cols, side="right")
+    counts = hi - lo
+    total = int(counts.sum())
+    if total == 0:
+        return empty
+
+    # Segment-expand: pair stream in A-traversal order (sorted (i, j)), each A
+    # block contributing its B row-range in ascending-c order.
+    a_slot, offs = _segment_expand(counts)
+    b_slot = np.repeat(lo, counts) + offs
+
+    out_r = a_coords[a_slot, 0]
+    out_c = b_coords[b_slot, 1]
+
+    # Stable sort by output key: within a key, the stream order is ascending
+    # inner-coordinate j (A sorted by (i, j)), which stability preserves.
+    # A single fused uint64 key + stable argsort hits numpy's radix path --
+    # several times faster than a two-pass lexsort on multi-million-pair
+    # joins (the chain bench's symbolic phase was lexsort-dominated).  uint64
+    # matches the native join (native/symbolic.cpp) bit-for-bit where int64
+    # would silently wrap for max_row * span >= 2^63; beyond even uint64's
+    # range, fall back to a stable lexsort on the coordinate pair.
+    span = int(b_coords[:, 1].max()) + 1
+    max_row = int(a_coords[:, 0].max())
+    if (max_row + 1) * span <= 1 << 64:
+        fused = out_r.astype(np.uint64) * np.uint64(span) + out_c.astype(np.uint64)
+        order = np.argsort(fused, kind="stable")
+        fused = fused[order]
+        a_slot, b_slot = a_slot[order], b_slot[order]
+        key_change = np.empty(total, dtype=bool)
+        key_change[0] = True
+        key_change[1:] = fused[1:] != fused[:-1]
+        key_starts = np.flatnonzero(key_change)
+        keys = np.stack(
+            [(fused[key_starts] // np.uint64(span)).astype(np.int64),
+             (fused[key_starts] % np.uint64(span)).astype(np.int64)], axis=1)
+    else:
+        order = np.lexsort((out_c, out_r))  # stable, last key primary
+        r_s, c_s = out_r[order], out_c[order]
+        a_slot, b_slot = a_slot[order], b_slot[order]
+        key_change = np.empty(total, dtype=bool)
+        key_change[0] = True
+        key_change[1:] = (r_s[1:] != r_s[:-1]) | (c_s[1:] != c_s[:-1])
+        key_starts = np.flatnonzero(key_change)
+        keys = np.stack([r_s[key_starts], c_s[key_starts]], axis=1)
+    pair_ptr = np.append(key_starts, total).astype(np.int64)
+
+    return JoinResult(keys=keys, pair_ptr=pair_ptr,
+                      pair_a=a_slot.astype(np.int32), pair_b=b_slot.astype(np.int32))
+
+
+@dataclass
+class Round:
+    """One fixed-shape numeric launch: <= round_size keys, all padded to the
+    same fanout class.  The reference's 500-key round (sparse_matrix_mult.cu:181-185)
+    generalized to (pow-4 key count) x (3/4-pow-2 fanout) shape classes so
+    the jit cache stays small."""
+
+    key_index: np.ndarray  # (n,) int64 -- positions into JoinResult.keys
+    pa: np.ndarray         # (K_pad, P) int32 -- A slab indices (sentinel-padded)
+    pb: np.ndarray         # (K_pad, P) int32
+    max_fanout: int = 0    # real (unpadded) max fanout among the round's keys
+                           # -- the hybrid exactness proof uses this, not the
+                           # padded class width (sentinel pairs contribute 0)
+
+
+def _ceil_pow2(x: int) -> int:
+    return 1 << max(int(x) - 1, 0).bit_length() if x > 1 else 1
+
+
+def _floor_pow2(x: int) -> int:
+    return 1 << (max(int(x), 1).bit_length() - 1)
+
+
+def _shape_class_vec(f: np.ndarray) -> np.ndarray:
+    """Round up to {1, 2, 3, 4, 6, 8, 12, 16, ...}: pow2 plus 3/4-pow2.
+
+    Pure pow2 classes waste up to ~50% padded slots (a banded matrix with
+    fanout 9 pads to 16); interleaving 3*2^(n-2) caps waste at 25% while the
+    compiled-shape count stays logarithmic.  np.log2 of an exact power of
+    two is exact in f64, so the ceil is safe."""
+    p = 1 << np.ceil(np.log2(np.maximum(f, 1))).astype(np.int64)
+    c34 = (3 * p) // 4
+    return np.where((p >= 4) & (f <= c34), c34, p)
+
+
+def _shape_class(x: int) -> int:
+    return int(_shape_class_vec(np.array([x]))[0])
+
+
+def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
+                round_size: int = 512,
+                max_entries: int | None = None) -> list[Round]:
+    """Bucket output keys by fanout class and chop into fixed-shape rounds.
+
+    a_sentinel/b_sentinel: index of the appended all-zero tile in each slab.
+    Padding both the pair axis (to the 3/4-pow-2 fanout class) and the key
+    axis (to a pow-4 rung <= the chunk cap) keeps the set of compiled shapes
+    logarithmic.
+
+    max_entries: if set, the key-axis chunk for fanout class P grows to
+    max_entries // P (pow-2, capped at 8192) instead of round_size -- fewer,
+    bigger launches for a backend whose per-round index arrays are bounded by
+    a memory budget (the Pallas kernel's scalar-prefetch arrays live in SMEM)
+    rather than by gather-materialization size (the XLA backend's constraint).
+    """
+    if round_size < 1:
+        raise ValueError(f"round_size must be >= 1, got {round_size}")
+    rounds: list[Round] = []
+    if join.num_keys == 0:
+        return rounds
+    fan = join.fanouts
+    classes = _shape_class_vec(fan)
+    for cls in np.unique(classes):
+        members = np.flatnonzero(classes == cls)
+        P = int(cls)
+        if max_entries is None:
+            chunk_cap = round_size
+        else:
+            # SMEM-derived cap.  The kernel ships pa/pb with the LONGER axis
+            # in lanes (lane-padded to 128, sublanes to 8), so the per-array
+            # footprint is pad8(short) * max(long, 128) entries; solve for
+            # the key-chunk size under the max_entries budget.
+            pad8_p = -(-P // 8) * 8
+            if P <= 512:
+                cap = max_entries // pad8_p       # (P, K): P sublanes
+            else:
+                # (K, P): P rides the lanes and is padded to a 128 multiple
+                # by Mosaic -- budget against the padded footprint, not raw
+                # P, or the shipped arrays overshoot SMEM for non-128-multiple
+                # fanout classes
+                pad128_p = -(-P // 128) * 128
+                cap = max(max_entries // pad128_p, 1)
+            chunk_cap = max(1, min(8192, _floor_pow2(cap)))
+            chunk_cap = min(chunk_cap, max(round_size, 1))
+        for start in range(0, len(members), chunk_cap):
+            chunk = members[start : start + chunk_cap]
+            K = len(chunk)
+            # key-axis ladder is pow4 (4, 16, 64, 256, 1024, 4096): padded
+            # keys compute discarded zeros only on the one tail round per
+            # class, while the compiled-shape count -- the expensive resource
+            # on the slow-AOT TPU toolchain -- stays at <= 6 per fanout
+            # class.  The pair axis keeps the finer 3/4-pow2 ladder because
+            # its padding costs real work on every round.
+            K_pad = 4
+            while K_pad < K:
+                K_pad *= 4
+            K_pad = min(K_pad, chunk_cap)
+            pa = np.full((K_pad, P), a_sentinel, dtype=np.int32)
+            pb = np.full((K_pad, P), b_sentinel, dtype=np.int32)
+            # scatter each key's pair list into its row (vectorized over keys)
+            lens = fan[chunk]
+            rows, cols = _segment_expand(lens)
+            src = np.repeat(join.pair_ptr[chunk], lens) + cols
+            pa[rows, cols] = join.pair_a[src]
+            pb[rows, cols] = join.pair_b[src]
+            rounds.append(Round(key_index=chunk, pa=pa, pb=pb,
+                                max_fanout=int(lens.max())))
+    return rounds

@@ -1,0 +1,195 @@
+"""uint64 modular arithmetic on TPU via (hi, lo) uint32 limb pairs.
+
+TPUs have no native 64-bit integer multiply (SURVEY.md section 7 "hard parts"),
+so every uint64 value is carried as two uint32 planes (hi, lo) and all
+arithmetic is synthesized from wrapping uint32 ops, which the VPU supports
+natively.  These functions are pure jax.numpy, shape-polymorphic, and work
+identically under jit on TPU, on the CPU backend, and inside Pallas kernels.
+
+Semantics implemented: the reference's wrap-then-mod sequence
+(sparse_matrix_mult.cu:48,59-61; SURVEY.md section 2.9):
+
+    mulmod(a, b) = ((a*b) mod 2^64) mod (2^64-1)     -- LOW 64 bits of the
+                                                        product, then the
+                                                        ==MAX -> 0 collapse
+    addmod(a, b) = ((a+b) mod 2^64) mod (2^64-1)
+
+For x < 2^64:  x mod (2^64-1) == 0 if x == 2^64-1 else x, so "mod" is an
+equality test, never a division.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_M16 = 0xFFFF
+_M32 = 0xFFFFFFFF
+# As a typed scalar: the bare python literal would overflow JAX's default
+# int32 canonicalization when mixed with uint32 arrays under jit.
+_M32_U32 = np.uint32(_M32)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing between numpy uint64 and (hi, lo) uint32 planes.
+# ---------------------------------------------------------------------------
+
+def u64_to_hilo(x: np.ndarray):
+    """Split a numpy uint64 array into (hi, lo) uint32 arrays."""
+    x = np.asarray(x, dtype=np.uint64)
+    hi = (x >> np.uint64(32)).astype(np.uint32)
+    lo = (x & np.uint64(_M32)).astype(np.uint32)
+    return hi, lo
+
+
+def hilo_to_u64(hi, lo) -> np.ndarray:
+    """Reassemble numpy uint64 from (hi, lo) uint32 arrays (device or host)."""
+    hi = np.asarray(hi, dtype=np.uint64)
+    lo = np.asarray(lo, dtype=np.uint64)
+    return (hi << np.uint64(32)) | lo
+
+
+# ---------------------------------------------------------------------------
+# Device-side limb arithmetic (wrapping uint32 ops only).
+# ---------------------------------------------------------------------------
+
+def mul32_wide(a, b):
+    """Exact 32x32 -> 64 bit product of uint32 arrays, as (hi, lo) uint32.
+
+    16-bit limb decomposition; every intermediate provably fits in uint32
+    (max value of `mid` is exactly 2^32 - 1), so no partial sum ever wraps.
+    """
+    al = a & _M16
+    ah = a >> 16
+    bl = b & _M16
+    bh = b >> 16
+    ll = al * bl  # <= (2^16-1)^2 < 2^32
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    mid = lh + (hl & _M16) + (ll >> 16)  # <= 2^32 - 1 exactly: no wrap
+    hi = hh + (hl >> 16) + (mid >> 16)
+    lo = (mid << 16) | (ll & _M16)
+    return hi, lo
+
+
+def mul64_lo(a_hi, a_lo, b_hi, b_lo):
+    """Low 64 bits of the u64 x u64 product -- i.e. (a*b) mod 2^64.
+
+    Mirrors the hardware wraparound the reference's `elem1*elem2` performs
+    (sparse_matrix_mult.cu:59): the high 64 bits are discarded, so only
+    al*bl (full) and the low halves of the cross terms contribute.
+    """
+    hi, lo = mul32_wide(a_lo, b_lo)
+    hi = hi + a_lo * b_hi + a_hi * b_lo  # wrapping u32: only low 32 of cross terms
+    return hi, lo
+
+
+def add64(a_hi, a_lo, b_hi, b_lo):
+    """(a + b) mod 2^64 on (hi, lo) pairs, with carry propagation."""
+    lo = a_lo + b_lo
+    carry = (lo < a_lo).astype(jnp.uint32)
+    hi = a_hi + b_hi + carry
+    return hi, lo
+
+
+def mod_max(hi, lo):
+    """x mod (2^64 - 1) for x < 2^64: collapse x == 2^64-1 to 0.
+
+    (hi & lo) == 0xFFFFFFFF iff both words are all-ones -- one op cheaper
+    than two compares, and this runs twice per MAC in the hot kernel."""
+    is_max = (hi & lo) == _M32_U32
+    zero = jnp.zeros_like(hi)
+    return jnp.where(is_max, zero, hi), jnp.where(is_max, zero, lo)
+
+
+def mulmod(a_hi, a_lo, b_hi, b_lo):
+    """The reference's product step: ((a*b) mod 2^64) mod (2^64-1)."""
+    return mod_max(*mul64_lo(a_hi, a_lo, b_hi, b_lo))
+
+
+def addmod(a_hi, a_lo, b_hi, b_lo):
+    """The reference's accumulate step: ((a+b) mod 2^64) mod (2^64-1).
+
+    NOT associative (SURVEY.md section 2.9): when the u64 sum wraps, the
+    result is one less than the clean mod-(2^64-1) sum.  Callers must fold
+    terms in the reference's order.
+    """
+    return mod_max(*add64(a_hi, a_lo, b_hi, b_lo))
+
+
+def mac(acc_hi, acc_lo, a_hi, a_lo, b_hi, b_lo):
+    """acc = addmod(acc, mulmod(a, b)) -- one contraction step."""
+    p_hi, p_lo = mulmod(a_hi, a_lo, b_hi, b_lo)
+    return addmod(acc_hi, acc_lo, p_hi, p_lo)
+
+
+# ---------------------------------------------------------------------------
+# Clean ring arithmetic mod (2^64 - 1) -- "field mode".
+#
+# The reference's wrap-then-mod sequence above is order-dependent, which
+# forbids reducing partial products across devices.  Partitioning the
+# *contraction* dimension (parallel/innershard.py, the north star's
+# "MPI -> psum" mapping) therefore uses clean mod-(2^64-1) arithmetic, which
+# is associative and commutative: 2^64 === 1 (mod 2^64-1), so the high word
+# of any overflow folds back in as +1.  Results agree with reference mode
+# whenever no product or accumulation crosses 2^64 (e.g. values < 2^32);
+# they are the mathematically-correct residues everywhere.
+# ---------------------------------------------------------------------------
+
+def add64_carry(a_hi, a_lo, b_hi, b_lo):
+    """(a + b) exactly, as (carry, hi, lo) -- 65-bit result."""
+    lo = a_lo + b_lo
+    c_lo = (lo < a_lo).astype(jnp.uint32)
+    hi1 = a_hi + b_hi
+    c_hi1 = (hi1 < a_hi).astype(jnp.uint32)
+    hi = hi1 + c_lo
+    c_hi2 = (hi < hi1).astype(jnp.uint32)
+    return c_hi1 + c_hi2, hi, lo
+
+
+def addmod_field(a_hi, a_lo, b_hi, b_lo):
+    """(a + b) mod (2^64 - 1) for a, b <= 2^64 - 1.  Associative."""
+    carry, hi, lo = add64_carry(a_hi, a_lo, b_hi, b_lo)
+    # fold the 2^64 carry back as +1 (2^64 === 1); cannot re-overflow because
+    # carry=1 implies the low 64 bits are <= 2^64 - 2
+    lo2 = lo + carry
+    c2 = (lo2 < lo).astype(jnp.uint32)
+    return mod_max(hi + c2, lo2)
+
+
+def mul64_full(a_hi, a_lo, b_hi, b_lo):
+    """Exact 64x64 -> 128 bit product as four uint32 limbs (p3, p2, p1, p0)."""
+    h00, l00 = mul32_wide(a_lo, b_lo)
+    h01, l01 = mul32_wide(a_lo, b_hi)
+    h10, l10 = mul32_wide(a_hi, b_lo)
+    h11, l11 = mul32_wide(a_hi, b_hi)
+
+    p0 = l00
+    p1 = h00 + l01
+    c1a = (p1 < h00).astype(jnp.uint32)
+    p1b = p1 + l10
+    c1b = (p1b < p1).astype(jnp.uint32)
+    carry1 = c1a + c1b
+
+    p2 = h01 + h10
+    c2a = (p2 < h01).astype(jnp.uint32)
+    p2b = p2 + l11
+    c2b = (p2b < p2).astype(jnp.uint32)
+    p2c = p2b + carry1
+    c2c = (p2c < p2b).astype(jnp.uint32)
+
+    p3 = h11 + c2a + c2b + c2c  # h11 <= 2^32 - 2^17 + 1: cannot wrap
+    return p3, p2c, p1b, p0
+
+
+def mulmod_field(a_hi, a_lo, b_hi, b_lo):
+    """(a * b) mod (2^64 - 1), full 128-bit product folded (2^64 === 1)."""
+    p3, p2, p1, p0 = mul64_full(a_hi, a_lo, b_hi, b_lo)
+    return addmod_field(p3, p2, p1, p0)  # hi64 + lo64 (mod 2^64-1)
+
+
+def mac_field(acc_hi, acc_lo, a_hi, a_lo, b_hi, b_lo):
+    """acc = (acc + a*b) mod (2^64 - 1), clean ring semantics."""
+    p_hi, p_lo = mulmod_field(a_hi, a_lo, b_hi, b_lo)
+    return addmod_field(acc_hi, acc_lo, p_hi, p_lo)

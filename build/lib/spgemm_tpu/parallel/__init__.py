@@ -1,0 +1,19 @@
+"""Distribution layer (L5): device meshes + XLA collectives replace MPI.
+
+The reference's distribution story (SURVEY.md C12-C14) is: range-partition the
+matrix chain over MPI ranks, blocking Send/Recv every partial product to rank
+0 through host memory, then rank 0 multiplies the partials alone.  The
+TPU-native inversion:
+
+  * rowshard  -- shard one SpGEMM's *output tile space* across the mesh with
+    shard_map (bit-exact: each output tile is computed whole on one device,
+    so the non-associative accumulation order is untouched).
+  * innershard -- partition the contraction (inner) dimension and psum partial
+    products over ICI (the north-star's "MPI -> psum" mapping; mathematically
+    mod-(2^64-1) but NOT bit-order-exact, see module docstring).
+  * chainpart -- the reference's chain partition + combine, device-placed
+    (exact helper2 parity per sub-chain and for the combine tree).
+
+Everything here runs identically on a real pod and on the
+`--xla_force_host_platform_device_count=8` CPU mesh used by tests.
+"""

@@ -1,0 +1,149 @@
+"""Chain partition + combine: the reference's MPI distribution, re-done (C12, C14).
+
+The reference range-partitions the chain over P ranks (sparse_matrix_mult.cu:
+438-456): rank r owns [r*q, (r+1)*q - 1] with q = N/P (integer), the last rank
+takes the remainder, and if q == 0 rank 0 does everything alone (:612-666).
+Each rank reduces its sub-chain with helper2, partials are gathered to rank 0
+(:460-556) and rank 0 runs helper2 over the P partials (:557-571).
+
+Here the partition arithmetic is replicated exactly -- including the q == 0
+degenerate branch -- because with non-associative arithmetic (SURVEY.md
+section 2.9) `mpirun -np P` can produce different bits than P=1, and parity
+means matching the reference *at the same P*.  The gather disappears: partial
+products are just arrays; the combine is the same pairwise tree (a log-P
+reduction, which the reference's report claimed but its code never had --
+SURVEY.md section 0 caveat 1).
+"""
+
+from __future__ import annotations
+
+from spgemm_tpu.chain import _to_host, chain_product
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+
+def partition_chain(n: int, p: int) -> list[tuple[int, int] | None]:
+    """Rank r -> inclusive (start, end) into the chain, or None for idle ranks.
+
+    Exact replica of sparse_matrix_mult.cu:438-456 (+ :612 degenerate case).
+    """
+    q = n // p
+    if q == 0:
+        return [(0, n - 1)] + [None] * (p - 1)
+    parts: list[tuple[int, int] | None] = []
+    for r in range(p):
+        start = r * q
+        end = (r + 1) * q - 1 if r < p - 1 else n - 1
+        parts.append((start, end))
+    return parts
+
+
+def chain_product_partitioned(matrices: list[BlockSparseMatrix], num_parts: int,
+                              multiply=None, checkpoint_dir: str | None = None,
+                              **kwargs) -> BlockSparseMatrix:
+    """Chain product with the reference's P-rank partition/combine semantics.
+
+    Equivalent to `mpirun -np num_parts ./a4`: each part reduces its sub-chain
+    with the helper2 tree, then the partials are reduced with the same tree
+    (the reference's rank-0 combine, :571).  With checkpoint_dir, each rank's
+    sub-chain and the combine get their own snapshot subdirectory."""
+    import os
+
+    if num_parts < 1:
+        raise ValueError("num_parts must be >= 1")
+
+    def sub(name):
+        return os.path.join(checkpoint_dir, name) if checkpoint_dir else None
+
+    # With the default device-resident multiply, each part's partial product
+    # stays in HBM between the per-part reduction and the combine tree (the
+    # reference instead serializes partials through MPI to rank 0, :460-556).
+    keep_device = kwargs.pop("keep_device", False)
+    keep = {"keep_device": True} if multiply is None else {}
+    parts = partition_chain(len(matrices), num_parts)
+    partials = [
+        chain_product(matrices[start : end + 1], multiply=multiply,
+                      checkpoint_dir=sub(f"rank{idx}"), **keep, **kwargs)
+        for idx, part in enumerate(parts) if part is not None
+        for start, end in [part]
+    ]
+    if len(partials) == 1:
+        return partials[0] if keep_device else _to_host(partials[0])
+    return chain_product(partials, multiply=multiply, keep_device=keep_device,
+                         checkpoint_dir=sub("combine"), **kwargs)
+
+
+def chain_product_on_devices(matrices: list[BlockSparseMatrix],
+                             devices=None, num_parts: int | None = None,
+                             **kwargs) -> BlockSparseMatrix:
+    """The reference's MPI data parallelism actually EXECUTING in parallel:
+    one device per rank, concurrent sub-chain reductions.
+
+    `chain_product_partitioned` replicates `mpirun -np P` *semantics* on one
+    device; here each rank's sub-chain is placed on its own mesh device
+    (committed placement, so jit runs each rank's multiplies where its tiles
+    live) and JAX's async dispatch overlaps the per-rank reductions across
+    the mesh -- the TPU-native version of P MPI processes computing
+    concurrently (sparse_matrix_mult.cu:438-456).  Partials then converge to
+    devices[0] and reduce with the same helper2 combine tree as rank 0
+    (:557-571), so the result is bit-identical to
+    `chain_product_partitioned(matrices, P)` at the same P.
+
+    num_parts: P (default len(devices); parity requires matching the
+    reference's P, so an explicit P cycles ranks over the devices).  Idle
+    ranks (N < P) get no device work, mirroring the reference's :612
+    degenerate branch.  NOTE: checkpoint_dir serializes the ranks -- each
+    pass snapshot is a blocking D2H, so rank idx finishes before rank idx+1
+    dispatches; recoverability costs the overlap.
+    """
+    import os
+
+    import jax
+
+    from spgemm_tpu.ops.device import DeviceBlockMatrix
+    from spgemm_tpu.ops.spgemm import spgemm_device
+
+    if devices is None:
+        devices = jax.devices()
+    p = num_parts or len(devices)
+    checkpoint_dir = kwargs.pop("checkpoint_dir", None)
+
+    def sub(name):
+        return os.path.join(checkpoint_dir, name) if checkpoint_dir else None
+
+    parts = partition_chain(len(matrices), p)
+    partials = []
+    for idx, part in enumerate(parts):
+        if part is None:
+            continue
+        start, end = part
+        dev = devices[idx % len(devices)]
+        dmats = [DeviceBlockMatrix.from_host(m, device=dev)
+                 for m in matrices[start:end + 1]]
+        # async dispatch: rank idx's whole reduction enqueues on its device
+        # before rank idx+1's begins -- the ranks execute concurrently
+        # (unless checkpointing, see docstring)
+        partials.append(chain_product(dmats, multiply=spgemm_device,
+                                      keep_device=True,
+                                      checkpoint_dir=sub(f"rank{idx}"),
+                                      **kwargs))
+    if len(partials) == 1:
+        return _to_host(partials[0])
+    if any(not isinstance(d, DeviceBlockMatrix) for d in partials):
+        # a rank failed over to the host oracle (failover=True): finish the
+        # combine tree on the host too -- the device cannot be trusted
+        from spgemm_tpu.chain import oracle_multiply  # noqa: PLC0415
+
+        return chain_product([_to_host(d) for d in partials],
+                             multiply=oracle_multiply,
+                             checkpoint_dir=sub("combine"))
+    # gather: partial slabs converge on devices[0] (the rank-0 combine);
+    # coords stay host-side, only tile planes move over ICI/PCIe
+    gathered = [
+        DeviceBlockMatrix(rows=d.rows, cols=d.cols, k=d.k, coords=d.coords,
+                          hi=jax.device_put(d.hi, devices[0]),
+                          lo=jax.device_put(d.lo, devices[0]),
+                          val_bound=d.val_bound)
+        for d in partials
+    ]
+    return chain_product(gathered, multiply=spgemm_device, keep_device=False,
+                         checkpoint_dir=sub("combine"), **kwargs)

@@ -1,0 +1,14 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+
+def default_mesh(n_devices: int | None = None, axis: str = "keys") -> Mesh:
+    """1-D mesh over the first n visible devices (all by default)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return jax.make_mesh((len(devs),), (axis,), devices=devs)

@@ -1,0 +1,115 @@
+"""Multi-host (DCN) chain distribution: the literal `mpirun -np P` replacement.
+
+The reference distributes the chain over MPI ranks and funnels every partial
+product through a serial rank-0 Recv loop in host memory (sparse_matrix_mult.
+cu:460-556, an O(P) bottleneck).  The JAX-native multi-host story:
+
+  * each *process* (host) owns the same chain slice arithmetic as an MPI rank
+    (parallel/chainpart.partition_chain -- bit-for-bit the reference's N/P
+    split) and reduces its sub-chain locally;
+  * partial products are exchanged with one padded all-gather over DCN
+    (jax.experimental.multihost_utils) -- O(log P) collective, not a serial
+    gather, and every host then runs the identical combine tree, so the
+    result is replicated and any host can write it (no rank-0 hot spot);
+  * within each host, the per-multiply numeric phase can additionally shard
+    over local devices (rowshard/innershard/ring).
+
+Launch (per host):
+    JAX_COORDINATOR=host0:1234 JAX_NUM_PROCESSES=P JAX_PROCESS_ID=r \
+        python -m spgemm_tpu.cli <folder> --distributed
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.parallel.chainpart import partition_chain
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+log = logging.getLogger("spgemm_tpu.multihost")
+
+
+def init_from_env() -> None:
+    """Initialize jax.distributed from JAX_COORDINATOR/JAX_NUM_PROCESSES/
+    JAX_PROCESS_ID (no-op if unset or already initialized)."""
+    coord = os.environ.get("JAX_COORDINATOR")
+    if not coord:
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+        process_id=int(os.environ["JAX_PROCESS_ID"]),
+    )
+
+
+def _allgather_partials(partial: BlockSparseMatrix | None, k: int):
+    """Exchange per-process partial products (variable nnzb) via two padded
+    all-gathers: metadata first, then coord/tile slabs padded to the max."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    p = jax.process_count()
+    meta_local = np.array(
+        [partial.rows, partial.cols, partial.nnzb] if partial is not None
+        else [-1, -1, -1], dtype=np.int64)
+    metas = np.asarray(multihost_utils.process_allgather(meta_local))  # (P, 3)
+    max_nnzb = max(1, int(metas[:, 2].max()))
+
+    coords = np.full((max_nnzb, 2), -1, dtype=np.int64)
+    tiles = np.zeros((max_nnzb, k, k), dtype=np.uint64)
+    if partial is not None and partial.nnzb:
+        coords[: partial.nnzb] = partial.coords
+        tiles[: partial.nnzb] = partial.tiles
+    # uint64 is not a DCN-friendly dtype everywhere; ship as two uint32 planes
+    from spgemm_tpu.ops import u64 as u64mod
+
+    t_hi, t_lo = u64mod.u64_to_hilo(tiles)
+    all_coords = np.asarray(multihost_utils.process_allgather(coords))
+    all_hi = np.asarray(multihost_utils.process_allgather(t_hi))
+    all_lo = np.asarray(multihost_utils.process_allgather(t_lo))
+
+    partials = []
+    for r in range(p):
+        rows, cols, nnzb = (int(v) for v in metas[r])
+        if rows < 0:
+            continue  # idle rank (N < P degenerate branch)
+        partials.append(BlockSparseMatrix(
+            rows=rows, cols=cols, k=k,
+            coords=all_coords[r, :nnzb],
+            tiles=u64mod.hilo_to_u64(all_hi[r, :nnzb], all_lo[r, :nnzb])))
+    return partials
+
+
+def chain_product_multihost(matrices_for_me: list[BlockSparseMatrix] | None,
+                            k: int, multiply=None, **kwargs) -> BlockSparseMatrix:
+    """Reduce this process's sub-chain, exchange partials over DCN, and run
+    the reference's combine tree (replicated on every host)."""
+    partial = (chain_product(matrices_for_me, multiply=multiply, **kwargs)
+               if matrices_for_me else None)
+    partials = _allgather_partials(partial, k)
+    log.info("gathered %d partials over DCN", len(partials))
+    if len(partials) == 1:
+        return partials[0]
+    return chain_product(partials, multiply=multiply, **kwargs)
+
+
+def run_distributed(folder: str, k: int, n: int, loader, multiply=None,
+                    **kwargs) -> BlockSparseMatrix:
+    """Full distributed driver: partition by process_index, load only the
+    local slice, reduce, exchange, combine.  `loader(start, end)` returns the
+    inclusive sub-chain."""
+    import jax
+
+    p = jax.process_count()
+    r = jax.process_index()
+    parts = partition_chain(n, p)
+    my = parts[r] if r < len(parts) else None
+    mine = loader(my[0], my[1]) if my is not None else None
+    log.info("process %d/%d owns chain[%s]", r, p, my)
+    return chain_product_multihost(mine, k, multiply=multiply, **kwargs)

@@ -1,0 +1,176 @@
+"""Ring SpGEMM: rotate B around the mesh, O(1/n) operand memory per device.
+
+The long-context pattern of SURVEY.md section 5.7 ("shard the long axis,
+rotate/reduce partials" -- structurally ring attention's KV rotation) applied
+to SpGEMM: output keys are range-sharded across the ring (each device computes
+its slab of C), A's tile slab is resident, and B's tile slab is partitioned
+into n chunks that rotate one hop per step via `jax.lax.ppermute` over ICI.
+After n steps every device has seen all of B while only ever holding 1/n of
+it -- this is what lets a `webbase-1M`-scale operand exceed single-chip HBM.
+
+Arithmetic: field mode (clean mod-(2^64-1), ops/u64.py) -- the rotation
+schedule visits each key's pairs grouped by B-slab, not in the reference's
+j-ascending order, so only an associative reduction is correct here.  Use
+parallel/rowshard.py when bit-order-exact results are required (it keeps every
+key's fold on one device, in order).
+
+Contrast with the reference: its distribution never slices an operand -- every
+rank holds whole matrices and ships whole partials through host memory
+(sparse_matrix_mult.cu:460-556).  The ring inverts that: operands stream
+device-to-device over ICI, nothing touches the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spgemm_tpu.ops import u64
+from spgemm_tpu.ops.symbolic import JoinResult, symbolic_join
+from spgemm_tpu.parallel.innershard import fold_pairs_field
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+
+def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
+    """Host-side schedule: key chunks per device, pair lists per (device, slab).
+
+    Returns (key_chunks, slab_bounds, pa_all, pb_all, s_max) where
+      key_chunks  : list of n index arrays into join.keys (device d's keys)
+      slab_bounds : (n+1,) B tile-slab boundaries (contiguous equal splits)
+      pa_all      : (n, n, K_max, P_max) int32 A-slab indices
+                    [device, slab, local key, pair]
+      pb_all      : (n, n, K_max, P_max) int32 *within-slab* B indices
+      s_max       : max slab size; within-slab sentinel == s_max (zero tile)
+    """
+    n_keys = join.num_keys
+    slab_bounds = np.array([(i * nnzb_b) // n_dev for i in range(n_dev + 1)],
+                           dtype=np.int64)
+    slab_sizes = np.diff(slab_bounds)
+    s_max = int(slab_sizes.max()) if n_dev > 0 else 0
+
+    # contiguous key ranges (keys are sorted by (row, col), so these are
+    # row-range slabs of C)
+    key_bounds = [(d * n_keys) // n_dev for d in range(n_dev + 1)]
+    key_chunks = [np.arange(key_bounds[d], key_bounds[d + 1])
+                  for d in range(n_dev)]
+    k_max = max(1, max(len(c) for c in key_chunks))
+
+    # slab of each pair = which contiguous B chunk owns its B tile index
+    slab_of_pair = np.searchsorted(slab_bounds, join.pair_b, side="right") - 1
+
+    # max pairs per (key, slab) cell
+    p_max = 1
+    cell_lists: list[list[tuple[np.ndarray, np.ndarray]]] = []
+    for d in range(n_dev):
+        per_slab: list[tuple[np.ndarray, np.ndarray]] = []
+        cell_lists.append(per_slab)
+    for d, chunk in enumerate(key_chunks):
+        for s in range(n_dev):
+            pas, pbs = [], []
+            for ki in chunk:
+                lo, hi = join.pair_ptr[ki], join.pair_ptr[ki + 1]
+                sel = slab_of_pair[lo:hi] == s
+                pas.append(join.pair_a[lo:hi][sel])
+                pbs.append(join.pair_b[lo:hi][sel] - slab_bounds[s])
+                p_max = max(p_max, int(sel.sum()))
+            cell_lists[d].append((pas, pbs))
+
+    pa_all = np.full((n_dev, n_dev, k_max, p_max), -1, dtype=np.int32)
+    pb_all = np.full((n_dev, n_dev, k_max, p_max), s_max, dtype=np.int32)
+    for d in range(n_dev):
+        for s in range(n_dev):
+            pas, pbs = cell_lists[d][s]
+            for row, (pa_row, pb_row) in enumerate(zip(pas, pbs)):
+                pa_all[d, s, row, : len(pa_row)] = pa_row
+                pb_all[d, s, row, : len(pb_row)] = pb_row
+    return key_chunks, slab_bounds, pa_all, pb_all, s_max
+
+
+def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
+                mesh: Mesh | None = None, **_ignored) -> BlockSparseMatrix:
+    """C = A x B with B rotating around the ring (field-mode arithmetic)."""
+    if a.k != b.k:
+        raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
+    k = a.k
+    if mesh is None:
+        from spgemm_tpu.parallel.mesh import default_mesh
+        mesh = default_mesh(axis="ring")
+    n_dev = mesh.devices.size
+
+    join = symbolic_join(a.coords, b.coords)
+    if join.num_keys == 0:
+        return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k)
+
+    from spgemm_tpu.ops.spgemm import pack_tiles
+    a_hi, a_lo = pack_tiles(a)  # replicated; sentinel zero tile at a.nnzb
+
+    key_chunks, slab_bounds, pa_all, pb_all, s_max = plan_ring(
+        join, b.nnzb, n_dev)
+    pa_all = np.where(pa_all < 0, a.nnzb, pa_all)  # A sentinel -> zero tile
+
+    # per-device B slab buffers: (n, s_max + 1, k, k), zero tile at s_max
+    bh_np, bl_np = u64.u64_to_hilo(b.tiles)
+    b_slab_h = np.zeros((n_dev, s_max + 1, k, k), np.uint32)
+    b_slab_l = np.zeros((n_dev, s_max + 1, k, k), np.uint32)
+    for s in range(n_dev):
+        lo, hi = slab_bounds[s], slab_bounds[s + 1]
+        b_slab_h[s, : hi - lo] = bh_np[lo:hi]
+        b_slab_l[s, : hi - lo] = bl_np[lo:hi]
+
+    fold = _make_ring_fold(mesh, n_dev)
+    shard0 = NamedSharding(mesh, P("ring"))
+    oh, ol = fold(
+        a_hi, a_lo,
+        jax.device_put(b_slab_h, shard0), jax.device_put(b_slab_l, shard0),
+        jax.device_put(jnp.asarray(pa_all), shard0),
+        jax.device_put(jnp.asarray(pb_all), shard0),
+    )
+    vals = u64.hilo_to_u64(np.asarray(oh), np.asarray(ol))  # (n, K_max, k, k)
+
+    out = np.zeros((join.num_keys, k, k), dtype=np.uint64)
+    for d, chunk in enumerate(key_chunks):
+        out[chunk] = vals[d, : len(chunk)]
+    return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k,
+                             coords=join.keys, tiles=out)
+
+
+@partial(jax.jit, static_argnames=("mesh", "n_dev"))
+def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb, *, mesh, n_dev):
+    def per_device(a_hi, a_lo, bh, bl, pa, pb):
+        # local shapes: bh (1, s_max+1, k, k), pa (1, n_slab, K, P)
+        d = jax.lax.axis_index("ring")
+        K = pa.shape[2]
+        k = a_hi.shape[-1]
+        rot_perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+        def step(t, carry):
+            acc_h, acc_l, bh, bl = carry
+            s = (d - t) % n_dev  # slab currently resident on this device
+            pa_s = pa[0, s]      # (K, P) -- dynamic index over the slab axis
+            pb_s = pb[0, s]
+            ph, pl = fold_pairs_field(a_hi, a_lo, bh[0], bl[0], pa_s, pb_s)
+            acc_h, acc_l = u64.addmod_field(acc_h, acc_l, ph, pl)
+            bh = jax.lax.ppermute(bh, "ring", rot_perm)  # rotate B one hop
+            bl = jax.lax.ppermute(bl, "ring", rot_perm)
+            return acc_h, acc_l, bh, bl
+
+        zero = jnp.zeros((K, k, k), jnp.uint32)
+        acc_h, acc_l, _, _ = jax.lax.fori_loop(
+            0, n_dev, step, (zero, zero, bh, bl))
+        return acc_h[None], acc_l[None]
+
+    return jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(), P("ring"), P("ring"), P("ring"), P("ring")),
+        out_specs=(P("ring"), P("ring")),
+        check_vma=False,
+    )(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb)
+
+
+def _make_ring_fold(mesh: Mesh, n_dev: int):
+    return partial(_ring_fold_jit, mesh=mesh, n_dev=n_dev)

@@ -1,0 +1,1 @@
+"""Host-side utilities: containers, text I/O, oracle semantics, timers, config."""

@@ -1,0 +1,49 @@
+"""Backend liveness probe + platform pinning (shared by bench.py and the CLI).
+
+The failure mode observed on this environment's TPU tunnel is a HANG inside
+backend init or the first device op -- not an exception -- so an in-process
+try/except can never fail soft.  The probe runs a tiny matmul in a
+SUBPROCESS with a hard timeout; the main process must not touch jax's
+backends until a probe has passed (or it has pinned a known-good platform).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def probe_default_backend(timeout_s: float | None = None) -> str:
+    """Probe outcome: 'ok' (real accelerator computed), 'cpu' (healthy but
+    CPU-only -- deterministic, not worth retrying), 'timeout' (hung), or
+    'error' (init crashed).  SPGEMM_TPU_PROBE_TIMEOUT overrides the default
+    150 s."""
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("SPGEMM_TPU_PROBE_TIMEOUT", "150"))
+    code = ("import jax, jax.numpy as jnp; "
+            "x = jnp.ones((64, 64), jnp.bfloat16); "
+            "(x @ x).block_until_ready(); "
+            "print(jax.devices()[0].platform)")
+    try:
+        rc = subprocess.run([sys.executable, "-c", code],
+                            capture_output=True, text=True, timeout=timeout_s)
+        if rc.returncode != 0:
+            return "error"
+        plat = rc.stdout.strip().splitlines()[-1] if rc.stdout.strip() else ""
+        return "cpu" if plat in ("", "cpu") else "ok"
+    except subprocess.TimeoutExpired:
+        return "timeout"
+
+
+def pin(platform: str) -> None:
+    """Pin the JAX platform in-process.  The env var alone is ineffective
+    here: the TPU plugin's sitecustomize imports jax at interpreter start
+    and snapshots JAX_PLATFORMS, so the config must be updated before any
+    backend initializes."""
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = platform
+    from jax._src import xla_bridge
+    if not xla_bridge._backends:
+        jax.config.update("jax_platforms", platform)

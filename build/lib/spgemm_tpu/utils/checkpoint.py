@@ -1,0 +1,57 @@
+"""Checkpoint/resume for chain reductions (SURVEY.md section 5.4).
+
+The reference has no persistence beyond the final output file -- a crash
+mid-chain loses everything.  Here each reduction pass can snapshot its
+surviving partial products as one .npz per pass (atomic rename), and a
+restart resumes from the newest complete pass.  The npz holds exactly the
+BlockSparseMatrix arrays, so a checkpoint round-trips losslessly.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+_PASS_RE = re.compile(r"^pass_(\d+)\.npz$")
+
+
+def save_pass(ckpt_dir: str, pass_idx: int, matrices: list[BlockSparseMatrix]) -> str:
+    """Atomically write the partial products surviving after `pass_idx`."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    payload: dict = {"n": np.int64(len(matrices))}
+    for i, m in enumerate(matrices):
+        payload[f"m{i}_meta"] = np.array([m.rows, m.cols, m.k], np.int64)
+        payload[f"m{i}_coords"] = m.coords
+        payload[f"m{i}_tiles"] = m.tiles
+    path = os.path.join(ckpt_dir, f"pass_{pass_idx}.npz")
+    tmp = path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+    os.replace(tmp, path)
+    return path
+
+
+def latest_pass(ckpt_dir: str) -> tuple[int, list[BlockSparseMatrix]] | None:
+    """Newest complete checkpoint as (pass_idx, matrices), or None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    best = -1
+    for name in os.listdir(ckpt_dir):
+        match = _PASS_RE.match(name)
+        if match:
+            best = max(best, int(match.group(1)))
+    if best < 0:
+        return None
+    with np.load(os.path.join(ckpt_dir, f"pass_{best}.npz")) as z:
+        n = int(z["n"])
+        mats = []
+        for i in range(n):
+            rows, cols, k = (int(v) for v in z[f"m{i}_meta"])
+            mats.append(BlockSparseMatrix(
+                rows=rows, cols=cols, k=k,
+                coords=z[f"m{i}_coords"], tiles=z[f"m{i}_tiles"]))
+    return best, mats

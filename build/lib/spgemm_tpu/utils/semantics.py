@@ -1,0 +1,162 @@
+"""The reference's exact arithmetic semantics, as a host-side numpy oracle.
+
+Parity-critical (SURVEY.md section 2.9). The reference's CUDA kernel
+(sparse_matrix_mult.cu:48,59-61) computes, per contraction step, in uint64:
+
+    p   = (a * b) mod 2^64            # hardware wraparound on the product
+    p'  = p mod (2^64 - 1)            # :59
+    acc = ((acc + p') mod 2^64) mod (2^64 - 1)   # :61 -- the sum can wrap FIRST
+
+This is *not* clean arithmetic mod (2^64 - 1): when `acc + p'` >= 2^64 the
+wrap-then-mod result is one less than the clean modular sum, so the reduction
+is **order-dependent**.  The accumulation order fixed by the reference is:
+
+  * output tile (i, c) contracts its matching inner block-coordinates j in
+    ascending order (A's std::map iteration order, sparse_matrix_mult.cu:149-156),
+  * and within each tile pair, the k-loop runs j = 0..k-1
+    (sparse_matrix_mult.cu:56-62).
+
+Every implementation in this framework (numpy oracle here, the XLA numeric
+phase, and the Pallas TPU kernel) reproduces this exact sequence.
+
+Useful simplification used throughout: for x < 2^64,
+    x mod (2^64 - 1) == 0 if x == 2^64 - 1 else x
+so each "mod" is an equality test against MAX, never a division.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# The reference's modulus constant (sparse_matrix_mult.cu:48).
+MAX_INT = 0xFFFFFFFFFFFFFFFF  # 2^64 - 1, as a python int
+MAX_U64 = np.uint64(MAX_INT)
+_ZERO_U64 = np.uint64(0)
+
+
+# ---------------------------------------------------------------------------
+# Scalar (python int) reference -- the dead-simple cross-check implementation.
+# ---------------------------------------------------------------------------
+
+def scalar_mac(acc: int, a: int, b: int) -> int:
+    """One multiply-accumulate step with the reference's exact semantics."""
+    p = (a * b) & MAX_INT  # mod 2^64 (keep low 64 bits only)
+    if p == MAX_INT:
+        p = 0
+    s = (acc + p) & MAX_INT  # the sum can also wrap at 2^64 first
+    if s == MAX_INT:
+        s = 0
+    return s
+
+
+def scalar_tile_matmul(acc, a_tile, b_tile):
+    """Contract one (A-tile, B-tile) pair into acc, all python ints.
+
+    acc, a_tile, b_tile: k x k lists/arrays of ints. Mirrors the loop nest of
+    matrix_multiplyKernel (sparse_matrix_mult.cu:54-62): for each output
+    element (ty, tx), fold over j = 0..k-1 in order.
+    """
+    k = len(a_tile)
+    out = [[0] * k for _ in range(k)]
+    for ty in range(k):
+        for tx in range(k):
+            s = int(acc[ty][tx])
+            for j in range(k):
+                s = scalar_mac(s, int(a_tile[ty][j]), int(b_tile[j][tx]))
+            out[ty][tx] = s
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Vectorized numpy oracle (uint64; hardware wraparound is numpy's behavior).
+# ---------------------------------------------------------------------------
+
+def mulmod_np(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(a * b) mod 2^64, then mod (2^64 - 1). uint64 arrays, broadcastable."""
+    with np.errstate(over="ignore"):
+        p = a * b  # uint64 wraparound == mod 2^64
+    return np.where(p == MAX_U64, _ZERO_U64, p)
+
+
+def addmod_np(acc: np.ndarray, p: np.ndarray) -> np.ndarray:
+    """((acc + p) mod 2^64) mod (2^64 - 1). uint64 arrays, broadcastable."""
+    with np.errstate(over="ignore"):
+        s = acc + p
+    return np.where(s == MAX_U64, _ZERO_U64, s)
+
+
+def tile_pair_mac_np(acc: np.ndarray, a_tile: np.ndarray, b_tile: np.ndarray) -> np.ndarray:
+    """Accumulate one tile-pair product into acc (all (k,k) uint64).
+
+    Vectorized over the k x k output lanes; sequential over j (order matters,
+    see module docstring). out[ty,tx] folds A[ty,j]*B[j,tx] for j=0..k-1.
+    """
+    k = a_tile.shape[0]
+    for j in range(k):
+        prod = mulmod_np(a_tile[:, j : j + 1], b_tile[j : j + 1, :])
+        acc = addmod_np(acc, prod)
+    return acc
+
+
+def tile_mac_oracle(a_tiles: np.ndarray, b_tiles: np.ndarray) -> np.ndarray:
+    """Fold an ordered list of (A, B) tile pairs into one output tile.
+
+    a_tiles/b_tiles: (p, k, k) uint64, already in the engine's j-ascending
+    pair order for a single output key.  This is the per-key oracle used for
+    sampled parity on configs too large for the full spgemm_oracle
+    (benchmarks/run.py cage12/nd24k).
+    """
+    k = a_tiles.shape[-1]
+    acc = np.zeros((k, k), dtype=np.uint64)
+    for a_t, b_t in zip(a_tiles, b_tiles):
+        acc = tile_pair_mac_np(acc, a_t, b_t)
+    return acc
+
+
+def spgemm_oracle(a_blocks: dict, b_blocks: dict, k: int) -> dict:
+    """Reference-semantics block-sparse matmul on dicts {(r,c): (k,k) uint64}.
+
+    Reproduces helper()'s symbolic join and accumulation order
+    (sparse_matrix_mult.cu:141-156): iterate A's blocks in sorted (r,c) order;
+    for each A block (i, j), for each B block (j, c), accumulate the tile-pair
+    product into output block (i, c).  Because A's sorted order visits j
+    ascending for fixed i, each output tile's pair list is j-ascending.
+
+    NOTE: does NOT prune all-zero output tiles -- the reference keeps them in
+    intermediate chain products and only prunes at final output
+    (sparse_matrix_mult.cu:577-592).
+    """
+    b_by_row: dict = {}
+    for (br, bc) in sorted(b_blocks.keys()):
+        b_by_row.setdefault(br, []).append(bc)
+
+    out: dict = {}
+    for (ar, ac) in sorted(a_blocks.keys()):
+        cols = b_by_row.get(ac)
+        if not cols:
+            continue
+        a_tile = a_blocks[(ar, ac)]
+        for bc in cols:
+            key = (ar, bc)
+            acc = out.get(key)
+            if acc is None:
+                acc = np.zeros((k, k), dtype=np.uint64)
+            out[key] = tile_pair_mac_np(acc, a_tile, b_blocks[(ac, bc)])
+    return out
+
+
+def chain_oracle(matrices: list, k: int) -> dict:
+    """Pairwise-halving chain product matching helper2 (sparse_matrix_mult.cu:287-327).
+
+    matrices: list of block dicts. Returns the final block dict. The pairing
+    order (adjacent pairs, odd element carried to the end) is semantically
+    irrelevant for an associative product -- but the arithmetic here is NOT
+    associative (section 2.9), so we replicate the exact reduction tree.
+    """
+    arr = list(matrices)
+    while len(arr) > 1:
+        nxt = [spgemm_oracle(arr[i], arr[i + 1], k) for i in range(0, len(arr) - 1, 2)]
+        if len(arr) % 2 == 1:
+            nxt.append(arr[-1])
+        arr = nxt
+    return arr[0]

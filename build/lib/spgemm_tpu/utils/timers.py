@@ -1,0 +1,67 @@
+"""Per-phase timing + structured logging (the reference's C17, done properly).
+
+The reference wraps every phase in chrono spans with the prints commented out
+(sparse_matrix_mult.cu:101,160-163,...) and reports only the final
+"time taken X seconds" (:679).  Here phases are named context managers
+accumulated in a registry, reported as structured lines, with optional
+jax.profiler traces; the CLI keeps the final `time taken` line for parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+log = logging.getLogger("spgemm_tpu.timers")
+
+
+class PhaseTimers:
+    """Accumulates wall-clock per named phase (re-entrant by name)."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def log_report(self):
+        for name in self.totals:
+            log.info("phase %s: %.4fs (x%d)", name, self.totals[name], self.counts[name])
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+
+    def snapshot(self) -> dict[str, float]:
+        """Rounded totals, for embedding in structured bench/CLI output."""
+        return {name: round(t, 4) for name, t in self.totals.items()}
+
+
+# Global registry for the SpGEMM engine's internal phases (symbolic join /
+# round planning / numeric dispatch / assembly) -- the analog of the
+# reference's per-phase chrono spans inside helper() (sparse_matrix_mult.cu:
+# 160-274, report.pdf Table 2).  The engine accumulates here on every
+# multiply; the CLI (--profile) and bench.py reset + report it.
+ENGINE = PhaseTimers()
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: str | None):
+    """jax.profiler.trace wrapper -- the XLA-level analog of the reference's
+    hand-rolled chrono spans."""
+    if trace_dir:
+        import jax
+
+        with jax.profiler.trace(trace_dir):
+            yield
+    else:
+        yield
