@@ -84,12 +84,20 @@ def _init_platform(args) -> str:
         pin(args.device)
     else:
         outcome = None
+        timeouts = 0
         for attempt in range(3):
             outcome = probe_default_backend()
             if outcome in ("ok", "cpu"):
                 break  # 'cpu' is deterministic -- retrying cannot change it
             print(f"backend probe attempt {attempt + 1}: {outcome}",
                   file=sys.stderr)
+            if outcome == "timeout":
+                # the observed hang mode persists for hours (round-3 notes):
+                # one retry covers a racy tunnel re-attach, more just burns
+                # the driver's budget 150 s at a time
+                timeouts += 1
+                if timeouts >= 2:
+                    break
             if attempt < 2:
                 time.sleep(5 * (attempt + 1))
         if outcome != "ok":

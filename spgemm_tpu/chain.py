@@ -77,13 +77,19 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
     while len(arr) > 1:
         try:
             nxt = []
+            odd_carry = arr[-1] if len(arr) % 2 == 1 else None
             for i in range(0, len(arr) - 1, 2):
                 # the reference's :301 progress line -- printed
                 # unconditionally to stdout, as sparse_matrix_mult.cu does
                 print(f"multiplying {i} {i + 1}", flush=True)
                 nxt.append(multiply(arr[i], arr[i + 1], **kwargs))
-            if len(arr) % 2 == 1:
-                nxt.append(arr[-1])  # odd element carried (:315-321)
+                # drop consumed partials so their HBM frees as soon as the
+                # dependent computations drain (pass >= 1 operands are
+                # device-resident and otherwise pinned for the whole pass;
+                # failover restarts from arr_host, never from these)
+                arr[i] = arr[i + 1] = None
+            if odd_carry is not None:
+                nxt.append(odd_carry)  # odd element carried (:315-321)
             nxt_host = [_to_host(m) for m in nxt] if need_host else None
         except Exception as e:  # noqa: BLE001 -- device loss is the use case
             if not failover or multiply is oracle_multiply:
@@ -93,7 +99,9 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
             # dir it cannot belong to a previous unrelated run)
             log.warning("multiply failed (%r); failing over to the host "
                         "oracle from pass %d", e, pass_idx)
-            arr = arr_host
+            # copy, not alias: the retry pass Nones out consumed entries of
+            # its working list, which must never corrupt the snapshot
+            arr = list(arr_host)
             multiply, kwargs, keep_device = oracle_multiply, {}, False
             continue
         arr, arr_host = nxt, nxt_host

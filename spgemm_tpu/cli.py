@@ -63,6 +63,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "parallel/), 'chain' = one chain rank per device "
                         "executing concurrently (bit-exact, the reference's "
                         "MPI data parallelism at P = n_devices)")
+    p.add_argument("--stream", action="store_true",
+                   help="host-resident chain partials: each multiply uploads "
+                        "its two operands, computes on device, and fetches "
+                        "the result back, so peak HBM is one multiply's "
+                        "working set instead of the whole pass -- the knob "
+                        "for chains larger than device memory (costs one "
+                        "D2H+H2D round-trip per partial per pass; the keys/"
+                        "inner/ring shard strategies already keep partials "
+                        "host-resident, and --shard chain ignores this flag)")
     p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                    help="snapshot chain partials after each reduction pass and "
                         "resume from the newest snapshot on restart")
@@ -123,6 +132,11 @@ def run(argv: list[str] | None = None) -> int:
     from spgemm_tpu.utils import io_text
     from spgemm_tpu.utils.timers import PhaseTimers, maybe_profile
 
+    if args.stream and (args.distributed or args.backend == "oracle"):
+        print("--stream ignored: the oracle backend is host-only and the "
+              "distributed path manages residency per process",
+              file=sys.stderr, flush=True)
+
     if args.distributed:
         from spgemm_tpu.parallel import multihost
 
@@ -155,6 +169,10 @@ def run(argv: list[str] | None = None) -> int:
                 result = BlockSparseMatrix.from_dict(
                     matrices[0].rows, matrices[-1].cols, k, blocks)
             elif args.shard == "chain":
+                if args.stream:
+                    print("--stream ignored with --shard chain (per-rank "
+                          "partials are device-resident by design)",
+                          file=sys.stderr, flush=True)
                 from spgemm_tpu.parallel.chainpart import chain_product_on_devices
                 kwargs = {"round_size": args.round_size,
                           "backend": args.backend}
@@ -176,6 +194,10 @@ def run(argv: list[str] | None = None) -> int:
                     kwargs.pop("round_size")
                 else:
                     kwargs["backend"] = args.backend
+                    if args.stream:
+                        # host-resident partials: spgemm (host-to-host) bounds
+                        # peak HBM to one multiply's operands + result
+                        from spgemm_tpu.ops.spgemm import spgemm as multiply
                 if args.checkpoint_dir:
                     kwargs["checkpoint_dir"] = args.checkpoint_dir
                 if args.failover:
