@@ -6,11 +6,12 @@ cd "$(dirname "$0")/.."
 LOG=benchmarks/watch.log
 for i in $(seq 1 200); do
   echo "[watch $i $(date -u +%H:%M:%S)] probing" >> "$LOG"
-  if bash benchmarks/tpu_evidence.sh >> "$LOG" 2>&1; then
+  bash benchmarks/tpu_evidence.sh >> "$LOG" 2>&1
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
     echo "[watch] evidence captured" >> "$LOG"
     exit 0
   fi
-  rc=$?
   # rc=2 means probe failed (chip down) and nothing was written; retry.
   # rc=1 means partial evidence -- still worth stopping to inspect.
   if [ "$rc" -ne 2 ]; then

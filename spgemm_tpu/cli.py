@@ -72,6 +72,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "D2H+H2D round-trip per partial per pass; the keys/"
                         "inner/ring shard strategies already keep partials "
                         "host-resident, and --shard chain ignores this flag)")
+    p.add_argument("--out-of-core", action="store_true",
+                   help="never materialize an operand slab in HBM: partials "
+                        "stay host-resident (implies --stream) and each "
+                        "numeric round uploads only the tiles it references, "
+                        "so peak HBM is two rounds' working sets (depth-2 "
+                        "pipeline) -- multiplies bigger than device memory, "
+                        "the reference's host-staging capacity model "
+                        "(sparse_matrix_mult.cu:167-257)")
     p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
                    help="snapshot chain partials after each reduction pass and "
                         "resume from the newest snapshot on restart")
@@ -94,7 +102,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def run(argv: list[str] | None = None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.out_of_core and args.backend == "hybrid":
+        # reject before the load phase: raised mid-chain this would either
+        # surface only after minutes of I/O or, under --failover, be
+        # misread as device death and silently reroute to the host oracle
+        parser.error("--out-of-core does not support --backend hybrid "
+                     "(use xla, pallas, or mxu)")
+    if (args.stream or args.out_of_core) and args.shard in ("keys", "inner", "ring"):
+        print(f"--shard {args.shard} already keeps chain partials host-"
+              "resident; --out-of-core per-round staging does not apply to "
+              "the sharded multiplies", file=sys.stderr, flush=True)
     if args.device:
         os.environ["JAX_PLATFORMS"] = args.device
         # If an embedding (e.g. a TPU plugin's sitecustomize) already imported
@@ -132,10 +151,11 @@ def run(argv: list[str] | None = None) -> int:
     from spgemm_tpu.utils import io_text
     from spgemm_tpu.utils.timers import PhaseTimers, maybe_profile
 
-    if args.stream and (args.distributed or args.backend == "oracle"):
-        print("--stream ignored: the oracle backend is host-only and the "
-              "distributed path manages residency per process",
-              file=sys.stderr, flush=True)
+    if (args.stream or args.out_of_core) and (args.distributed
+                                              or args.backend == "oracle"):
+        print("--stream/--out-of-core ignored: the oracle backend is "
+              "host-only and the distributed path manages residency per "
+              "process", file=sys.stderr, flush=True)
 
     if args.distributed:
         from spgemm_tpu.parallel import multihost
@@ -169,9 +189,9 @@ def run(argv: list[str] | None = None) -> int:
                 result = BlockSparseMatrix.from_dict(
                     matrices[0].rows, matrices[-1].cols, k, blocks)
             elif args.shard == "chain":
-                if args.stream:
-                    print("--stream ignored with --shard chain (per-rank "
-                          "partials are device-resident by design)",
+                if args.stream or args.out_of_core:
+                    print("--stream/--out-of-core ignored with --shard chain "
+                          "(per-rank partials are device-resident by design)",
                           file=sys.stderr, flush=True)
                 from spgemm_tpu.parallel.chainpart import chain_product_on_devices
                 kwargs = {"round_size": args.round_size,
@@ -194,7 +214,12 @@ def run(argv: list[str] | None = None) -> int:
                     kwargs.pop("round_size")
                 else:
                     kwargs["backend"] = args.backend
-                    if args.stream:
+                    if args.out_of_core:
+                        # host-resident partials AND per-round tile staging:
+                        # peak HBM is one round's sub-slabs, so multiplies
+                        # need not fit in device memory at all
+                        from spgemm_tpu.ops.spgemm import spgemm_outofcore as multiply
+                    elif args.stream:
                         # host-resident partials: spgemm (host-to-host) bounds
                         # peak HBM to one multiply's operands + result
                         from spgemm_tpu.ops.spgemm import spgemm as multiply

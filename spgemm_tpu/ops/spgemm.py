@@ -271,6 +271,125 @@ def spgemm_device(a, b, *, round_size: int | None = None,
                              val_bound=min(out_bound, (1 << 64) - 2))
 
 
+def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
+                     round_size: int | None = None,
+                     backend: str | None = None) -> BlockSparseMatrix:
+    """C = A x B without ever materializing either operand slab in HBM.
+
+    The device-resident pipeline (spgemm_device) requires both operand slabs
+    plus the result to fit in HBM at once.  The reference has no such limit:
+    its matrices live in host RAM and the GPU only ever holds one <= 500-key
+    round's staged pairs (the 8 GB large_arr, sparse_matrix_mult.cu:167-257).
+    This is the same staging model as a *capacity* mode: operands stay host-
+    resident, and each round uploads only the tiles it references --
+
+      peak HBM = TWO rounds' sub-slabs + output tiles (depth-2 pipeline),
+
+    bounded by round_size regardless of operand size, at the cost of one
+    upload per referenced tile per round (banded/clustered structures re-use
+    tiles within a round, so uploads are deduplicated per round).
+
+    Sub-slab sizes are padded to the 3/4-pow-2 ladder so the jit cache sees
+    a logarithmic set of shapes, and rounds are pipelined two-deep: round
+    i+1's host-side gather and upload overlap round i's device execution.
+
+    Semantics, ordering, and output structure are identical to spgemm
+    (reference wrap-then-mod, SURVEY.md section 2.9); 'hybrid' dispatch is
+    not supported here (use xla / pallas / mxu).
+    """
+    from types import SimpleNamespace  # noqa: PLC0415
+
+    from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
+    from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
+
+    a = a.to_host() if hasattr(a, "to_host") else a
+    b = b.to_host() if hasattr(b, "to_host") else b
+    if a.k != b.k:
+        raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
+    backend = resolve_backend(backend)
+    if backend == "hybrid":
+        raise ValueError("hybrid dispatch is not supported out-of-core; "
+                         "use backend='xla', 'pallas', or 'mxu'")
+    k = a.k
+    with timers.phase("symbolic_join"):
+        join = symbolic_join(a.coords, b.coords)
+    if join.num_keys == 0:
+        return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k)
+
+    # val_bound for the MXU limb-grid selection (host matrices don't track
+    # bounds the way DeviceBlockMatrix does -- compute them here, it's one
+    # pass over each slab and only the mxu backend reads them)
+    if backend == "mxu":
+        bound = SimpleNamespace(val_bound=int(a.tiles.max()) if a.nnzb else 0), \
+                SimpleNamespace(val_bound=int(b.tiles.max()) if b.nnzb else 0)
+    else:
+        bound = SimpleNamespace(val_bound=None), SimpleNamespace(val_bound=None)
+    # keep the backend's max_entries (the Pallas kernels' SMEM index-array
+    # budget -- huge-fanout classes must still shrink their key chunks), but
+    # bound every round by round_size keys (the reference's small_size):
+    # capacity, not launch width, is the point here
+    numeric, max_entries, _ = _select_numeric(backend, *bound)
+    round_size = 512 if round_size is None else round_size
+
+    with timers.phase("plan_rounds"):
+        rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+                             round_size=round_size, max_entries=max_entries)
+
+    def stage(rnd):
+        """Host gather + upload of one round's referenced tiles."""
+        ua = np.unique(rnd.pa)
+        ua = ua[ua < a.nnzb]          # drop the global sentinel
+        ub = np.unique(rnd.pb)
+        ub = ub[ub < b.nnzb]
+        # global index -> sub-slab index; the global sentinel (> every real
+        # index) lands at len(ua), exactly where the zero tile sits
+        sub_pa = np.searchsorted(ua, rnd.pa).astype(np.int32)
+        sub_pb = np.searchsorted(ub, rnd.pb).astype(np.int32)
+        # pad the sub-slab length to a shape class so jit compiles a
+        # logarithmic set of slab shapes, not one per round
+        na = _shape_class(len(ua) + 1)
+        nb = _shape_class(len(ub) + 1)
+        a_sub = np.zeros((na, k, k), np.uint64)
+        a_sub[: len(ua)] = a.tiles[ua]
+        b_sub = np.zeros((nb, k, k), np.uint64)
+        b_sub[: len(ub)] = b.tiles[ub]
+        ah, al = u64.u64_to_hilo(a_sub)
+        bh, bl = u64.u64_to_hilo(b_sub)
+        return numeric(jnp.asarray(ah), jnp.asarray(al),
+                       jnp.asarray(bh), jnp.asarray(bl),
+                       jnp.asarray(sub_pa), jnp.asarray(sub_pb))
+
+    out_tiles = np.zeros((join.num_keys, k, k), np.uint64)
+
+    def land(oh, ol, key_index):
+        """Fetch one round's outputs (blocks on that round only) and place
+        them into the host result slab."""
+        n = len(key_index)
+        out_tiles[key_index] = u64.hilo_to_u64(np.asarray(oh[:n]),
+                                               np.asarray(ol[:n]))
+
+    in_flight: list = []  # [(out_hi, out_lo, key_index)] -- depth 2: round
+    # i+1 stages while round i executes; landing blocks only on round i
+    for rnd in rounds:
+        with timers.phase("numeric_dispatch"):
+            oh, ol = stage(rnd)
+        in_flight.append((oh, ol, rnd.key_index))
+        if len(in_flight) > 1:
+            with timers.phase("assembly"):
+                land(*in_flight.pop(0))
+    with timers.phase("assembly"):
+        for entry in in_flight:
+            land(*entry)
+
+    total_pairs = int(join.pair_ptr[-1])
+    log.info("spgemm[%s,out-of-core]: nnzb %d x %d -> keys=%d pairs=%d "
+             "rounds=%d work=%.3f GFLOP", backend, a.nnzb, b.nnzb,
+             join.num_keys, total_pairs, len(rounds),
+             2.0 * total_pairs * k ** 3 / 1e9)
+    return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k,
+                             coords=join.keys, tiles=out_tiles)
+
+
 def spgemm(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
            round_size: int | None = None,
            backend: str | None = None) -> BlockSparseMatrix:

@@ -65,6 +65,20 @@ def test_cli_stream_mode(tmp_path, capsys, monkeypatch, n):
     assert len(calls) == n - 1  # one host-to-host multiply per reduction edge
 
 
+def test_cli_out_of_core(tmp_path, capsys):
+    """--out-of-core (per-round staging) matches the reference bytes."""
+    rng = np.random.default_rng(90)
+    k = 2
+    mats = random_chain(4, 4, k, 0.5, rng, "adversarial")
+    folder = str(tmp_path / "in")
+    io_text.write_chain_dir(folder, mats, k)
+    out = str(tmp_path / "matrix")
+
+    rc = run([folder, "--output", out, "--out-of-core"])
+    assert rc == 0
+    assert open(out, "rb").read() == _expected_bytes(mats, k)
+
+
 def test_cli_default_output_cwd(tmp_path, monkeypatch, capsys):
     """The reference writes to ./matrix in the cwd (sparse_matrix_mult.cu:595)."""
     rng = np.random.default_rng(70)
