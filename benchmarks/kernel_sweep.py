@@ -97,6 +97,17 @@ def main() -> int:
              (hi, lo, hi, lo, pa, pb), {"algo": "vecj"}),
             ("vpu-vecj-g8", numeric_round_pallas,
              (hi, lo, hi, lo, pa, pb), {"algo": "vecj", "group": 8}),
+            # pair-axis blocking (round-2 VERDICT #2): PB pairs per grid
+            # step amortize the per-step fixed cost
+            ("vpu-colbcast-g16-pb2", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb), {"algo": "colbcast", "pair_block": 2}),
+            ("vpu-colbcast-g16-pb4", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb), {"algo": "colbcast", "pair_block": 4}),
+            ("vpu-colbcast-g8-pb4", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb),
+             {"algo": "colbcast", "group": 8, "pair_block": 4}),
+            ("vpu-vecj-g16-pb2", numeric_round_pallas,
+             (hi, lo, hi, lo, pa, pb), {"algo": "vecj", "pair_block": 2}),
             ("mxu-xla-10x10", numeric_round_mxu,
              (hi, lo, hi, lo, pa, pb), {}),
             ("mxu-pallas-10x10", numeric_round_mxu_pallas,

@@ -39,13 +39,18 @@ from jax.experimental.pallas import tpu as pltpu
 from spgemm_tpu.ops import u64
 
 
-def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str):
-    # refs layout: ah x G, al x G, bh x G, bl x G, out_hi, out_lo
-    ahs = [r[0] for r in refs[0 * G : 1 * G]]          # each (k, k) uint32
-    als = [r[0] for r in refs[1 * G : 2 * G]]
-    bhs = [r[0] for r in refs[2 * G : 3 * G]]
-    bls = [r[0] for r in refs[3 * G : 4 * G]]
-    out_hi_ref, out_lo_ref = refs[4 * G], refs[4 * G + 1]
+def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str, PB: int = 1):
+    # refs layout, pb-major: for pb in range(PB): ah x G; then al, bh, bl
+    # blocks in the same order; finally out_hi, out_lo.  PB > 1 folds
+    # pair_block consecutive pairs per grid step (pair-axis blocking --
+    # amortizes per-step fixed cost over PB pair slots; fold order stays
+    # pair-ascending, so SURVEY.md 2.9 ordering is preserved).
+    n = G * PB
+    all_ah = [r[0] for r in refs[0 * n : 1 * n]]       # each (k, k) uint32
+    all_al = [r[0] for r in refs[1 * n : 2 * n]]
+    all_bh = [r[0] for r in refs[2 * n : 3 * n]]
+    all_bl = [r[0] for r in refs[3 * n : 4 * n]]
+    out_hi_ref, out_lo_ref = refs[4 * n], refs[4 * n + 1]
 
     pair = pl.program_id(1)
 
@@ -56,7 +61,19 @@ def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str):
 
     acc_h = out_hi_ref[0]                              # (k, G*k)
     acc_l = out_lo_ref[0]
+    for pb in range(PB):
+        ahs = all_ah[pb * G : (pb + 1) * G]
+        als = all_al[pb * G : (pb + 1) * G]
+        bhs = all_bh[pb * G : (pb + 1) * G]
+        bls = all_bl[pb * G : (pb + 1) * G]
+        acc_h, acc_l = _fold_pair(acc_h, acc_l, ahs, als, bhs, bls,
+                                  k=k, G=G, algo=algo)
 
+    out_hi_ref[0] = acc_h
+    out_lo_ref[0] = acc_l
+
+
+def _fold_pair(acc_h, acc_l, ahs, als, bhs, bls, *, k: int, G: int, algo: str):
     if algo == "colbcast":
         # B rows pack once per step: group tiles side by side along lanes.
         bh_cat = jnp.concatenate(bhs, axis=1)          # (k, G*k)
@@ -108,9 +125,7 @@ def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str):
                     prod_h[jj * k:(jj + 1) * k, :], prod_l[jj * k:(jj + 1) * k, :])
     else:
         raise ValueError(f"unknown algo {algo!r}")
-
-    out_hi_ref[0] = acc_h
-    out_lo_ref[0] = acc_l
+    return acc_h, acc_l
 
 
 def resolve_group(k: int, K: int, group: int | None = None) -> int:
@@ -123,15 +138,20 @@ def resolve_group(k: int, K: int, group: int | None = None) -> int:
     return max(1, min(group or 16, lane_cap // k, K))
 
 
-@partial(jax.jit, static_argnames=("interpret", "algo", "group"))
+@partial(jax.jit, static_argnames=("interpret", "algo", "group", "pair_block"))
 def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
-                         algo: str = "colbcast", group: int | None = None):
+                         algo: str = "colbcast", group: int | None = None,
+                         pair_block: int = 1):
     """Same contract as ops.spgemm.numeric_round_impl, as a Pallas kernel.
 
     a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
     pa, pb  : (K, P) int32 slab indices, per-key j-ascending, sentinel-padded.
     group   : override the key-group width G (benchmarks/kernel_sweep.py
               measures the ladder; default below is the tuned value).
+    pair_block : pairs folded per grid step (PB).  PB > 1 shrinks the grid's
+              pair axis PB-fold, amortizing per-step fixed cost, at the price
+              of 4*G*PB input refs per step.  Sentinel padding of the pair
+              axis keeps results exact; fold order stays pair-ascending.
     Returns (out_hi, out_lo): (K, k, k) uint32.
     """
     K, P = pa.shape
@@ -143,15 +163,13 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
     # from G=4 to G=16 at k=32, measured); bounded by the accumulator lane
     # cap and 4*G input refs per step
     G = resolve_group(k, K, group)
-    K_pad = -(-K // G) * G
-    if K_pad != K:
-        pad = ((0, K_pad - K), (0, 0))
-        a_sent = jnp.int32(a_hi.shape[0] - 1)
-        b_sent = jnp.int32(b_hi.shape[0] - 1)
-        pa = jnp.concatenate(
-            [pa, jnp.full((K_pad - K, P), a_sent, jnp.int32)], axis=0)
-        pb = jnp.concatenate(
-            [pb, jnp.full((K_pad - K, P), b_sent, jnp.int32)], axis=0)
+    PB = max(1, min(int(pair_block), P))
+    K_pad = -(-K // G) * G      # key axis: whole groups
+    P_pad = -(-P // PB) * PB    # pair axis: whole pair blocks
+    if (K_pad, P_pad) != (K, P):
+        widths = ((0, K_pad - K), (0, P_pad - P))
+        pa = jnp.pad(pa, widths, constant_values=a_hi.shape[0] - 1)
+        pb = jnp.pad(pb, widths, constant_values=b_hi.shape[0] - 1)
     KG = K_pad // G
 
     # Prefetch arrays are SMEM-resident, lane-padded to 128 in the last
@@ -162,31 +180,35 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
     def pad8(x):
         return -(-x // 8) * 8
 
-    transpose = pad8(P) * max(K_pad, 128) <= pad8(K_pad) * max(P, 128)
+    transpose = pad8(P_pad) * max(K_pad, 128) <= pad8(K_pad) * max(P_pad, 128)
     if transpose:
         pa_t, pb_t = pa.T, pb.T
 
-        def a_map(g):
-            return lambda kg, p, pa, pb: (pa[p, kg * G + g], 0, 0)
+        def a_map(g, pbi):
+            return lambda kg, p, pa, pb: (pa[p * PB + pbi, kg * G + g], 0, 0)
 
-        def b_map(g):
-            return lambda kg, p, pa, pb: (pb[p, kg * G + g], 0, 0)
+        def b_map(g, pbi):
+            return lambda kg, p, pa, pb: (pb[p * PB + pbi, kg * G + g], 0, 0)
     else:
         pa_t, pb_t = pa, pb
 
-        def a_map(g):
-            return lambda kg, p, pa, pb: (pa[kg * G + g, p], 0, 0)
+        def a_map(g, pbi):
+            return lambda kg, p, pa, pb: (pa[kg * G + g, p * PB + pbi], 0, 0)
 
-        def b_map(g):
-            return lambda kg, p, pa, pb: (pb[kg * G + g, p], 0, 0)
+        def b_map(g, pbi):
+            return lambda kg, p, pa, pb: (pb[kg * G + g, p * PB + pbi], 0, 0)
 
-    tile_spec_a = [pl.BlockSpec((1, k, k), a_map(g)) for g in range(G)]
-    tile_spec_b = [pl.BlockSpec((1, k, k), b_map(g)) for g in range(G)]
+    # pb-major ref order -- the kernel slices G-wide runs per pair slot
+    tile_spec_a = [pl.BlockSpec((1, k, k), a_map(g, pbi))
+                   for pbi in range(PB) for g in range(G)]
+    tile_spec_b = [pl.BlockSpec((1, k, k), b_map(g, pbi))
+                   for pbi in range(PB) for g in range(G)]
     out_spec = pl.BlockSpec((1, k, G * k), lambda kg, p, pa, pb: (kg, 0, 0))
 
+    n = G * PB
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # pa, pb
-        grid=(KG, P),
+        grid=(KG, P_pad // PB),
         in_specs=tile_spec_a + tile_spec_a + tile_spec_b + tile_spec_b,
         out_specs=[out_spec, out_spec],
     )
@@ -195,7 +217,7 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
         jax.ShapeDtypeStruct((KG, k, G * k), jnp.uint32),
     ]
     packed_hi, packed_lo = pl.pallas_call(
-        partial(_kernel, k=k, G=G, algo=algo),
+        partial(_kernel, k=k, G=G, algo=algo, PB=PB),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
@@ -203,7 +225,7 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
             dimension_semantics=("arbitrary", "arbitrary"),  # sequential: order matters
         ),
     )(pa_t, pb_t,
-      *([a_hi] * G), *([a_lo] * G), *([b_hi] * G), *([b_lo] * G))
+      *([a_hi] * n), *([a_lo] * n), *([b_hi] * n), *([b_lo] * n))
 
     def unpack(x):
         # (KG, ty, g*k+tx) -> (K, ty, tx)

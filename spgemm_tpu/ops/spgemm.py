@@ -118,11 +118,13 @@ def _select_numeric(backend: str, a, b):
 
         from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas  # noqa: PLC0415
 
-        # manual A/B hook: SPGEMM_TPU_VPU_ALGO=vecj runs the whole engine
-        # (CLI, bench) on the alternate kernel layout; default is the tuned
-        # one.  jit caches per static algo value, so this costs nothing.
+        # manual A/B hooks: SPGEMM_TPU_VPU_ALGO=vecj runs the whole engine
+        # (CLI, bench) on the alternate kernel layout, SPGEMM_TPU_VPU_PB=N
+        # on pair-axis blocking; defaults are the tuned values.  jit caches
+        # per static value, so this costs nothing.
         numeric = partial(numeric_round_pallas,
-                          algo=os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast"))
+                          algo=os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast"),
+                          pair_block=int(os.environ.get("SPGEMM_TPU_VPU_PB", "1")))
         # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
         # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to 8),
         # not by gather materialization: merge key chunks into fewer, bigger

@@ -36,6 +36,35 @@ def test_pallas_multi_round_and_padding():
     assert got == want
 
 
+@pytest.mark.parametrize("algo", ["colbcast", "vecj"])
+@pytest.mark.parametrize("pb", [2, 3, 8])  # clean multi-step, tail, PB == P
+def test_pair_block_matches_unblocked(algo, pb):
+    """Pair-axis blocking (PB pairs folded per grid step) must be
+    bit-identical to the PB=1 kernel: sentinel padding of the pair axis
+    contributes zero and the fold order stays pair-ascending.  P=8 with
+    PB in {2, 3, 8} exercises the no-padding multi-step case, tail
+    padding, and the full-collapse-to-one-step case."""
+    import jax.numpy as jnp
+
+    from spgemm_tpu.ops import u64
+    from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas
+    from spgemm_tpu.utils.gen import random_values
+
+    rng = np.random.default_rng(31 * pb + len(algo))
+    k, nnzb, K, P = 8, 9, 20, 8
+    tiles = random_values((nnzb + 1, k, k), rng, "adversarial")
+    tiles[-1] = 0
+    hi, lo = map(jnp.asarray, u64.u64_to_hilo(tiles))
+    pa = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    pb_idx = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    w = numeric_round_pallas(hi, lo, hi, lo, pa, pb_idx, interpret=True,
+                             algo=algo)
+    g = numeric_round_pallas(hi, lo, hi, lo, pa, pb_idx, interpret=True,
+                             algo=algo, pair_block=pb)
+    assert np.array_equal(np.asarray(w[0]), np.asarray(g[0]))
+    assert np.array_equal(np.asarray(w[1]), np.asarray(g[1]))
+
+
 @pytest.mark.parametrize("dist", ["full", "adversarial"])
 def test_vecj_algo_matches_colbcast(dist):
     """The vectorized-j kernel layout must be bit-identical to the unrolled
