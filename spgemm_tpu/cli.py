@@ -104,12 +104,6 @@ def build_parser() -> argparse.ArgumentParser:
 def run(argv: list[str] | None = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
-    if args.out_of_core and args.backend == "hybrid":
-        # reject before the load phase: raised mid-chain this would either
-        # surface only after minutes of I/O or, under --failover, be
-        # misread as device death and silently reroute to the host oracle
-        parser.error("--out-of-core does not support --backend hybrid "
-                     "(use xla, pallas, or mxu)")
     if (args.stream or args.out_of_core) and args.shard in ("keys", "inner", "ring"):
         print(f"--shard {args.shard} already keeps chain partials host-"
               "resident; --out-of-core per-round staging does not apply to "

@@ -152,6 +152,43 @@ def _select_numeric(backend: str, a, b):
     raise ValueError(f"unknown backend {backend!r}")
 
 
+def _hybrid_setup(a, b, k):
+    """Per-ROUND hybrid dispatch shared by the resident and out-of-core
+    pipelines: rounds are bucketed by fanout class (plan_rounds) and the
+    bit-exactness proof depends on the fanout, so each round independently
+    runs MXU field mode when provably equal to the reference fold (no
+    product or partial sum can reach 2^64-1 at that fanout) and the exact
+    VPU/XLA kernel otherwise.  One huge-fanout key no longer forces the
+    whole multiply off the MXU.  Every key is computed whole by one kernel,
+    so the mixed result is bit-exact regardless of the split.
+
+    a, b need only .val_bound.  Returns (numeric_exact, max_entries,
+    default_rs, choose_numeric) where choose_numeric(rnd) -> (fn, used_mxu).
+    """
+    from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
+
+    exact_name = resolve_backend(None)
+    numeric_exact, max_entries, default_rs = _select_numeric(exact_name, a, b)
+    numeric_mxu, mxu_entries, _ = _select_numeric("mxu", a, b)
+    # plan under the tighter budget so both kernels accept every round
+    if mxu_entries is not None and (max_entries is None
+                                    or mxu_entries < max_entries):
+        max_entries = mxu_entries
+    bounds_ok = a.val_bound is not None and b.val_bound is not None
+
+    def choose_numeric(rnd):
+        # proof at the round's REAL max fanout (padded sentinel pairs
+        # contribute exactly 0); the padded width only gates the MXU
+        # kernel's own int32-accumulator check (P*k <= 2^17)
+        if (not bounds_ok or rnd.pa.shape[1] * k > 1 << 17
+                or safe_exact_bound(a.val_bound, b.val_bound,
+                                    rnd.max_fanout, k) is None):
+            return numeric_exact, False
+        return numeric_mxu, True
+
+    return numeric_exact, max_entries, default_rs, choose_numeric
+
+
 def spgemm_device(a, b, *, round_size: int | None = None,
                   backend: str | None = None):
     """C = A x B with reference-exact semantics, tiles staying in HBM.
@@ -179,36 +216,9 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     out_bound = (1 << 64) - 2  # any backend's outputs are mod-collapsed
     choose_numeric = None  # per-round dispatcher (hybrid only)
     if backend == "hybrid":
-        # Per-ROUND dispatch: rounds are bucketed by fanout class
-        # (plan_rounds) and the bit-exactness proof depends on the fanout,
-        # so each round independently runs MXU field mode when provably
-        # equal to the reference fold (no product or partial sum can reach
-        # 2^64-1 at that fanout) and the exact VPU/XLA kernel otherwise.
-        # One huge-fanout key no longer forces the whole multiply off the
-        # MXU.  Every key is computed whole by one kernel, so the mixed
-        # result is bit-exact regardless of the split.
         from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
 
-        exact_name = resolve_backend(None)
-        numeric_exact, max_entries, default_rs = _select_numeric(exact_name, a, b)
-        numeric_mxu, mxu_entries, _ = _select_numeric("mxu", a, b)
-        # plan under the tighter budget so both kernels accept every round
-        if mxu_entries is not None and (max_entries is None
-                                        or mxu_entries < max_entries):
-            max_entries = mxu_entries
-        bounds_ok = a.val_bound is not None and b.val_bound is not None
-
-        def choose_numeric(rnd):  # noqa: F811 -- the hybrid dispatcher
-            # proof at the round's REAL max fanout (padded sentinel pairs
-            # contribute exactly 0); the padded width only gates the MXU
-            # kernel's own int32-accumulator check (P*k <= 2^17)
-            if (not bounds_ok or rnd.pa.shape[1] * k > 1 << 17
-                    or safe_exact_bound(a.val_bound, b.val_bound,
-                                        rnd.max_fanout, k) is None):
-                return numeric_exact, False
-            return numeric_mxu, True
-
-        numeric = numeric_exact  # placeholder; per-round choice below
+        numeric, max_entries, default_rs, choose_numeric = _hybrid_setup(a, b, k)
     else:
         numeric, max_entries, default_rs = _select_numeric(backend, a, b)
     round_size = default_rs if round_size is None else round_size
@@ -296,8 +306,9 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     i+1's host-side gather and upload overlap round i's device execution.
 
     Semantics, ordering, and output structure are identical to spgemm
-    (reference wrap-then-mod, SURVEY.md section 2.9); 'hybrid' dispatch is
-    not supported here (use xla / pallas / mxu).
+    (reference wrap-then-mod, SURVEY.md section 2.9), including per-round
+    'hybrid' dispatch (exact host-side value bounds feed the same proof as
+    the resident pipeline's).
     """
     from types import SimpleNamespace  # noqa: PLC0415
 
@@ -309,19 +320,17 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     if a.k != b.k:
         raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
     backend = resolve_backend(backend)
-    if backend == "hybrid":
-        raise ValueError("hybrid dispatch is not supported out-of-core; "
-                         "use backend='xla', 'pallas', or 'mxu'")
     k = a.k
     with timers.phase("symbolic_join"):
         join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:
         return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k)
 
-    # val_bound for the MXU limb-grid selection (host matrices don't track
-    # bounds the way DeviceBlockMatrix does -- compute them here, it's one
-    # pass over each slab and only the mxu backend reads them)
-    if backend == "mxu":
+    # val_bound for the MXU limb-grid selection and the hybrid proof (host
+    # matrices don't track bounds the way DeviceBlockMatrix does -- compute
+    # the EXACT slab maxima here; one pass each, and only the backends that
+    # read them pay for it)
+    if backend in ("mxu", "hybrid"):
         bound = SimpleNamespace(val_bound=int(a.tiles.max()) if a.nnzb else 0), \
                 SimpleNamespace(val_bound=int(b.tiles.max()) if b.nnzb else 0)
     else:
@@ -330,7 +339,11 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     # budget -- huge-fanout classes must still shrink their key chunks), but
     # bound every round by round_size keys (the reference's small_size):
     # capacity, not launch width, is the point here
-    numeric, max_entries, _ = _select_numeric(backend, *bound)
+    choose_numeric = None
+    if backend == "hybrid":
+        numeric, max_entries, _, choose_numeric = _hybrid_setup(*bound, k)
+    else:
+        numeric, max_entries, _ = _select_numeric(backend, *bound)
     round_size = 512 if round_size is None else round_size
 
     with timers.phase("plan_rounds"):
@@ -357,9 +370,12 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         b_sub[: len(ub)] = b.tiles[ub]
         ah, al = u64.u64_to_hilo(a_sub)
         bh, bl = u64.u64_to_hilo(b_sub)
-        return numeric(jnp.asarray(ah), jnp.asarray(al),
-                       jnp.asarray(bh), jnp.asarray(bl),
-                       jnp.asarray(sub_pa), jnp.asarray(sub_pb))
+        fn, used_mxu = (numeric, False) if choose_numeric is None \
+            else choose_numeric(rnd)
+        out = fn(jnp.asarray(ah), jnp.asarray(al),
+                 jnp.asarray(bh), jnp.asarray(bl),
+                 jnp.asarray(sub_pa), jnp.asarray(sub_pb))
+        return out, used_mxu
 
     out_tiles = np.zeros((join.num_keys, k, k), np.uint64)
 
@@ -370,11 +386,13 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         out_tiles[key_index] = u64.hilo_to_u64(np.asarray(oh[:n]),
                                                np.asarray(ol[:n]))
 
+    mxu_rounds = 0
     in_flight: list = []  # [(out_hi, out_lo, key_index)] -- depth 2: round
     # i+1 stages while round i executes; landing blocks only on round i
     for rnd in rounds:
         with timers.phase("numeric_dispatch"):
-            oh, ol = stage(rnd)
+            (oh, ol), used_mxu = stage(rnd)
+            mxu_rounds += used_mxu
         in_flight.append((oh, ol, rnd.key_index))
         if len(in_flight) > 1:
             with timers.phase("assembly"):
@@ -384,8 +402,10 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
             land(*entry)
 
     total_pairs = int(join.pair_ptr[-1])
+    tag = backend if choose_numeric is None \
+        else f"hybrid mxu={mxu_rounds}/{len(rounds)}"
     log.info("spgemm[%s,out-of-core]: nnzb %d x %d -> keys=%d pairs=%d "
-             "rounds=%d work=%.3f GFLOP", backend, a.nnzb, b.nnzb,
+             "rounds=%d work=%.3f GFLOP", tag, a.nnzb, b.nnzb,
              join.num_keys, total_pairs, len(rounds),
              2.0 * total_pairs * k ** 3 / 1e9)
     return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k,

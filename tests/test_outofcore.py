@@ -76,11 +76,29 @@ def test_outofcore_empty_result():
     assert got.nnzb == 0 and got.rows == 8 and got.cols == 8
 
 
-def test_outofcore_rejects_hybrid():
-    rng = np.random.default_rng(17)
-    a = random_block_sparse(4, 4, 2, 0.5, rng, "small")
-    with pytest.raises(ValueError, match="hybrid"):
-        spgemm_outofcore(a, a, backend="hybrid")
+@pytest.mark.parametrize("dist", ["small", "full"])
+def test_outofcore_hybrid_dispatch(dist, caplog):
+    """Hybrid out-of-core: small values prove every round onto the MXU
+    path, full-range values fail the proof and run the exact kernel --
+    both must match the oracle bit-for-bit, and the structured log must
+    show the split actually happened (a silent degrade to exact-only
+    dispatch would still pass a parity-only check)."""
+    import logging
+    import re
+
+    rng = np.random.default_rng(17 + len(dist))
+    a = random_block_sparse(6, 6, 4, 0.5, rng, dist)
+    b = random_block_sparse(6, 6, 4, 0.5, rng, dist)
+    with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
+        got = spgemm_outofcore(a, b, backend="hybrid")
+    assert got == _oracle(a, b)
+    m = re.search(r"hybrid mxu=(\d+)/(\d+)", caplog.text)
+    assert m, f"no hybrid dispatch tag in log: {caplog.text!r}"
+    mxu, total = int(m.group(1)), int(m.group(2))
+    if dist == "small":      # bounds < 2^16: every round proves onto the MXU
+        assert mxu == total > 0
+    else:                    # full-range u64: no round can prove exact
+        assert mxu == 0 and total > 0
 
 
 def test_outofcore_uploads_are_subslab_sized(monkeypatch):
