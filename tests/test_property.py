@@ -1,0 +1,148 @@
+"""Property-based tests (SURVEY.md section 4: "property tests (hypothesis)
+random block-sparse chains vs the oracle").
+
+Strategies generate adversarial uint64 values (0, 1, 2^32 boundaries,
+2^64-1 -- the wrap-then-mod quirk's trigger set, SURVEY.md section 2.9)
+alongside uniform randoms, random block structures including empty and
+duplicate-free coordinate sets, and short chains.  Each property pins a
+layer of the engine against an independent implementation:
+
+  * u64 limb arithmetic vs python ints (arbitrary 64-bit operands);
+  * symbolic_join vs a dict-based brute-force join (arbitrary structures);
+  * single SpGEMM and full chain_product vs the python-int oracle;
+  * text format round-trip identity.
+
+Example counts are kept small: each engine call jit-compiles on first use
+and the suite must stay CI-fast; the adversarial example pool is seeded
+into every run via the `examples` heuristics below.
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from spgemm_tpu.ops import u64
+from spgemm_tpu.ops.symbolic import symbolic_join
+from spgemm_tpu.ops.spgemm import spgemm
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.utils import io_text
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.semantics import chain_oracle, scalar_mac, spgemm_oracle
+
+MAX = (1 << 64) - 1
+# the §2.9 trigger set: values whose products/sums straddle 2^32/2^64 wraps
+EDGE = [0, 1, 2, (1 << 32) - 1, 1 << 32, (1 << 32) + 1,
+        (1 << 63) - 1, 1 << 63, MAX - 2, MAX - 1, MAX]
+
+u64_values = st.one_of(st.sampled_from(EDGE),
+                       st.integers(min_value=0, max_value=MAX))
+
+
+@st.composite
+def block_matrices(draw, max_dim=4, k=2, dim=None):
+    """A BlockSparseMatrix with arbitrary (deduplicated) structure and
+    edge-heavy values.  dim fixes the block dimension (multiplication-
+    compatible chains share one dim, like utils/gen.random_chain)."""
+    if dim is None:
+        dim = draw(st.integers(min_value=1, max_value=max_dim))
+    coords = draw(st.lists(
+        st.tuples(st.integers(0, dim - 1), st.integers(0, dim - 1)),
+        min_size=0, max_size=dim * dim, unique=True))
+    tiles = np.array(
+        [[[draw(u64_values) for _ in range(k)] for _ in range(k)]
+         for _ in coords], dtype=np.uint64).reshape(len(coords), k, k)
+    return BlockSparseMatrix.from_blocks(
+        rows=dim * k, cols=dim * k, k=k,
+        coords=np.array(sorted(coords), np.int64).reshape(-1, 2),
+        tiles=tiles if len(coords) else np.zeros((0, k, k), np.uint64))
+
+
+@st.composite
+def matrix_pairs(draw, max_dim=4, k=2):
+    """A multiplication-compatible (square, shared-dim) matrix pair."""
+    dim = draw(st.integers(min_value=1, max_value=max_dim))
+    return (draw(block_matrices(k=k, dim=dim)),
+            draw(block_matrices(k=k, dim=dim)))
+
+
+@st.composite
+def matrix_chains(draw, max_dim=3, k=2):
+    """A multiplication-compatible chain of 2-4 matrices."""
+    dim = draw(st.integers(min_value=1, max_value=max_dim))
+    n = draw(st.integers(min_value=2, max_value=4))
+    return [draw(block_matrices(k=k, dim=dim)) for _ in range(n)]
+
+
+@settings(max_examples=200, deadline=None)
+@given(a=u64_values, b=u64_values, acc=u64_values)
+def test_u64_mac_matches_python_ints(a, b, acc):
+    """One contraction step (acc = addmod(acc, mulmod(a, b))) of the limb
+    arithmetic vs exact python ints -- the §2.9 wrap-then-mod sequence."""
+    ah, al = u64.u64_to_hilo(np.array([a], np.uint64))
+    bh, bl = u64.u64_to_hilo(np.array([b], np.uint64))
+    ch, cl = u64.u64_to_hilo(np.array([acc], np.uint64))
+    rh, rl = u64.mac(ch, cl, ah, al, bh, bl)
+    got = int(u64.hilo_to_u64(np.asarray(rh), np.asarray(rl))[0])
+    assert got == scalar_mac(acc, a, b)  # the one reference-fold definition
+
+
+@settings(max_examples=200, deadline=None)
+@given(a=u64_values, b=u64_values)
+def test_u64_field_mulmod_is_true_residue(a, b):
+    """Field mode must be the mathematically-correct mod-(2^64-1) residue
+    for ALL operands (it is the associative arithmetic the cross-device
+    reductions rely on)."""
+    ah, al = u64.u64_to_hilo(np.array([a], np.uint64))
+    bh, bl = u64.u64_to_hilo(np.array([b], np.uint64))
+    rh, rl = u64.mulmod_field(ah, al, bh, bl)
+    got = int(u64.hilo_to_u64(np.asarray(rh), np.asarray(rl))[0])
+    assert got == (a * b) % MAX  # true residue, canonical rep in [0, MAX-1]
+
+
+@settings(max_examples=50, deadline=None)
+@given(ab=matrix_pairs())
+def test_symbolic_join_vs_bruteforce(ab):
+    """Join structure + per-key pair lists vs a dict brute force."""
+    a, b = ab
+    join = symbolic_join(a.coords, b.coords)
+    brute: dict = {}
+    for ia, (r, j) in enumerate(a.coords):
+        for ib, (jb, c) in enumerate(b.coords):
+            if j == jb:
+                brute.setdefault((int(r), int(c)), []).append((ia, ib))
+    assert sorted(brute.keys()) == [tuple(x) for x in join.keys.tolist()]
+    for ki, key in enumerate(join.keys.tolist()):
+        lo, hi = join.pair_ptr[ki], join.pair_ptr[ki + 1]
+        got_pairs = list(zip(join.pair_a[lo:hi].tolist(),
+                             join.pair_b[lo:hi].tolist()))
+        # j-ascending order == sorted by (a slab index, b slab index) here
+        # because coords are lex-sorted
+        assert got_pairs == sorted(brute[tuple(key)])
+
+
+@settings(max_examples=15, deadline=None)
+@given(ab=matrix_pairs())
+def test_spgemm_vs_oracle(ab):
+    a, b = ab
+    got = spgemm(a, b, backend="xla")
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+    assert got == want
+
+
+@settings(max_examples=8, deadline=None)
+@given(mats=matrix_chains())
+def test_chain_vs_oracle(mats):
+    got = chain_product(mats, backend="xla")
+    want = BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, mats[0].k,
+        chain_oracle([m.to_dict() for m in mats], mats[0].k))
+    assert got == want
+
+
+@settings(max_examples=25, deadline=None)
+@given(m=block_matrices())
+def test_text_format_roundtrip(m, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("prop") / "m")
+    io_text.write_matrix(path, m)
+    back = io_text.read_matrix(path, m.k)
+    assert back == m
