@@ -24,7 +24,8 @@ jax -- the CLI must be able to pin JAX_PLATFORMS before jax is imported.
 
 __version__ = "0.1.0"
 
-__all__ = ["BlockSparseMatrix", "spgemm", "chain_product", "__version__"]
+__all__ = ["BlockSparseMatrix", "spgemm", "spgemm_outofcore", "chain_product",
+           "__version__"]
 
 
 def __getattr__(name):
@@ -34,6 +35,9 @@ def __getattr__(name):
     if name == "spgemm":
         from spgemm_tpu.ops.spgemm import spgemm
         return spgemm
+    if name == "spgemm_outofcore":
+        from spgemm_tpu.ops.spgemm import spgemm_outofcore
+        return spgemm_outofcore
     if name == "chain_product":
         from spgemm_tpu.chain import chain_product
         return chain_product
