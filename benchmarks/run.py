@@ -435,18 +435,22 @@ def _extra_rows():
     return rows
 
 
-def write_table(rows):
+def write_table(rows, path=None):
     # merge best-effort evidence rows: a real captured row replaces the
     # core run's --skip placeholder for the same config
     rows = list(rows)
     for extra in _extra_rows():
         for i, r in enumerate(rows):
             if r.get("config") == extra.get("config"):
-                rows[i] = extra
+                # replace PLACEHOLDERS only: a freshly measured (or error)
+                # row must never be overwritten by stale evidence
+                if "skipped" in r:
+                    rows[i] = extra
                 break
         else:
             rows.append(extra)
-    path = os.path.join(REPO, "benchmarks", "RESULTS.md")
+    if path is None:
+        path = os.path.join(REPO, "benchmarks", "RESULTS.md")
     lines = ["# Benchmark suite results (BASELINE.json configs, synthesized)",
              "",
              "Regenerate: `python benchmarks/run.py --write-table`", "",

@@ -77,6 +77,48 @@ def test_bench_emits_json_and_rc0_on_internal_failure():
     assert "error" in row["detail"]
 
 
+def test_suite_skip_flag():
+    """--skip yields a placeholder row, runs nothing, exits 0."""
+    rc = _run([os.path.join("benchmarks", "run.py"),
+               "--config", "loader-scaling", "--skip", "loader-scaling",
+               "--device", "cpu"])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads(rc.stdout.strip().splitlines()[-1])
+    assert row["config"] == "loader-scaling" and "skipped" in row
+
+
+def test_write_table_merges_extras(tmp_path, monkeypatch):
+    """A best-effort row from the evidence dir replaces the --skip
+    placeholder of the same config (tpu_evidence.sh's isolation contract)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_run", os.path.join(REPO, "benchmarks", "run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    extra = {"config": "webbase-1Mrow", "backend": "pallas", "platform": "tpu",
+             "wall_s": 0.9, "effective_gflops": 33.0,
+             "value_parity_sampled": True, "parity_tiles_checked": 64}
+    (tmp_path / "extras.jsonl").write_text(json.dumps(extra) + "\n")
+    monkeypatch.setenv("SPGEMM_TPU_EVIDENCE_DIR", str(tmp_path))
+
+    out = tmp_path / "RESULTS.md"
+    mod.write_table([{"config": "webbase-1Mrow", "skipped": "via --skip"}],
+                    path=str(out))
+    text = out.read_text()
+    assert "33.0" in text and "bit-exact (64 tiles sampled)" in text
+    assert "skipped" not in text  # the placeholder was replaced, not kept
+
+    # a freshly MEASURED row must never be overwritten by stale extras
+    fresh = {"config": "webbase-1Mrow", "backend": "pallas", "platform": "tpu",
+             "wall_s": 0.5, "effective_gflops": 60.0,
+             "value_parity_sampled": True, "parity_tiles_checked": 64}
+    mod.write_table([fresh], path=str(out))
+    text = out.read_text()
+    assert "60.0" in text and "33.0" not in text
+
+
 def test_suite_rc_nonzero_on_config_error(tmp_path):
     """A crashing config yields an error row AND a nonzero exit."""
     code = (
