@@ -11,11 +11,18 @@ FOLDER  ?=
 RANKS   ?= 1
 BACKEND ?= xla
 SHARD   ?= none
+# memory mode: resident | stream (host partials) | outofcore (per-round staging)
+MEM     ?= resident
+
+MEMFLAG_resident  =
+MEMFLAG_stream    = --stream
+MEMFLAG_outofcore = --out-of-core
+MEMFLAG = $(MEMFLAG_$(MEM))
 
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test bench warm clean
+.PHONY: all native run test bench bench-large warm clean
 
 all: native
 
@@ -28,12 +35,15 @@ $(NATIVE_SO): $(NATIVE_SRC)
 # DEVICE=cpu forces the CPU backend.
 run:
 ifeq ($(FOLDER),)
-	$(error usage: make run FOLDER=<input dir> [DEVICE=tpu|cpu] [RANKS=P] [BACKEND=xla|pallas] [SHARD=none|keys|inner])
+	$(error usage: make run FOLDER=<input dir> [DEVICE=tpu|cpu] [RANKS=P] [BACKEND=xla|pallas] [SHARD=none|keys|inner] [MEM=resident|stream|outofcore])
+endif
+ifeq ($(filter $(MEM),resident stream outofcore),)
+	$(error unknown MEM='$(MEM)' (use resident, stream, or outofcore))
 endif
 ifeq ($(DEVICE),tpu)
-	$(PY) -m spgemm_tpu.cli $(FOLDER) --backend $(BACKEND) --shard $(SHARD) --ranks $(RANKS)
+	$(PY) -m spgemm_tpu.cli $(FOLDER) --backend $(BACKEND) --shard $(SHARD) --ranks $(RANKS) $(MEMFLAG)
 else
-	$(PY) -m spgemm_tpu.cli $(FOLDER) --device $(DEVICE) --backend $(BACKEND) --shard $(SHARD) --ranks $(RANKS)
+	$(PY) -m spgemm_tpu.cli $(FOLDER) --device $(DEVICE) --backend $(BACKEND) --shard $(SHARD) --ranks $(RANKS) $(MEMFLAG)
 endif
 
 test:
@@ -41,6 +51,10 @@ test:
 
 bench:
 	$(PY) bench.py
+
+# the reference's Large scale (1M tiles) through the out-of-core pipeline
+bench-large:
+	$(PY) bench.py --preset large
 
 # AOT-populate the persistent compile cache for the bench's round-shape
 # ladder so a cold cache never contaminates (or zeroes) a timed run.

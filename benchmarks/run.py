@@ -422,17 +422,20 @@ def _extra_rows():
     ev_dir = os.environ.get("SPGEMM_TPU_EVIDENCE_DIR",
                             os.path.join(REPO, "benchmarks", "evidence"))
     path = os.path.join(ev_dir, "extras.jsonl")
-    rows = []
+    by_config: dict = {}
     if os.path.exists(path):
         with open(path) as f:
             for ln in f:
                 ln = ln.strip()
                 if ln.startswith("{"):
                     try:
-                        rows.append(json.loads(ln))
+                        row = json.loads(ln)
                     except json.JSONDecodeError:
-                        pass
-    return rows
+                        continue
+                    # appended file, newest capture last: last row per
+                    # config wins, so a re-capture supersedes stale rows
+                    by_config[row.get("config")] = row
+    return list(by_config.values())
 
 
 def write_table(rows, path=None):

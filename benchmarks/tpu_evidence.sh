@@ -50,7 +50,10 @@ echo "[5/6] best-effort big-scale runs"
 # final multiply, past one chip)
 timeout 3000 python bench.py --preset large 2>&1 | tee "$OUT/bench_large.txt" | tail -1 \
   || echo "large-scale bench did not complete (see bench_large.txt)"
-# webbase at its honest 1M-element-row scale, single chip
+# webbase at its honest 1M-element-row scale, single chip.  extras.jsonl
+# is truncated per capture like every other artifact here (write_table
+# also keeps only the newest row per config as a second guard).
+: > "$OUT/extras.jsonl"
 timeout 1200 python benchmarks/run.py --config webbase-1Mrow 2>&1 \
   | tee "$OUT/webbase_1mrow.txt" | tail -1 | grep '^{' >> "$OUT/extras.jsonl" \
   || echo "webbase-1Mrow did not complete (see webbase_1mrow.txt)"

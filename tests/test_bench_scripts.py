@@ -97,10 +97,13 @@ def test_write_table_merges_extras(tmp_path, monkeypatch):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
 
+    stale = {"config": "webbase-1Mrow", "error": "hung on first capture"}
     extra = {"config": "webbase-1Mrow", "backend": "pallas", "platform": "tpu",
              "wall_s": 0.9, "effective_gflops": 33.0,
              "value_parity_sampled": True, "parity_tiles_checked": 64}
-    (tmp_path / "extras.jsonl").write_text(json.dumps(extra) + "\n")
+    # appended file across captures: the NEWEST row per config must win
+    (tmp_path / "extras.jsonl").write_text(
+        json.dumps(stale) + "\n" + json.dumps(extra) + "\n")
     monkeypatch.setenv("SPGEMM_TPU_EVIDENCE_DIR", str(tmp_path))
 
     out = tmp_path / "RESULTS.md"
