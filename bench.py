@@ -131,7 +131,66 @@ def _init_platform(args) -> str:
     return jax.devices()[0].platform
 
 
+def _failure_row(error: str) -> str:
+    """The driver-contract failure payload -- ONE definition shared by the
+    inner except branch and the outer supervisor."""
+    return json.dumps({
+        "metric": "chain_multiply_wall_clock_failed",
+        "value": None, "unit": "s", "vs_baseline": None,
+        "detail": {"error": error},
+    })
+
+
+def _outer() -> int:
+    """Self-wrap: run the real bench as a child with a hard kill budget.
+
+    The probe (below) guards hangs at backend INIT, but the tunnel can die
+    mid-run too -- and that hang sits in an uninterruptible C call, beyond
+    any in-process signal handler.  The parent is pure Python: it inherits
+    stdout (progress lines and, on success, the child's JSON flow through
+    untouched) and on timeout SIGKILLs the child and emits the failure
+    JSON itself, so the driver ALWAYS sees rc=0 and a final JSON line.
+    SPGEMM_TPU_BENCH_TIMEOUT overrides the 2700 s default budget.
+    """
+    import signal
+    import subprocess
+
+    budget = float(os.environ.get("SPGEMM_TPU_BENCH_TIMEOUT", "2700"))
+    env = {**os.environ, "SPGEMM_TPU_BENCH_INNER": "1"}
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                             *sys.argv[1:]], env=env)
+
+    def _forward_kill(signum, frame):
+        # if something (e.g. the evidence script's `timeout`) terminates the
+        # parent, the hung child must not be left orphaned and running
+        proc.kill()
+        try:
+            proc.wait(timeout=5)  # reap -- no zombie left behind
+        except Exception:  # noqa: BLE001
+            pass
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _forward_kill)
+    signal.signal(signal.SIGINT, _forward_kill)
+    try:
+        rc = proc.wait(timeout=budget)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        print(_failure_row(f"bench exceeded {budget:.0f}s budget "
+                           "(device hang mid-run?); killed"), flush=True)
+        return 0
+    if rc < 0:
+        # child died on a signal (plugin segfault, OOM kill): the inner
+        # except clause never ran, so the contract JSON must come from here
+        print(_failure_row(f"bench child killed by signal {-rc}"), flush=True)
+        return 0
+    return rc
+
+
 def main() -> int:
+    if not os.environ.get("SPGEMM_TPU_BENCH_INNER"):
+        return _outer()
     p = argparse.ArgumentParser()
     p.add_argument("--chain", type=int, default=10, help="chain length N")
     p.add_argument("--block-dim", type=int, default=None,
@@ -177,11 +236,7 @@ def main() -> int:
     except Exception as e:  # noqa: BLE001 -- emit the JSON line no matter what
         import traceback
         traceback.print_exc()
-        print(json.dumps({
-            "metric": "chain_multiply_wall_clock_failed",
-            "value": None, "unit": "s", "vs_baseline": None,
-            "detail": {"error": repr(e)},
-        }))
+        print(_failure_row(repr(e)), flush=True)
         return 0
 
 

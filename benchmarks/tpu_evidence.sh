@@ -26,13 +26,16 @@ fi
 fail=0
 
 echo "[2/6] bench warm (compile cache)"
-timeout 900 python bench.py --warm 2>&1 | tee "$OUT/warm.txt" | tail -2 || fail=1
+# bench.py self-wraps with a kill budget (SPGEMM_TPU_BENCH_TIMEOUT); keep
+# it below each step's `timeout` so the wrapper -- which emits the failure
+# JSON and reaps the child -- always fires first
+SPGEMM_TPU_BENCH_TIMEOUT=850 timeout 900 python bench.py --warm 2>&1 | tee "$OUT/warm.txt" | tail -2 || fail=1
 # bench.py's driver contract forces rc=0 even on internal failure -- detect
 # the failure through the emitted JSON instead
 grep -q '"warmed": true' "$OUT/warm.txt" || fail=1
 
 echo "[3/6] bench headline"
-timeout 900 python bench.py 2>&1 | tee "$OUT/bench.txt" | tail -1 || fail=1
+SPGEMM_TPU_BENCH_TIMEOUT=850 timeout 900 python bench.py 2>&1 | tee "$OUT/bench.txt" | tail -1 || fail=1
 grep -q 'chain_multiply_wall_clock_failed' "$OUT/bench.txt" && fail=1
 
 # sweep BEFORE the suite: run.py --write-table embeds $OUT/sweep.txt into
@@ -48,7 +51,8 @@ echo "[5/6] best-effort big-scale runs"
 # the reference's Large scale (1M tiles, 320.5 s baseline) via the
 # out-of-core pipeline (the resident pipeline needs ~22 GB HBM at the
 # final multiply, past one chip)
-timeout 3000 python bench.py --preset large 2>&1 | tee "$OUT/bench_large.txt" | tail -1 \
+SPGEMM_TPU_BENCH_TIMEOUT=2900 timeout 3000 python bench.py --preset large 2>&1 \
+  | tee "$OUT/bench_large.txt" | tail -1 \
   || echo "large-scale bench did not complete (see bench_large.txt)"
 # webbase at its honest 1M-element-row scale, single chip.  extras.jsonl
 # is truncated per capture like every other artifact here (write_table

@@ -77,6 +77,27 @@ def test_bench_emits_json_and_rc0_on_internal_failure():
     assert "error" in row["detail"]
 
 
+def test_bench_outer_budget_kills_and_emits_json():
+    """The self-wrapping outer process: when the inner bench exceeds the
+    kill budget (the mid-run device-hang mode no in-process handler can
+    escape), the outer SIGKILLs it and still emits the failure JSON with
+    rc=0 -- the driver contract under every observed failure mode."""
+    # budget 1 s: even interpreter start + jax import exceeds it, and the
+    # medium-scale default workload takes minutes on CPU -- the kill path
+    # fires deterministically regardless of host speed or warm caches
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "SPGEMM_TPU_BENCH_TIMEOUT": "1",
+           "PYTHONPATH": REPO + ":" + os.environ.get("PYTHONPATH", "")}
+    rc = subprocess.run(
+        [sys.executable, "bench.py", "--device", "cpu"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads([ln for ln in rc.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert row["metric"] == "chain_multiply_wall_clock_failed"
+    assert "budget" in row["detail"]["error"]
+
+
 def test_suite_skip_flag():
     """--skip yields a placeholder row, runs nothing, exits 0."""
     rc = _run([os.path.join("benchmarks", "run.py"),
