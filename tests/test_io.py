@@ -1,6 +1,7 @@
 """Text format reader/writer: roundtrip, byte-exactness, std::map semantics."""
 
 import numpy as np
+import pytest
 
 from spgemm_tpu.utils import io_text
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
@@ -73,6 +74,40 @@ def test_empty_matrix(tmp_path):
     assert m.nnzb == 0
     io_text.write_matrix(str(tmp_path / "out"), m)
     assert (tmp_path / "out").read_bytes() == b"8 8\n0\n"
+
+
+def test_missing_file_raises_filenotfound(tmp_path):
+    """Both parser paths (native rc=-1, python open) must raise
+    FileNotFoundError for a missing file -- the reference prints an error
+    and exits (sparse_matrix_mult.cu:346-349)."""
+    with pytest.raises(FileNotFoundError):
+        io_text.read_matrix(str(tmp_path / "nope"), 2)
+    with pytest.raises(FileNotFoundError):
+        io_text.read_size(str(tmp_path))
+
+
+@pytest.mark.parametrize("text,why", [
+    ("", "empty file"),
+    ("2 2\n", "header only, no block count"),
+    ("2 2\n1\n0 0\n1 2\n3\n", "truncated tile data"),
+    ("2 2\n2\n0 0\n1 2\n3 4\n", "block count larger than data"),
+])
+def test_malformed_matrix_raises_valueerror(tmp_path, monkeypatch, text, why):
+    """Malformed inputs must raise ValueError on BOTH parser paths (the
+    native tokenizer and the numpy fallback must agree on rejection)."""
+    path = tmp_path / "m"
+    path.write_text(text)
+    with pytest.raises(ValueError):
+        io_text.read_matrix(str(path), 2)
+    monkeypatch.setenv("SPGEMM_TPU_NO_NATIVE", "1")
+    with pytest.raises(ValueError):
+        io_text.read_matrix(str(path), 2)
+
+
+def test_malformed_size_file(tmp_path):
+    (tmp_path / "size").write_text("3\n")
+    with pytest.raises(ValueError):
+        io_text.read_size(str(tmp_path))
 
 
 def test_prune_zeros():
