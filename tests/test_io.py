@@ -76,10 +76,13 @@ def test_empty_matrix(tmp_path):
     assert (tmp_path / "out").read_bytes() == b"8 8\n0\n"
 
 
-def test_missing_file_raises_filenotfound(tmp_path):
+def test_missing_file_raises_filenotfound(tmp_path, monkeypatch):
     """Both parser paths (native rc=-1, python open) must raise
     FileNotFoundError for a missing file -- the reference prints an error
     and exits (sparse_matrix_mult.cu:346-349)."""
+    with pytest.raises(FileNotFoundError):
+        io_text.read_matrix(str(tmp_path / "nope"), 2)
+    monkeypatch.setenv("SPGEMM_TPU_NO_NATIVE", "1")
     with pytest.raises(FileNotFoundError):
         io_text.read_matrix(str(tmp_path / "nope"), 2)
     with pytest.raises(FileNotFoundError):
