@@ -124,15 +124,10 @@ def run(argv: list[str] | None = None) -> int:
         # Maximum-survivability mode: the observed accelerator failure mode
         # is a HANG at backend init (utils/backend_probe), which no
         # in-process handler can escape -- probe in a subprocess first and
-        # start on CPU if the accelerator is dead.
-        import sys as _sys
-
-        from spgemm_tpu.utils.backend_probe import pin, probe_default_backend
-        if probe_default_backend() != "ok":
-            # stderr: stdout keeps reference parity (`multiplying`/`time taken`)
-            print("accelerator unreachable; --failover starts on cpu",
-                  file=_sys.stderr, flush=True)
-            pin("cpu")
+        # start on CPU if the accelerator is dead.  (stderr only: stdout
+        # keeps reference parity -- `multiplying` / `time taken` lines.)
+        from spgemm_tpu.utils.backend_probe import failover_to_cpu
+        failover_to_cpu("--failover")
     logging.basicConfig(
         level=logging.INFO if args.verbose else logging.WARNING,
         format="%(name)s %(message)s",

@@ -36,6 +36,32 @@ def probe_default_backend(timeout_s: float | None = None) -> str:
         return "timeout"
 
 
+def failover_to_cpu(context: str, attempts: int = 2) -> bool:
+    """Probe the default backend; on persistent failure pin the CPU
+    platform.  Returns True iff the failover happened.  The shared guard
+    used by the CLI's --failover and the driver-contract entry() (bench.py
+    keeps its own richer retry/shrink logic).
+
+    - Already pinned to cpu: nothing to probe, returns False immediately.
+    - 'error' outcomes retry (a raise can be a transient tunnel blip);
+      'timeout' does not (the observed hang mode persists for hours --
+      re-probing burns 150 s per attempt for nothing).
+    """
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        return False
+    outcome = "error"
+    for _ in range(max(1, attempts)):
+        outcome = probe_default_backend()
+        if outcome in ("ok", "cpu"):
+            return False
+        if outcome == "timeout":
+            break
+    print(f"{context}: accelerator unreachable (probe: {outcome}); "
+          "falling back to cpu", file=sys.stderr, flush=True)
+    pin("cpu")
+    return True
+
+
 def pin(platform: str) -> None:
     """Pin the JAX platform in-process.  The env var alone is ineffective
     here: the TPU plugin's sitecustomize imports jax at interpreter start
