@@ -318,8 +318,7 @@ def _run(args) -> int:
             return spgemm_outofcore(a, b, backend=backend,
                                     round_size=args.round_size)
 
-        def run_single(a, b):
-            return mul(a, b)  # landing the last round already blocks
+        run_single = mul  # landing the last round already blocks
     else:
         srcs = dmats
 

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import argparse
 import logging
-import os
 import sys
 import time
 
@@ -109,17 +108,11 @@ def run(argv: list[str] | None = None) -> int:
               "resident; --out-of-core per-round staging does not apply to "
               "the sharded multiplies", file=sys.stderr, flush=True)
     if args.device:
-        os.environ["JAX_PLATFORMS"] = args.device
-        # If an embedding (e.g. a TPU plugin's sitecustomize) already imported
-        # jax, the env var alone is too late -- the config default was
-        # snapshotted at import.  Updating the config still works as long as
-        # no backend has been initialized.
-        import sys as _sys
-        if "jax" in _sys.modules:
-            import jax
-            from jax._src import xla_bridge
-            if not xla_bridge._backends:
-                jax.config.update("jax_platforms", args.device)
+        # env var + in-process config update: the TPU plugin's sitecustomize
+        # imports jax at interpreter start and snapshots JAX_PLATFORMS, so
+        # the env var alone is too late (utils/backend_probe.pin docs)
+        from spgemm_tpu.utils.backend_probe import pin
+        pin(args.device)
     elif args.failover:
         # Maximum-survivability mode: the observed accelerator failure mode
         # is a HANG at backend init (utils/backend_probe), which no

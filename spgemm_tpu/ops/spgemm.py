@@ -216,8 +216,6 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     out_bound = (1 << 64) - 2  # any backend's outputs are mod-collapsed
     choose_numeric = None  # per-round dispatcher (hybrid only)
     if backend == "hybrid":
-        from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
-
         numeric, max_entries, default_rs, choose_numeric = _hybrid_setup(a, b, k)
     else:
         numeric, max_entries, default_rs = _select_numeric(backend, a, b)
@@ -268,8 +266,9 @@ def spgemm_device(a, b, *, round_size: int | None = None,
         if mxu_rounds == len(rounds):
             # every round ran under a proof: the tighter propagated bound
             # feeds the NEXT multiply's proof (chain products stay on the
-            # MXU as long as the bounds hold); safe_exact_bound is already
-            # in scope from the hybrid branch above
+            # MXU as long as the bounds hold)
+            from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
+
             proven = safe_exact_bound(a.val_bound, b.val_bound,
                                       int(join.fanouts.max()), k)
             if proven is not None:
