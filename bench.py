@@ -177,13 +177,16 @@ def _outer() -> int:
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.wait()
-        print(_failure_row(f"bench exceeded {budget:.0f}s budget "
-                           "(device hang mid-run?); killed"), flush=True)
+        # leading newline: the killed child may have died mid-write without a
+        # trailing newline, and the JSON must start a fresh stdout line
+        print("\n" + _failure_row(f"bench exceeded {budget:.0f}s budget "
+                                  "(device hang mid-run?); killed"), flush=True)
         return 0
     if rc < 0:
         # child died on a signal (plugin segfault, OOM kill): the inner
         # except clause never ran, so the contract JSON must come from here
-        print(_failure_row(f"bench child killed by signal {-rc}"), flush=True)
+        print("\n" + _failure_row(f"bench child killed by signal {-rc}"),
+              flush=True)
         return 0
     return rc
 

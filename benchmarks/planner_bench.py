@@ -1,0 +1,71 @@
+"""Microbench for the host-side ring planner (parallel/ring.plan_ring).
+
+The planner must not become the serial bottleneck the ring layer exists to
+remove (the reference's O(P) host gather, sparse_matrix_mult.cu:460-556):
+at webbase-1Mrow scale the schedule covers ~1e5-1e6 keys, so the planner is
+required to stay vectorized -- no per-key Python.  Target: < 1 s wall at
+1e5 keys x 8 devices.
+
+Pure host-side numpy -- no jax backend is touched, safe to run anywhere.
+
+Usage: python benchmarks/planner_bench.py [--keys 100000] [--devices 8]
+Prints one JSON line: {"metric": "plan_ring_wall", "value": ..., ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spgemm_tpu.ops.symbolic import JoinResult
+from spgemm_tpu.parallel.ring import plan_ring
+
+
+def synth_join(n_keys: int, mean_fanout: int, nnzb_b: int,
+               seed: int = 0) -> JoinResult:
+    """A structurally realistic join: sorted keys, ragged per-key pair lists."""
+    rng = np.random.default_rng(seed)
+    fanouts = rng.integers(1, 2 * mean_fanout + 1, size=n_keys)
+    pair_ptr = np.zeros(n_keys + 1, dtype=np.int64)
+    np.cumsum(fanouts, out=pair_ptr[1:])
+    total = int(pair_ptr[-1])
+    side = int(np.ceil(np.sqrt(n_keys)))
+    keys = np.stack(np.divmod(np.arange(n_keys, dtype=np.int64), side), axis=1)
+    pair_a = rng.integers(0, nnzb_b, size=total, dtype=np.int64).astype(np.int32)
+    pair_b = rng.integers(0, nnzb_b, size=total, dtype=np.int64).astype(np.int32)
+    return JoinResult(keys=keys, pair_ptr=pair_ptr, pair_a=pair_a, pair_b=pair_b)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--keys", type=int, default=100_000)
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--fanout", type=int, default=8)
+    p.add_argument("--nnzb-b", type=int, default=100_000)
+    p.add_argument("--repeats", type=int, default=3)
+    args = p.parse_args()
+
+    join = synth_join(args.keys, args.fanout, args.nnzb_b)
+    best = float("inf")
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        plan_ring(join, args.nnzb_b, args.devices)
+        best = min(best, time.perf_counter() - t0)
+    print(json.dumps({
+        "metric": "plan_ring_wall", "value": round(best, 4), "unit": "s",
+        "vs_baseline": None,
+        "detail": {"keys": args.keys, "devices": args.devices,
+                   "pairs": int(join.pair_ptr[-1]), "target_s": 1.0},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

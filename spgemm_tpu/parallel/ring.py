@@ -54,39 +54,41 @@ def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
 
     # contiguous key ranges (keys are sorted by (row, col), so these are
     # row-range slabs of C)
-    key_bounds = [(d * n_keys) // n_dev for d in range(n_dev + 1)]
+    key_bounds = np.array([(d * n_keys) // n_dev for d in range(n_dev + 1)],
+                          dtype=np.int64)
     key_chunks = [np.arange(key_bounds[d], key_bounds[d + 1])
                   for d in range(n_dev)]
-    k_max = max(1, max(len(c) for c in key_chunks))
+    k_max = max(1, int(np.diff(key_bounds).max()))
 
+    # One scatter instead of a (device x slab x key) Python loop: each pair
+    # maps to a (key, slab) cell; a stable sort by cell id groups every
+    # cell's pairs contiguously while preserving their original j-ascending
+    # order within the cell (order inside a cell is what the field-mode
+    # fold contract leaves free, but keep it deterministic anyway).
+    pair_ptr = np.asarray(join.pair_ptr, dtype=np.int64)
+    key_of_pair = np.repeat(np.arange(n_keys, dtype=np.int64),
+                            np.diff(pair_ptr))
     # slab of each pair = which contiguous B chunk owns its B tile index
     slab_of_pair = np.searchsorted(slab_bounds, join.pair_b, side="right") - 1
 
-    # max pairs per (key, slab) cell
-    p_max = 1
-    cell_lists: list[list[tuple[np.ndarray, np.ndarray]]] = []
-    for d in range(n_dev):
-        per_slab: list[tuple[np.ndarray, np.ndarray]] = []
-        cell_lists.append(per_slab)
-    for d, chunk in enumerate(key_chunks):
-        for s in range(n_dev):
-            pas, pbs = [], []
-            for ki in chunk:
-                lo, hi = join.pair_ptr[ki], join.pair_ptr[ki + 1]
-                sel = slab_of_pair[lo:hi] == s
-                pas.append(join.pair_a[lo:hi][sel])
-                pbs.append(join.pair_b[lo:hi][sel] - slab_bounds[s])
-                p_max = max(p_max, int(sel.sum()))
-            cell_lists[d].append((pas, pbs))
+    cell = key_of_pair * n_dev + slab_of_pair
+    order = np.argsort(cell, kind="stable")
+    cell_counts = np.bincount(cell, minlength=n_keys * n_dev)
+    p_max = max(1, int(cell_counts.max())) if cell.size else 1
+    # position of each sorted pair within its cell = rank - cell start
+    cell_offsets = np.concatenate(([0], np.cumsum(cell_counts)))
+    pos = np.arange(cell.size, dtype=np.int64) - cell_offsets[cell[order]]
+
+    key_sorted = key_of_pair[order]
+    dev_of_pair = np.searchsorted(key_bounds, key_sorted, side="right") - 1
+    local_row = key_sorted - key_bounds[dev_of_pair]
+    slab_sorted = slab_of_pair[order]
 
     pa_all = np.full((n_dev, n_dev, k_max, p_max), -1, dtype=np.int32)
     pb_all = np.full((n_dev, n_dev, k_max, p_max), s_max, dtype=np.int32)
-    for d in range(n_dev):
-        for s in range(n_dev):
-            pas, pbs = cell_lists[d][s]
-            for row, (pa_row, pb_row) in enumerate(zip(pas, pbs)):
-                pa_all[d, s, row, : len(pa_row)] = pa_row
-                pb_all[d, s, row, : len(pb_row)] = pb_row
+    pa_all[dev_of_pair, slab_sorted, local_row, pos] = join.pair_a[order]
+    pb_all[dev_of_pair, slab_sorted, local_row, pos] = (
+        join.pair_b[order] - slab_bounds[slab_sorted])
     return key_chunks, slab_bounds, pa_all, pb_all, s_max
 
 

@@ -178,6 +178,44 @@ def test_ring_fewer_keys_than_devices():
     assert spgemm_ring(a, b) == want_m
 
 
+def test_plan_ring_packing_matches_naive_oracle():
+    """Pin the vectorized planner's packed layout cell by cell: every
+    (device, slab, local key) row must hold exactly that key's pairs whose B
+    tile falls in that slab, in their original order, sentinel-padded."""
+    from spgemm_tpu.ops.symbolic import JoinResult
+    from spgemm_tpu.parallel.ring import plan_ring
+
+    rng = np.random.default_rng(363)
+    n_keys, nnzb_b, n_dev = 37, 53, 8
+    fanouts = rng.integers(0, 7, size=n_keys)
+    fanouts[fanouts.argmax()] += 5  # one fat key to force p_max
+    pair_ptr = np.concatenate(([0], np.cumsum(fanouts))).astype(np.int64)
+    total = int(pair_ptr[-1])
+    side = 7
+    keys = np.stack(np.divmod(np.arange(n_keys, dtype=np.int64), side), axis=1)
+    pair_a = rng.integers(0, nnzb_b, size=total).astype(np.int32)
+    pair_b = rng.integers(0, nnzb_b, size=total).astype(np.int32)
+    join = JoinResult(keys=keys, pair_ptr=pair_ptr,
+                      pair_a=pair_a, pair_b=pair_b)
+
+    key_chunks, slab_bounds, pa_all, pb_all, s_max = plan_ring(
+        join, nnzb_b, n_dev)
+    slab_of_pair = np.searchsorted(slab_bounds, pair_b, side="right") - 1
+    for d, chunk in enumerate(key_chunks):
+        for row, ki in enumerate(chunk):
+            lo, hi = pair_ptr[ki], pair_ptr[ki + 1]
+            for s in range(n_dev):
+                sel = slab_of_pair[lo:hi] == s
+                want_a = pair_a[lo:hi][sel]
+                want_b = pair_b[lo:hi][sel] - slab_bounds[s]
+                got_a = pa_all[d, s, row]
+                got_b = pb_all[d, s, row]
+                assert np.array_equal(got_a[: len(want_a)], want_a)
+                assert np.array_equal(got_b[: len(want_b)], want_b)
+                assert np.all(got_a[len(want_a):] == -1)
+                assert np.all(got_b[len(want_b):] == s_max)
+
+
 def test_chain_product_on_devices_matches_partitioned():
     """Device-parallel chain DP must be bit-identical to the single-device
     mpirun-semantics replica at the same P (and to the oracle)."""
