@@ -117,6 +117,19 @@ def main() -> int:
              (hi, lo, hi, lo, pa, pb), {}),
             ("mxu-pallas-3x3-bounded", numeric_round_mxu_pallas,
              (hi16, lo16, hi16, lo16, pa, pb), {"a_limbs": 3, "b_limbs": 3}),
+            # pair-width ladder (round-3 finding: the epilogue amortizes
+            # with more pairs per launch; R=8 was the pre-outage default,
+            # 1024/k = 32 is the bf16-exactness cap at k=32)
+            ("mxu-pallas-10x10-R16", numeric_round_mxu_pallas,
+             (hi, lo, hi, lo, pa, pb), {"pair_width": 16}),
+            ("mxu-pallas-10x10-R32", numeric_round_mxu_pallas,
+             (hi, lo, hi, lo, pa, pb), {"pair_width": 32}),
+            ("mxu-pallas-3x3-bounded-R16", numeric_round_mxu_pallas,
+             (hi16, lo16, hi16, lo16, pa, pb),
+             {"a_limbs": 3, "b_limbs": 3, "pair_width": 16}),
+            ("mxu-pallas-3x3-bounded-R32", numeric_round_mxu_pallas,
+             (hi16, lo16, hi16, lo16, pa, pb),
+             {"a_limbs": 3, "b_limbs": 3, "pair_width": 32}),
         ]
         from spgemm_tpu.ops.pallas_spgemm import resolve_group
 

@@ -247,6 +247,23 @@ def config_nd24k_mxu():
                           parity=False, sampled_parity=64)
 
 
+def config_k64():
+    """k = 64 tiles, full-range values -- a scale the reference physically
+    cannot run: its CUDA launch assigns one thread per tile element
+    (block(k,k)), so the 1024-thread block limit caps it at k = 32
+    (SURVEY.md section 3.3).  The u64 engine is shape-polymorphic in k
+    (G auto-clamps to 512/k lanes); exact wrap-then-mod parity is
+    sampled-verified like the other big configs."""
+    from spgemm_tpu.ops.spgemm import resolve_backend
+    from spgemm_tpu.utils.gen import random_block_sparse
+
+    rng = np.random.default_rng(64)
+    a = random_block_sparse(128, 128, 64, 6 / 128, rng, "full")
+    b = random_block_sparse(128, 128, 64, 6 / 128, rng, "full")
+    return _spgemm_config("k64-beyond-ref", a, b, resolve_backend(None),
+                          parity=False, sampled_parity=32)
+
+
 def _webbase_config(config_name, dist, strategy, backend_label, n_dev=4):
     """Shared scaffold for the power-law (webbase-like) mesh configs:
     re-exec onto a virtual CPU mesh when fewer than n_dev chips are visible,
@@ -406,6 +423,7 @@ CONFIGS = {
     "nd24k": config_nd24k,
     "cage12-mxu": config_cage12_mxu,
     "nd24k-mxu": config_nd24k_mxu,
+    "k64-beyond-ref": config_k64,
     "webbase-1M": config_webbase,
     "webbase-ring": config_webbase_ring,
     "webbase-1Mrow": config_webbase_1mrow,

@@ -1,0 +1,124 @@
+"""Measured exact-vs-MXU crossover gate for hybrid dispatch (ops/spgemm.py).
+
+Round-3 hardware data (benchmarks/ROUND3_NOTES.md): the MXU limb kernel's
+best measured rate (7.0 GFLOP/s at (64, 256)) is far below the exact VPU
+kernel (~45 GFLOP/s) at every swept shape -- so an exactness proof alone
+must not route a round MXU-ward; `--backend hybrid` would then be *slower*
+than `--backend pallas` while producing identical bits.  This module is the
+missing half of the gate: a one-time micro-measurement of both kernels at
+the round's shape, persisted to disk, consulted per round by
+_hybrid_setup's choose_numeric.
+
+Policy (SPGEMM_TPU_HYBRID_GATE):
+  * "auto"  -- measure once per (kernel config, round shape), cache, route
+               to the measured winner.  Default on TPU.
+  * "proof" -- route on the exactness proof alone (the pre-round-4
+               behavior).  Default off-TPU, where the CPU 'mxu' lowering is
+               an XLA oracle whose relative speed says nothing about the
+               chip and where tests pin proof-based routing.
+
+The measurement itself doubles as the compile warmup for whichever kernel
+wins.  Timing inputs are synthetic random planes: both kernels' wall time
+is value-independent (fixed limb grids, fixed fold lengths), so garbage
+values time exactly like real ones.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+log = logging.getLogger("spgemm_tpu.crossover")
+
+_CACHE: dict | None = None
+
+
+def gate_policy() -> str:
+    """'auto' or 'proof' (see module docstring)."""
+    env = os.environ.get("SPGEMM_TPU_HYBRID_GATE")
+    if env in ("auto", "proof"):
+        return env
+    import jax  # noqa: PLC0415
+
+    return "auto" if jax.devices()[0].platform == "tpu" else "proof"
+
+
+def _cache_path() -> str:
+    root = (os.environ.get("SPGEMM_TPU_CROSSOVER_CACHE")
+            or os.path.expanduser("~/.cache/jax_bench"))
+    os.makedirs(root, exist_ok=True)
+    return os.path.join(root, "hybrid_crossover.json")
+
+
+def _load() -> dict:
+    global _CACHE
+    if _CACHE is None:
+        try:
+            with open(_cache_path()) as f:
+                _CACHE = json.load(f)
+        except (OSError, ValueError):
+            _CACHE = {}
+    return _CACHE
+
+
+def _save() -> None:
+    # merge the on-disk state first: concurrent processes (multi-host runs)
+    # each measure their own missing keys, and a whole-dict dump would lose
+    # the other writers' entries (last-writer-wins); measured-first-wins per
+    # key is fine -- any process's measurement is equally valid
+    assert _CACHE is not None
+    try:
+        with open(_cache_path()) as f:
+            on_disk = json.load(f)
+    except (OSError, ValueError):
+        on_disk = {}
+    _CACHE.update({k: v for k, v in on_disk.items() if k not in _CACHE})
+    tmp = _cache_path() + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(_CACHE, f, indent=0, sort_keys=True)
+    os.replace(tmp, _cache_path())
+
+
+def _time_call(fn, args, repeats: int = 2) -> float:
+    import jax  # noqa: PLC0415
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        return time.perf_counter() - t0
+
+    once()  # compile + warmup
+    return min(once() for _ in range(repeats))
+
+
+def mxu_wins(numeric_exact, numeric_mxu, *, key: str, k: int, K: int,
+             P: int, nnzb: int) -> bool:
+    """True iff the MXU kernel measured faster than the exact kernel at this
+    round shape.  First call per key measures both (and persists); later
+    calls are a dict lookup."""
+    cache = _load()
+    hit = cache.get(key)
+    if hit is None:
+        import jax.numpy as jnp  # noqa: PLC0415
+        import numpy as np  # noqa: PLC0415
+
+        rng = np.random.default_rng(0)
+        plane = rng.integers(0, 1 << 32, size=(nnzb + 1, k, k),
+                             dtype=np.int64).astype(np.uint32)
+        plane[-1] = 0  # sentinel zero tile, as the engine guarantees
+        hi = jnp.asarray(plane)
+        lo = jnp.asarray(plane)
+        pa = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+        pb = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+        hit = {
+            "exact_s": _time_call(numeric_exact, (hi, lo, hi, lo, pa, pb)),
+            "mxu_s": _time_call(numeric_mxu, (hi, lo, hi, lo, pa, pb)),
+        }
+        cache[key] = hit
+        _save()
+        log.info("crossover %s: exact=%.4fs mxu=%.4fs -> %s", key,
+                 hit["exact_s"], hit["mxu_s"],
+                 "mxu" if hit["mxu_s"] < hit["exact_s"] else "exact")
+    return hit["mxu_s"] < hit["exact_s"]

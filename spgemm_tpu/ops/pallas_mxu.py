@@ -150,9 +150,11 @@ def limbs_for_bound(val_bound: int | None) -> int:
     return min(N_LIMBS, max(1, -(-int(val_bound).bit_length() // 7)))
 
 
-@partial(jax.jit, static_argnames=("interpret", "a_limbs", "b_limbs"))
+@partial(jax.jit,
+         static_argnames=("interpret", "a_limbs", "b_limbs", "pair_width"))
 def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
-                             a_limbs: int = N_LIMBS, b_limbs: int = N_LIMBS):
+                             a_limbs: int = N_LIMBS, b_limbs: int = N_LIMBS,
+                             pair_width: int | None = None):
     """Same contract as ops.spgemm.numeric_round_impl, field-mode semantics.
 
     a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
@@ -161,6 +163,8 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
     a_limbs/b_limbs: per-operand limb counts (limbs_for_bound of the proven
               value bound) -- 32-bit-bounded operands need 5x5 limb blocks
               instead of 10x10, a 4x cut in dot flops and epilogue work.
+    pair_width: requested pairs per grid step (R), clamped to the
+              bf16-exactness cap 1024/k; None = the tuned default 8.
     Returns (out_hi, out_lo): (K, k, k) uint32, residues mod 2^64-1.
     """
     K, P = pa.shape
@@ -172,8 +176,13 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
         interpret = jax.devices()[0].platform == "cpu"
 
     # pair-block width: R*k is the MXU contraction size; 127^2 * R*k < 2^24
-    # keeps each f32 dot exact (R*k <= 1024)
-    R = max(1, min(8, P, 1024 // max(k, 1)))
+    # keeps each f32 dot exact (R*k <= 1024, the hard cap).  The default 8
+    # was tuned pre-outage; the round-3 sweep showed the epilogue amortizing
+    # with MORE pairs per launch (7.0 GFLOP/s at (K=64, P=256) vs 1.4 at
+    # (256, 16)), so pair_width (static; SPGEMM_TPU_MXU_R via the engine's
+    # _select_numeric, swept by benchmarks/kernel_sweep.py) exposes the
+    # exactness-capped range.
+    R = max(1, min(pair_width or 8, P, 1024 // max(k, 1)))
     P_pad = -(-P // R) * R
     if P_pad != P:
         a_sent = jnp.int32(a_hi.shape[0] - 1)

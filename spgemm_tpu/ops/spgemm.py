@@ -68,23 +68,40 @@ def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
     bh, bl = b_hi[pb], b_lo[pb]
 
     # Walk order: for pair p, for j in 0..k-1.  The pair axis is a fori_loop
-    # (dynamic-index slice per step); the j fold is unrolled (k is static), so
-    # each loop body is ~k fused vector MACs instead of one.
+    # (dynamic-index slice per step); the j fold is unrolled at reference
+    # scales (k <= 32 -- each loop body is ~k fused vector MACs instead of
+    # one) and a fori_loop beyond them (a 128-wide unrolled MAC chain is a
+    # compile bomb, and k > 32 is already outside the perf-critical regime
+    # the reference can even reach).
     ath = jnp.transpose(ah, (1, 0, 2, 3))  # (P, K, ty, j)
     atl = jnp.transpose(al, (1, 0, 2, 3))
     bth = jnp.transpose(bh, (1, 0, 2, 3))  # (P, K, j, tx)
     btl = jnp.transpose(bl, (1, 0, 2, 3))
 
+    def _mac_j(acc_h, acc_l, pah, pal, pbh, pbl, j):
+        return u64.mac(
+            acc_h, acc_l,
+            jax.lax.dynamic_slice_in_dim(pah, j, 1, axis=2),
+            jax.lax.dynamic_slice_in_dim(pal, j, 1, axis=2),
+            jax.lax.dynamic_slice_in_dim(pbh, j, 1, axis=1),
+            jax.lax.dynamic_slice_in_dim(pbl, j, 1, axis=1),
+        )
+
     def body(p, acc):
         acc_h, acc_l = acc
         pah, pal = ath[p], atl[p]  # (K, k, k)
         pbh, pbl = bth[p], btl[p]
-        for j in range(k):
-            acc_h, acc_l = u64.mac(
-                acc_h, acc_l,
-                pah[:, :, j : j + 1], pal[:, :, j : j + 1],
-                pbh[:, j : j + 1, :], pbl[:, j : j + 1, :],
-            )
+        if k <= 32:
+            for j in range(k):
+                acc_h, acc_l = u64.mac(
+                    acc_h, acc_l,
+                    pah[:, :, j : j + 1], pal[:, :, j : j + 1],
+                    pbh[:, j : j + 1, :], pbl[:, j : j + 1, :],
+                )
+        else:
+            acc_h, acc_l = jax.lax.fori_loop(
+                0, k, lambda j, a: _mac_j(*a, pah, pal, pbh, pbl, j),
+                (acc_h, acc_l))
         return acc_h, acc_l
 
     zero = jnp.zeros((K, k, k), jnp.uint32)
@@ -141,10 +158,17 @@ def _select_numeric(backend: str, a, b):
                 limbs_for_bound, numeric_round_mxu_pallas)
 
             # proven value bounds shrink the limb grid (5x5 for 32-bit
-            # values vs 10x10 unbounded): 4x less dot + epilogue work
+            # values vs 10x10 unbounded): 4x less dot + epilogue work.
+            # SPGEMM_TPU_MXU_R: whole-engine A/B of the pair width R, like
+            # the VPU's ALGO/PB hooks above (static -> one jit cache entry
+            # per value)
+            import os  # noqa: PLC0415
+
             numeric = partial(numeric_round_mxu_pallas,
                               a_limbs=limbs_for_bound(a.val_bound),
-                              b_limbs=limbs_for_bound(b.val_bound))
+                              b_limbs=limbs_for_bound(b.val_bound),
+                              pair_width=int(os.environ.get(
+                                  "SPGEMM_TPU_MXU_R", "8")))
             return numeric, 64 * 1024, 8192
         from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu  # noqa: PLC0415
 
@@ -163,9 +187,21 @@ def _hybrid_setup(a, b, k):
     so the mixed result is bit-exact regardless of the split.
 
     a, b need only .val_bound.  Returns (numeric_exact, max_entries,
-    default_rs, choose_numeric) where choose_numeric(rnd) -> (fn, used_mxu).
+    default_rs, choose_numeric) where choose_numeric(rnd) ->
+    (fn, used_mxu, proof_ok) -- see its docstring for the proof/routing
+    distinction.
+
+    A round goes MXU-ward only when BOTH gates pass: the bit-exactness
+    proof (correctness) and -- under the 'auto' policy, the TPU default --
+    a measured speed win at the round's shape (ops/crossover.py; round-3
+    hardware data showed the proof-only gate routing provably-safe rounds
+    to a kernel ~6x slower than the exact one).
     """
+    import os  # noqa: PLC0415
+
+    from spgemm_tpu.ops import crossover  # noqa: PLC0415
     from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
+    from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
 
     exact_name = resolve_backend(None)
     numeric_exact, max_entries, default_rs = _select_numeric(exact_name, a, b)
@@ -176,15 +212,48 @@ def _hybrid_setup(a, b, k):
         max_entries = mxu_entries
     bounds_ok = a.val_bound is not None and b.val_bound is not None
 
+    gate = crossover.gate_policy()
+    key_prefix = None
+    if gate == "auto" and bounds_ok:
+        import jax  # noqa: PLC0415
+
+        dev = jax.devices()[0]
+        algo = os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast")
+        pb_env = os.environ.get("SPGEMM_TPU_VPU_PB", "1")
+        if dev.platform == "tpu":
+            from spgemm_tpu.ops.pallas_mxu import limbs_for_bound  # noqa: PLC0415
+
+            limbs = f"l{limbs_for_bound(a.val_bound)}x{limbs_for_bound(b.val_bound)}"
+        else:
+            limbs = "xla"
+        mxu_r = os.environ.get("SPGEMM_TPU_MXU_R", "8")
+        key_prefix = (f"{dev.platform}:{dev.device_kind}:"
+                      f"{exact_name}-{algo}-pb{pb_env}:{limbs}-R{mxu_r}:k{k}")
+
     def choose_numeric(rnd):
+        """-> (numeric_fn, used_mxu, proof_ok).  proof_ok reports whether
+        the bit-exactness proof held at this round's fanout -- the proven
+        output bound is valid whenever the proof holds, REGARDLESS of which
+        kernel the speed gate then picks (both produce identical bits), so
+        bound propagation keys off proof_ok, not used_mxu."""
         # proof at the round's REAL max fanout (padded sentinel pairs
         # contribute exactly 0); the padded width only gates the MXU
         # kernel's own int32-accumulator check (P*k <= 2^17)
         if (not bounds_ok or rnd.pa.shape[1] * k > 1 << 17
                 or safe_exact_bound(a.val_bound, b.val_bound,
                                     rnd.max_fanout, k) is None):
-            return numeric_exact, False
-        return numeric_mxu, True
+            return numeric_exact, False, False
+        if key_prefix is not None:
+            # measure at the round's padded key class so the cache stays
+            # logarithmic in shapes; canonical 2048-tile slabs (wall time
+            # is gather- and fold-shape-bound, not slab-size-bound)
+            Kc, P = _shape_class(rnd.pa.shape[0]), rnd.pa.shape[1]
+            if not crossover.mxu_wins(
+                    numeric_exact, numeric_mxu,
+                    key=f"{key_prefix}:K{Kc}:P{P}", k=k, K=Kc, P=P,
+                    nnzb=2048):
+                return numeric_exact, False, True
+        return numeric_mxu, True, True
 
     return numeric_exact, max_entries, default_rs, choose_numeric
 
@@ -231,14 +300,15 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     # the device tail is the caller's block_until_ready); the reference's
     # Table-2 analog phases are symbolic_join / plan_rounds /
     # numeric_dispatch / assembly.
-    mxu_rounds = 0
+    mxu_rounds = proof_rounds = 0
     with timers.phase("numeric_dispatch"):
         outs_h, outs_l, order = [], [], []
         for rnd in rounds:
             fn = numeric
             if choose_numeric is not None:
-                fn, used_mxu = choose_numeric(rnd)
+                fn, used_mxu, proof_ok = choose_numeric(rnd)
                 mxu_rounds += used_mxu
+                proof_rounds += proof_ok
             oh, ol = fn(a.hi, a.lo, b.hi, b.lo,
                         jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
             n_valid = len(rnd.key_index)
@@ -263,10 +333,12 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     tag = backend
     if choose_numeric is not None:
         tag = f"hybrid mxu={mxu_rounds}/{len(rounds)}"
-        if mxu_rounds == len(rounds):
-            # every round ran under a proof: the tighter propagated bound
-            # feeds the NEXT multiply's proof (chain products stay on the
-            # MXU as long as the bounds hold)
+        if proof_rounds == len(rounds):
+            # every round's exactness proof held: the tighter propagated
+            # bound feeds the NEXT multiply's proof, keeping chain products
+            # provable as long as the bounds hold -- even when the speed
+            # gate routed the rounds to the exact kernel (identical bits,
+            # so the proven bound applies either way)
             from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
 
             proven = safe_exact_bound(a.val_bound, b.val_bound,
@@ -370,7 +442,7 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         ah, al = u64.u64_to_hilo(a_sub)
         bh, bl = u64.u64_to_hilo(b_sub)
         fn, used_mxu = (numeric, False) if choose_numeric is None \
-            else choose_numeric(rnd)
+            else choose_numeric(rnd)[:2]
         out = fn(jnp.asarray(ah), jnp.asarray(al),
                  jnp.asarray(bh), jnp.asarray(bl),
                  jnp.asarray(sub_pa), jnp.asarray(sub_pb))

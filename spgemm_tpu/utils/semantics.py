@@ -145,6 +145,32 @@ def spgemm_oracle(a_blocks: dict, b_blocks: dict, k: int) -> dict:
     return out
 
 
+def field_spgemm_oracle(a_blocks: dict, b_blocks: dict, k: int) -> dict:
+    """Clean mod-(2^64-1) block-sparse matmul oracle in python ints.
+
+    Ground truth for the FIELD-mode paths (ops/u64.py field ops, the MXU
+    limb kernel, parallel/innershard + ring): C = A x B over Z/(2^64-1),
+    order-free because the clean residue arithmetic is associative.  Agrees
+    with spgemm_oracle exactly when no product or partial sum crosses 2^64
+    (e.g. all values < 2^32); deviates on wrap-triggering inputs -- that
+    deviation IS the documented contract (parallel/innershard.py docstring).
+    """
+    out: dict = {}
+    for (ar, ac), a_tile in a_blocks.items():
+        for (br, bc), b_tile in b_blocks.items():
+            if ac != br:
+                continue
+            acc = out.setdefault((ar, bc), [[0] * k for _ in range(k)])
+            for ty in range(k):
+                for tx in range(k):
+                    s = acc[ty][tx]
+                    for j in range(k):
+                        s = (s + int(a_tile[ty][j]) * int(b_tile[j][tx])) \
+                            % MAX_INT
+                    acc[ty][tx] = s
+    return {key: np.array(tile, dtype=np.uint64) for key, tile in out.items()}
+
+
 def chain_oracle(matrices: list, k: int) -> dict:
     """Pairwise-halving chain product matching helper2 (sparse_matrix_mult.cu:287-327).
 

@@ -1,6 +1,6 @@
-"""Subprocess worker for the 2-process DCN test (tests/test_multihost.py).
+"""Subprocess worker for the multi-process DCN tests (tests/test_multihost.py).
 
-Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <dir>
+Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <dir> <n_mats>
 Builds a deterministic chain, partitions it by process, runs the multi-host
 reduction, and (process 0) writes the result matrix file into <dir>/out.
 """
@@ -9,8 +9,9 @@ import sys
 
 
 def main():
-    coordinator, num_procs, proc_id, workdir = (
-        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    coordinator, num_procs, proc_id, workdir, n_mats = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+        int(sys.argv[5]))
 
     import jax
     from jax._src import xla_bridge
@@ -28,7 +29,7 @@ def main():
     from spgemm_tpu.utils.gen import random_chain
 
     k = 2
-    mats = random_chain(5, 4, k, 0.5, np.random.default_rng(777), "full")
+    mats = random_chain(n_mats, 4, k, 0.5, np.random.default_rng(777), "full")
     result = multihost.run_distributed(
         "unused", k, len(mats), loader=lambda s, e: mats[s : e + 1])
     if jax.process_index() == 0:

@@ -23,7 +23,13 @@ def _free_port():
     return port
 
 
-def test_two_process_chain(tmp_path):
+@pytest.mark.parametrize("num_procs,n_mats", [
+    (2, 5),   # the original 2-host split
+    (4, 7),   # P=4, every rank active (4-way padded DCN all-gather)
+    (4, 3),   # P=4, N < P: ranks 1-3 idle -- the q==0 degenerate branch
+              # (reference: sparse_matrix_mult.cu:612-666 region) over DCN
+])
+def test_multi_process_chain(tmp_path, num_procs, n_mats):
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
@@ -32,9 +38,10 @@ def test_two_process_chain(tmp_path):
 
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, coord, "2", str(r), str(tmp_path)],
+            [sys.executable, worker, coord, str(num_procs), str(r),
+             str(tmp_path), str(n_mats)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
-        for r in range(2)
+        for r in range(num_procs)
     ]
     outs = []
     try:
@@ -48,13 +55,13 @@ def test_two_process_chain(tmp_path):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
 
-    # compare against the single-process partitioned result (P=2 semantics)
+    # compare against the single-process partitioned result (P semantics)
     from spgemm_tpu.parallel.chainpart import chain_product_partitioned
     from spgemm_tpu.utils import io_text
     from spgemm_tpu.utils.gen import random_chain
 
     k = 2
-    mats = random_chain(5, 4, k, 0.5, np.random.default_rng(777), "full")
-    want = chain_product_partitioned(mats, 2)
+    mats = random_chain(n_mats, 4, k, 0.5, np.random.default_rng(777), "full")
+    want = chain_product_partitioned(mats, num_procs)
     got = io_text.read_matrix(str(tmp_path / "out"), k)
     assert got == want

@@ -98,6 +98,28 @@ def test_pair_padding_to_block_multiple():
     assert np.array_equal(np.asarray(want_l), np.asarray(got_l))
 
 
+@pytest.mark.parametrize("pair_width", [1, 3, 16, 128])
+def test_pair_width_ladder_bit_identical(pair_width):
+    """Any requested R (clamped to the bf16-exactness cap 1024/k) must be
+    bit-identical to the default -- field mode is associative, so the
+    R-grouping of the int32 accumulation is semantics-free."""
+    k, nnzb, K, P = 8, 9, 4, 21
+    rng = np.random.default_rng(pair_width)
+    tiles = rng.integers(0, 1 << 64, size=(nnzb + 1, k, k), dtype=np.uint64)
+    tiles[-1] = 0
+    hi, lo = u64.u64_to_hilo(tiles)
+    hi, lo = jnp.asarray(hi), jnp.asarray(lo)
+    pa = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    pb = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    want_h, want_l = numeric_round_mxu_pallas(hi, lo, hi, lo, pa, pb,
+                                              interpret=True)
+    got_h, got_l = numeric_round_mxu_pallas(hi, lo, hi, lo, pa, pb,
+                                            interpret=True,
+                                            pair_width=pair_width)
+    assert np.array_equal(np.asarray(want_h), np.asarray(got_h))
+    assert np.array_equal(np.asarray(want_l), np.asarray(got_l))
+
+
 @pytest.mark.parametrize("bits_a,bits_b", [(32, 32), (14, 64), (7, 7), (50, 21)])
 def test_adaptive_limb_counts(bits_a, bits_b):
     """Bounded operands with shrunk limb grids must match the full 10x10."""

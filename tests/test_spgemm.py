@@ -29,6 +29,28 @@ def test_random_vs_oracle(k, dist):
     assert_matches_oracle(a, b)
 
 
+@pytest.mark.parametrize("k", [64, 128])
+def test_beyond_reference_tile_cap_vs_oracle(k):
+    """k > 32 exact parity -- a capability the reference physically cannot
+    have: its CUDA launch uses one thread per tile element (block(k,k),
+    sparse_matrix_mult.cu kernel launch region), capping k at 32 by the
+    1024-thread block limit (SURVEY.md section 3.3).  The u64 engine here is
+    shape-polymorphic in k; pin exact wrap-then-mod parity at k=64/128."""
+    rng = np.random.default_rng(6400 + k)
+    a = random_block_sparse(3, 3, k, 0.7, rng, "adversarial")
+    b = random_block_sparse(3, 3, k, 0.7, rng, "adversarial")
+    assert_matches_oracle(a, b, backend="xla")
+
+
+def test_beyond_reference_tile_cap_pallas_k64():
+    """The Pallas VPU kernel at k=64 (interpret mode): same exact parity.
+    G auto-clamps to 512/k = 8 lanes-wide groups; fold order is unchanged."""
+    rng = np.random.default_rng(640)
+    a = random_block_sparse(2, 2, 64, 1.0, rng, "full")
+    b = random_block_sparse(2, 2, 64, 1.0, rng, "full")
+    assert_matches_oracle(a, b, backend="pallas")
+
+
 def test_rectangular():
     rng = np.random.default_rng(30)
     a = random_block_sparse(3, 7, 4, 0.5, rng, "full")
