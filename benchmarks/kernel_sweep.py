@@ -130,6 +130,18 @@ def main() -> int:
             ("mxu-pallas-3x3-bounded-R32", numeric_round_mxu_pallas,
              (hi16, lo16, hi16, lo16, pa, pb),
              {"a_limbs": 3, "b_limbs": 3, "pair_width": 32}),
+            # raw-epilogue: no in-kernel piece sums (the ~750 us/key lane
+            # slicing, ROUND3_NOTES finding 2) -- raw int32 accumulator out,
+            # batched XLA epilogue; at 3x3 limbs the output is ~same bytes
+            ("mxu-pallas-3x3-raw", numeric_round_mxu_pallas,
+             (hi16, lo16, hi16, lo16, pa, pb),
+             {"a_limbs": 3, "b_limbs": 3, "raw_epilogue": True}),
+            ("mxu-pallas-3x3-raw-R32", numeric_round_mxu_pallas,
+             (hi16, lo16, hi16, lo16, pa, pb),
+             {"a_limbs": 3, "b_limbs": 3, "pair_width": 32,
+              "raw_epilogue": True}),
+            ("mxu-pallas-10x10-raw", numeric_round_mxu_pallas,
+             (hi, lo, hi, lo, pa, pb), {"raw_epilogue": True}),
         ]
         from spgemm_tpu.ops.pallas_spgemm import resolve_group
 

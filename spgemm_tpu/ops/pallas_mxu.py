@@ -72,21 +72,49 @@ def _piece_sums(S, k: int, la_limbs: int = N_LIMBS, lb_limbs: int = N_LIMBS):
     contains no carry compares -- the part of the fold Mosaic compiles
     correctly (see module docstring).
     """
-    M16 = jnp.uint32(0xFFFF)
     limbs = [jnp.zeros((k, k), jnp.uint32) for _ in range(8)]
     for la in range(la_limbs):
         for lb in range(lb_limbs):
-            sh = 7 * (la + lb)
-            if sh >= 64:
-                sh -= 64  # 2^64 === 1 (mod 2^64-1)
-            q, r = divmod(sh, 16)
-            s = S[la * k:(la + 1) * k, lb * k:(lb + 1) * k].astype(jnp.uint32)
-            limbs[q] = limbs[q] + ((s << r) & M16)
-            if r == 0:
-                limbs[q + 1] = limbs[q + 1] + (s >> 16)
-            else:
-                limbs[q + 1] = limbs[q + 1] + ((s >> (16 - r)) & M16)
-                limbs[q + 2] = limbs[q + 2] + (s >> (32 - r))
+            s = S[la * k:(la + 1) * k, lb * k:(lb + 1) * k]
+            _accum_piece(limbs, s, la, lb)
+    return limbs
+
+
+def _accum_piece(limbs, s, la: int, lb: int) -> None:
+    """Accumulate one (la, lb) limb-product block into the 8 carry-free
+    16-bit-piece sums, at weight 2^(7(la+lb) mod 64).  Shape-agnostic (jnp
+    broadcasting) -- the single definition shared by the in-kernel epilogue
+    (_piece_sums) and the batched XLA one (piece_sums_batched)."""
+    M16 = jnp.uint32(0xFFFF)
+    sh = 7 * (la + lb)
+    if sh >= 64:
+        sh -= 64  # 2^64 === 1 (mod 2^64-1)
+    q, r = divmod(sh, 16)
+    s = s.astype(jnp.uint32)
+    limbs[q] = limbs[q] + ((s << r) & M16)
+    if r == 0:
+        limbs[q + 1] = limbs[q + 1] + (s >> 16)
+    else:
+        limbs[q + 1] = limbs[q + 1] + ((s >> (16 - r)) & M16)
+        limbs[q + 2] = limbs[q + 2] + (s >> (32 - r))
+
+
+def piece_sums_batched(S, k: int, La: int, Lb: int):
+    """(K, La*k, Lb*k) int32 raw limb products -> 8 (K, k, k) uint32 planes.
+
+    The XLA-side twin of the in-kernel _piece_sums, for the raw_epilogue
+    path: one reshape/transpose turns every (la, lb) block access into a
+    leading-axis index (no per-key lane slicing -- the relayout is one
+    batched transpose over all keys, XLA's scheduling instead of ~La*Lb
+    in-kernel lane extracts per key).  Same weights via the shared
+    _accum_piece, bit-identical by test."""
+    K = S.shape[0]
+    blocks = (S.reshape(K, La, k, Lb, k)
+               .transpose(1, 3, 0, 2, 4))              # (La, Lb, K, k, k)
+    limbs = [jnp.zeros((K, k, k), jnp.uint32) for _ in range(8)]
+    for la in range(La):
+        for lb in range(Lb):
+            _accum_piece(limbs, blocks[la, lb], la, lb)
     return limbs
 
 
@@ -106,14 +134,14 @@ def fold_piece_sums(limbs):
 
 
 def _kernel(pa_ref, pb_ref, *refs, k: int, R: int, blocks: int,
-            La: int, Lb: int):
-    # refs layout: ah x R, al x R, bh x R, bl x R, out_limbs | scratch
+            La: int, Lb: int, raw: bool):
+    # refs layout: ah x R, al x R, bh x R, bl x R, out[, scratch]
     ahs = [r[0] for r in refs[0 * R:1 * R]]            # each (k, k) uint32
     als = [r[0] for r in refs[1 * R:2 * R]]
     bhs = [r[0] for r in refs[2 * R:3 * R]]
     bls = [r[0] for r in refs[3 * R:4 * R]]
-    out_ref = refs[4 * R]                              # (1, 8, k, k) uint32
-    acc_ref = refs[4 * R + 1]                          # (La*k, Lb*k) int32 VMEM
+    out_ref = refs[4 * R]   # raw: (1, La*k, Lb*k) int32; else (1, 8, k, k) u32
+    acc_ref = None if raw else refs[4 * R + 1]           # (La*k, Lb*k) int32
 
     pb = pl.program_id(1)
 
@@ -129,6 +157,16 @@ def _kernel(pa_ref, pb_ref, *refs, k: int, R: int, blocks: int,
     # The MXU step: every one of the La*Lb limb-pair blocks in one dot.
     s = jax.lax.dot_general(a_cat, b_cat, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32)
+
+    if raw:
+        # the output block IS the accumulator: no scratch, no in-kernel
+        # epilogue -- the piece sums run batched in XLA outside
+        @pl.when(pb == 0)
+        def _init_raw():
+            out_ref[0] = jnp.zeros_like(out_ref[0])
+
+        out_ref[0] = out_ref[0] + s.astype(jnp.int32)
+        return
 
     @pl.when(pb == 0)
     def _init():
@@ -151,10 +189,12 @@ def limbs_for_bound(val_bound: int | None) -> int:
 
 
 @partial(jax.jit,
-         static_argnames=("interpret", "a_limbs", "b_limbs", "pair_width"))
+         static_argnames=("interpret", "a_limbs", "b_limbs", "pair_width",
+                          "raw_epilogue"))
 def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
                              a_limbs: int = N_LIMBS, b_limbs: int = N_LIMBS,
-                             pair_width: int | None = None):
+                             pair_width: int | None = None,
+                             raw_epilogue: bool = False):
     """Same contract as ops.spgemm.numeric_round_impl, field-mode semantics.
 
     a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
@@ -165,6 +205,13 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
               instead of 10x10, a 4x cut in dot flops and epilogue work.
     pair_width: requested pairs per grid step (R), clamped to the
               bf16-exactness cap 1024/k; None = the tuned default 8.
+    raw_epilogue: skip the in-kernel piece-sum epilogue (the measured
+              ~750 us/key lane-slicing cost, ROUND3_NOTES finding 2) and
+              output the raw (La*k, Lb*k) int32 accumulator per key; the
+              piece sums then run batched in XLA (piece_sums_batched).
+              Trades La*Lb/8 x more output HBM traffic for zero in-kernel
+              lane slicing -- at 3x3 limbs the output is ~= the same size,
+              so this should win there; the sweep decides.
     Returns (out_hi, out_lo): (K, k, k) uint32, residues mod 2^64-1.
     """
     K, P = pa.shape
@@ -201,18 +248,27 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
 
     tile_spec_a = [pl.BlockSpec((1, k, k), a_map(r)) for r in range(R)]
     tile_spec_b = [pl.BlockSpec((1, k, k), b_map(r)) for r in range(R)]
-    out_spec = pl.BlockSpec((1, 8, k, k), lambda kk, pblk, pa, pb: (kk, 0, 0, 0))
+    if raw_epilogue:
+        out_spec = pl.BlockSpec((1, La * k, Lb * k),
+                                lambda kk, pblk, pa, pb: (kk, 0, 0))
+        out_shape = [jax.ShapeDtypeStruct((K, La * k, Lb * k), jnp.int32)]
+        scratch = []
+    else:
+        out_spec = pl.BlockSpec((1, 8, k, k),
+                                lambda kk, pblk, pa, pb: (kk, 0, 0, 0))
+        out_shape = [jax.ShapeDtypeStruct((K, 8, k, k), jnp.uint32)]
+        scratch = [pltpu.VMEM((La * k, Lb * k), jnp.int32)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # pa, pb
         grid=(K, blocks),
         in_specs=tile_spec_a + tile_spec_a + tile_spec_b + tile_spec_b,
         out_specs=[out_spec],
-        scratch_shapes=[pltpu.VMEM((La * k, Lb * k), jnp.int32)],
+        scratch_shapes=scratch,
     )
-    out_shape = [jax.ShapeDtypeStruct((K, 8, k, k), jnp.uint32)]
-    (limb_sums,) = pl.pallas_call(
-        partial(_kernel, k=k, R=R, blocks=blocks, La=La, Lb=Lb),
+    (out,) = pl.pallas_call(
+        partial(_kernel, k=k, R=R, blocks=blocks, La=La, Lb=Lb,
+                raw=raw_epilogue),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
@@ -224,4 +280,6 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
     )(pa, pb,
       *([a_hi] * R), *([a_lo] * R), *([b_hi] * R), *([b_lo] * R))
     # final fold outside the kernel (see module docstring), batched over keys
-    return fold_piece_sums([limb_sums[:, i] for i in range(8)])
+    if raw_epilogue:
+        return fold_piece_sums(piece_sums_batched(out, k, La, Lb))
+    return fold_piece_sums([out[:, i] for i in range(8)])

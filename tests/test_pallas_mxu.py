@@ -120,6 +120,31 @@ def test_pair_width_ladder_bit_identical(pair_width):
     assert np.array_equal(np.asarray(want_l), np.asarray(got_l))
 
 
+@pytest.mark.parametrize("limbs,pair_width", [(10, None), (3, None), (3, 16)])
+def test_raw_epilogue_bit_identical(limbs, pair_width):
+    """raw_epilogue=True (no in-kernel piece sums; batched XLA epilogue)
+    must be bit-identical to the in-kernel epilogue at any limb grid and
+    pair width -- same weights, same carry-free bound, different venue."""
+    k, nnzb, K, P = 8, 9, 5, 13
+    rng = np.random.default_rng(limbs + (pair_width or 0))
+    bound = (1 << (7 * limbs)) - 1 if limbs < 10 else (1 << 64) - 1
+    tiles = (rng.integers(0, 1 << 64, size=(nnzb + 1, k, k), dtype=np.uint64)
+             % np.uint64(bound))
+    tiles[-1] = 0
+    hi, lo = u64.u64_to_hilo(tiles)
+    hi, lo = jnp.asarray(hi), jnp.asarray(lo)
+    pa = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    pb = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    kw = {"a_limbs": limbs, "b_limbs": limbs, "pair_width": pair_width}
+    want_h, want_l = numeric_round_mxu_pallas(hi, lo, hi, lo, pa, pb,
+                                              interpret=True, **kw)
+    got_h, got_l = numeric_round_mxu_pallas(hi, lo, hi, lo, pa, pb,
+                                            interpret=True,
+                                            raw_epilogue=True, **kw)
+    assert np.array_equal(np.asarray(want_h), np.asarray(got_h))
+    assert np.array_equal(np.asarray(want_l), np.asarray(got_l))
+
+
 @pytest.mark.parametrize("bits_a,bits_b", [(32, 32), (14, 64), (7, 7), (50, 21)])
 def test_adaptive_limb_counts(bits_a, bits_b):
     """Bounded operands with shrunk limb grids must match the full 10x10."""
