@@ -111,6 +111,14 @@ def main() -> int:
              {"algo": "colbcast", "group": 8, "pair_block": 4}),
             ("vpu-vecj-g16-pb2", numeric_round_pallas,
              (hi, lo, hi, lo, pa, pb), {"algo": "vecj", "pair_block": 2}),
+            # proven-regime MAC (no mod_max: 28 vs 36 ops, u64.mac_nomod);
+            # legal on the bounded slab -- hybrid routes proven rounds here
+            ("vpu-colbcast-g16-nomod", numeric_round_pallas,
+             (hi16, lo16, hi16, lo16, pa, pb),
+             {"algo": "colbcast", "no_mod": True}),
+            ("vpu-vecj-g16-nomod", numeric_round_pallas,
+             (hi16, lo16, hi16, lo16, pa, pb),
+             {"algo": "vecj", "no_mod": True}),
             ("mxu-xla-10x10", numeric_round_mxu,
              (hi, lo, hi, lo, pa, pb), {}),
             ("mxu-pallas-10x10", numeric_round_mxu_pallas,

@@ -42,6 +42,11 @@ grep -q 'chain_multiply_wall_clock_failed' "$OUT/bench.txt" && fail=1
 # RESULTS.md, so the sweep must come from the same capture
 echo "[4/6] kernel sweep"
 timeout 2400 python benchmarks/kernel_sweep.py 2>&1 | tee "$OUT/sweep.txt" | tail -10 || fail=1
+# best-effort k=64 quick sweep: on-chip evidence for the beyond-reference
+# tile size (its failure must not cost the capture)
+timeout 900 python benchmarks/kernel_sweep.py --quick --k 64 2>&1 \
+  | tee "$OUT/sweep_k64.txt" | tail -4 \
+  || echo "k64 sweep did not complete (see sweep_k64.txt)"
 
 # Best-effort BIG-scale runs, isolated from the fail-gated suite: each has
 # its own timeout, and a hang or failure here can only lose its own row,

@@ -39,7 +39,8 @@ from jax.experimental.pallas import tpu as pltpu
 from spgemm_tpu.ops import u64
 
 
-def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str, PB: int = 1):
+def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str, PB: int = 1,
+            no_mod: bool = False):
     # refs layout, pb-major: for pb in range(PB): ah x G; then al, bh, bl
     # blocks in the same order; finally out_hi, out_lo.  PB > 1 folds
     # pair_block consecutive pairs per grid step (pair-axis blocking --
@@ -67,13 +68,19 @@ def _kernel(pa_ref, pb_ref, *refs, k: int, G: int, algo: str, PB: int = 1):
         bhs = all_bh[pb * G : (pb + 1) * G]
         bls = all_bl[pb * G : (pb + 1) * G]
         acc_h, acc_l = _fold_pair(acc_h, acc_l, ahs, als, bhs, bls,
-                                  k=k, G=G, algo=algo)
+                                  k=k, G=G, algo=algo, no_mod=no_mod)
 
     out_hi_ref[0] = acc_h
     out_lo_ref[0] = acc_l
 
 
-def _fold_pair(acc_h, acc_l, ahs, als, bhs, bls, *, k: int, G: int, algo: str):
+def _fold_pair(acc_h, acc_l, ahs, als, bhs, bls, *, k: int, G: int, algo: str,
+               no_mod: bool = False):
+    # no_mod: elide both mod_max collapses per MAC (28 ops vs 36) -- bit-
+    # exact ONLY under the safe_exact_bound proof (u64.mac_nomod docstring)
+    mac_fn = u64.mac_nomod if no_mod else u64.mac
+    mul_fn = u64.mul64_lo if no_mod else u64.mulmod
+    add_fn = u64.add64 if no_mod else u64.addmod
     if algo == "colbcast":
         # B rows pack once per step: group tiles side by side along lanes.
         bh_cat = jnp.concatenate(bhs, axis=1)          # (k, G*k)
@@ -88,7 +95,7 @@ def _fold_pair(acc_h, acc_l, ahs, als, bhs, bls, *, k: int, G: int, algo: str):
                 [jnp.broadcast_to(t[:, j : j + 1], (k, k)) for t in als], axis=1)
             b_h = jnp.broadcast_to(bh_cat[j : j + 1, :], (k, G * k))
             b_l = jnp.broadcast_to(bl_cat[j : j + 1, :], (k, G * k))
-            acc_h, acc_l = u64.mac(acc_h, acc_l, a_h, a_l, b_h, b_l)
+            acc_h, acc_l = mac_fn(acc_h, acc_l, a_h, a_l, b_h, b_l)
     elif algo == "vecj":
         # Vectorized-j layout: compute a BLOCK of j's products at once in a
         # ((j, i) sublanes, (g, n) lanes) arrangement, then fold the j axis
@@ -118,9 +125,9 @@ def _fold_pair(acc_h, acc_l, ahs, als, bhs, bls, *, k: int, G: int, algo: str):
             a_l = jnp.concatenate([expand_a(t, j0) for t in ats_l], axis=1)
             b_h = jnp.concatenate([expand_b(t, j0) for t in bhs], axis=1)
             b_l = jnp.concatenate([expand_b(t, j0) for t in bls], axis=1)
-            prod_h, prod_l = u64.mulmod(a_h, a_l, b_h, b_l)  # (JB*k, G*k)
+            prod_h, prod_l = mul_fn(a_h, a_l, b_h, b_l)  # (JB*k, G*k)
             for jj in range(min(JB, k - j0)):
-                acc_h, acc_l = u64.addmod(
+                acc_h, acc_l = add_fn(
                     acc_h, acc_l,
                     prod_h[jj * k:(jj + 1) * k, :], prod_l[jj * k:(jj + 1) * k, :])
     else:
@@ -138,10 +145,11 @@ def resolve_group(k: int, K: int, group: int | None = None) -> int:
     return max(1, min(group or 16, lane_cap // k, K))
 
 
-@partial(jax.jit, static_argnames=("interpret", "algo", "group", "pair_block"))
+@partial(jax.jit, static_argnames=("interpret", "algo", "group", "pair_block",
+                                   "no_mod"))
 def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
                          algo: str = "colbcast", group: int | None = None,
-                         pair_block: int = 1):
+                         pair_block: int = 1, no_mod: bool = False):
     """Same contract as ops.spgemm.numeric_round_impl, as a Pallas kernel.
 
     a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
@@ -152,6 +160,9 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
               pair axis PB-fold, amortizing per-step fixed cost, at the price
               of 4*G*PB input refs per step.  Sentinel padding of the pair
               axis keeps results exact; fold order stays pair-ascending.
+    no_mod  : elide the mod_max collapses (u64.mac_nomod; 28 vs 36 ops per
+              MAC) -- callers must hold the safe_exact_bound proof, exactly
+              as for the MXU field-mode route (hybrid dispatch supplies it).
     Returns (out_hi, out_lo): (K, k, k) uint32.
     """
     K, P = pa.shape
@@ -217,7 +228,7 @@ def numeric_round_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
         jax.ShapeDtypeStruct((KG, k, G * k), jnp.uint32),
     ]
     packed_hi, packed_lo = pl.pallas_call(
-        partial(_kernel, k=k, G=G, algo=algo, PB=PB),
+        partial(_kernel, k=k, G=G, algo=algo, PB=PB, no_mod=no_mod),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,

@@ -206,6 +206,12 @@ def _hybrid_setup(a, b, k):
     exact_name = resolve_backend(None)
     numeric_exact, max_entries, default_rs = _select_numeric(exact_name, a, b)
     numeric_mxu, mxu_entries, _ = _select_numeric("mxu", a, b)
+    # proven-round exact kernel: under the same proof that licenses the MXU
+    # route, both mod_max collapses are identity and the VPU kernel drops
+    # them (u64.mac_nomod, 28 vs 36 ops/MAC) -- a strict op-subset of the
+    # exact kernel, so no separate speed measurement is needed
+    numeric_exact_proven = (partial(numeric_exact, no_mod=True)
+                            if exact_name == "pallas" else numeric_exact)
     # plan under the tighter budget so both kernels accept every round
     if mxu_entries is not None and (max_entries is None
                                     or mxu_entries < max_entries):
@@ -227,32 +233,41 @@ def _hybrid_setup(a, b, k):
         else:
             limbs = "xla"
         mxu_r = os.environ.get("SPGEMM_TPU_MXU_R", "8")
-        key_prefix = (f"{dev.platform}:{dev.device_kind}:"
+        # v2: the VPU side of the measurement is the proven-round (nomod)
+        # kernel -- older entries timed the mod kernel and must not be reused
+        key_prefix = (f"v2:{dev.platform}:{dev.device_kind}:"
                       f"{exact_name}-{algo}-pb{pb_env}:{limbs}-R{mxu_r}:k{k}")
 
     def choose_numeric(rnd):
         """-> (numeric_fn, used_mxu, proof_ok).  proof_ok reports whether
         the bit-exactness proof held at this round's fanout -- the proven
         output bound is valid whenever the proof holds, REGARDLESS of which
-        kernel the speed gate then picks (both produce identical bits), so
+        kernel the speed gate then picks (all produce identical bits), so
         bound propagation keys off proof_ok, not used_mxu."""
         # proof at the round's REAL max fanout (padded sentinel pairs
-        # contribute exactly 0); the padded width only gates the MXU
-        # kernel's own int32-accumulator check (P*k <= 2^17)
-        if (not bounds_ok or rnd.pa.shape[1] * k > 1 << 17
+        # contribute exactly 0)
+        if (not bounds_ok
                 or safe_exact_bound(a.val_bound, b.val_bound,
                                     rnd.max_fanout, k) is None):
             return numeric_exact, False, False
+        # the padded width gates only the MXU kernel's own int32-accumulator
+        # check (P*k <= 2^17) -- the proof itself (and so the nomod discount
+        # and bound propagation) is unaffected
+        if rnd.pa.shape[1] * k > 1 << 17:
+            return numeric_exact_proven, False, True
         if key_prefix is not None:
             # measure at the round's padded key class so the cache stays
             # logarithmic in shapes; canonical 2048-tile slabs (wall time
-            # is gather- and fold-shape-bound, not slab-size-bound)
+            # is gather- and fold-shape-bound, not slab-size-bound).  The
+            # VPU side of the measurement is the PROVEN-round kernel
+            # (nomod where available) -- that is what an MXU loss would
+            # actually run, so the routing is unbiased.
             Kc, P = _shape_class(rnd.pa.shape[0]), rnd.pa.shape[1]
             if not crossover.mxu_wins(
-                    numeric_exact, numeric_mxu,
+                    numeric_exact_proven, numeric_mxu,
                     key=f"{key_prefix}:K{Kc}:P{P}", k=k, K=Kc, P=P,
                     nnzb=2048):
-                return numeric_exact, False, True
+                return numeric_exact_proven, False, True
         return numeric_mxu, True, True
 
     return numeric_exact, max_entries, default_rs, choose_numeric

@@ -124,6 +124,21 @@ def mac(acc_hi, acc_lo, a_hi, a_lo, b_hi, b_lo):
     return addmod(acc_hi, acc_lo, p_hi, p_lo)
 
 
+def mac_nomod(acc_hi, acc_lo, a_hi, a_lo, b_hi, b_lo):
+    """mac with both mod_max collapses elided: 28 vector ops vs 36.
+
+    BIT-EXACT ONLY under a proof obligation (mxu_spgemm.safe_exact_bound):
+    every product and every partial sum stays strictly below 2^64 - 1, so
+    each `x mod (2^64-1)` is the identity and the wrap-then-mod sequence
+    degenerates to plain u64 arithmetic.  This is the same proof that
+    licenses the MXU field-mode route in hybrid dispatch -- the dispatcher
+    uses this variant for proven rounds the speed gate keeps on the VPU
+    (benchmarks/ROOFLINE.md section 1: the MAC op count is the ceiling-
+    setting quantity once layouts plateau)."""
+    p_hi, p_lo = mul64_lo(a_hi, a_lo, b_hi, b_lo)
+    return add64(acc_hi, acc_lo, p_hi, p_lo)
+
+
 # ---------------------------------------------------------------------------
 # Clean ring arithmetic mod (2^64 - 1) -- "field mode".
 #

@@ -184,6 +184,40 @@ def test_hybrid_perf_gate_routes_to_measured_winner(tmp_path, monkeypatch,
         assert c2 == want
 
 
+def test_hybrid_proven_route_dispatches_nomod_pallas(tmp_path, monkeypatch,
+                                                     caplog):
+    """The exact_name == 'pallas' branch of _hybrid_setup (the one that
+    actually dispatches the 28-op nomod kernel) is TPU-only in production;
+    force it on CPU via resolve_backend + interpret-mode Pallas so the
+    partial plumbing through choose_numeric is exercised in CI, end to end
+    through the engine, with reference-bit-exact output."""
+    import logging
+
+    from spgemm_tpu.ops import crossover
+    from spgemm_tpu.ops import spgemm as spgemm_mod
+
+    rng = np.random.default_rng(11)
+    a = random_block_sparse(6, 6, 4, 0.5, rng, "small")
+    b = random_block_sparse(6, 6, 4, 0.5, rng, "small")
+    monkeypatch.setenv("SPGEMM_TPU_HYBRID_GATE", "auto")
+    monkeypatch.setenv("SPGEMM_TPU_CROSSOVER_CACHE", str(tmp_path))
+    monkeypatch.setattr(crossover, "_CACHE", None)
+    # exact backend resolves to the Pallas kernel (interpret mode on CPU);
+    # an explicit backend name must still pass through untouched
+    monkeypatch.setattr(spgemm_mod, "resolve_backend",
+                        lambda be: "pallas" if be is None else be)
+    times = iter([0.1, 0.2] * 64)  # exact (nomod) measures faster -> VPU
+    monkeypatch.setattr(crossover, "_time_call",
+                        lambda fn, args, repeats=2: next(times))
+    with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
+        c = spgemm(a, b, backend="hybrid")
+    m = re.search(r"spgemm\[hybrid mxu=(\d+)/(\d+)\]", caplog.text)
+    assert m and int(m.group(1)) == 0 and int(m.group(2)) > 0, caplog.text
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+    assert c == want  # proven rounds ran the nomod pallas kernel, bit-exact
+
+
 def test_safe_exact_bound():
     assert safe_exact_bound(0, 0, 4, 32) == 0
     assert safe_exact_bound(1, 1, 4, 32) == 128  # boolean adjacency

@@ -65,6 +65,52 @@ def test_pair_block_matches_unblocked(algo, pb):
     assert np.array_equal(np.asarray(w[1]), np.asarray(g[1]))
 
 
+@pytest.mark.parametrize("algo", ["colbcast", "vecj"])
+def test_no_mod_matches_exact_in_proven_regime(algo):
+    """u64.mac_nomod (28-op MAC) must be bit-identical to the exact kernel
+    whenever the safe_exact_bound proof regime holds -- every product and
+    partial sum < 2^64-1, so each mod_max is identity.  Hybrid dispatch
+    routes proven rounds here when the speed gate keeps them on the VPU."""
+    import jax.numpy as jnp
+
+    from spgemm_tpu.ops import u64
+    from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound
+    from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas
+    from spgemm_tpu.utils.gen import random_values
+
+    rng = np.random.default_rng(len(algo))
+    k, nnzb, K, P = 8, 9, 12, 4
+    bound = (1 << 24) - 1
+    assert safe_exact_bound(bound, bound, P, k) is not None  # proven regime
+    tiles = random_values((nnzb + 1, k, k), rng, "full") % np.uint64(bound + 1)
+    tiles[-1] = 0
+    hi, lo = map(jnp.asarray, u64.u64_to_hilo(tiles))
+    pa = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    pb = jnp.asarray(rng.integers(0, nnzb + 1, size=(K, P), dtype=np.int32))
+    w = numeric_round_pallas(hi, lo, hi, lo, pa, pb, interpret=True, algo=algo)
+    g = numeric_round_pallas(hi, lo, hi, lo, pa, pb, interpret=True, algo=algo,
+                             no_mod=True)
+    assert np.array_equal(np.asarray(w[0]), np.asarray(g[0]))
+    assert np.array_equal(np.asarray(w[1]), np.asarray(g[1]))
+
+    # non-vacuity: outside the proven regime the variants genuinely diverge.
+    # mod_max fires only on the exact value 2^64-1 (never on random data),
+    # so construct it: (2^64-1) * 1 collapses to 0 under mulmod and stays
+    # 2^64-1 under mul64_lo.
+    t = np.zeros((3, k, k), np.uint64)
+    t[0, 0, 0] = (1 << 64) - 1
+    t[1, 0, 0] = 1
+    chi, clo = map(jnp.asarray, u64.u64_to_hilo(t))
+    one = jnp.zeros((1, 1), jnp.int32)
+    wf = numeric_round_pallas(chi, clo, chi, clo, one, one + 1,
+                              interpret=True, algo=algo)
+    gf = numeric_round_pallas(chi, clo, chi, clo, one, one + 1,
+                              interpret=True, algo=algo, no_mod=True)
+    assert int(np.asarray(wf[0])[0, 0, 0]) == 0 == int(np.asarray(wf[1])[0, 0, 0])
+    assert u64.hilo_to_u64(np.asarray(gf[0]), np.asarray(gf[1]))[0, 0, 0] \
+        == np.uint64((1 << 64) - 1)
+
+
 @pytest.mark.parametrize("dist", ["full", "adversarial"])
 def test_vecj_algo_matches_colbcast(dist):
     """The vectorized-j kernel layout must be bit-identical to the unrolled
