@@ -339,12 +339,14 @@ def config_webbase_1mrow():
     """The webbase structure at its HONEST scale: 1,000,000 element rows
     (31250 block-rows x k=32, ~119k tiles, ~30 GFLOP of join work),
     single-chip device-resident pipeline, full-range values, sampled exact
-    parity.  TPU-gated: the CPU backend's exact-kernel rate makes this
-    scale impractical in CI, and the 4-chip rowshard config above already
-    covers the strategy on the virtual mesh."""
+    parity.  TPU-gated in the suite (the CPU backend's exact-kernel rate
+    makes it a multi-minute row, too slow for the fail-gated core run);
+    SPGEMM_TPU_FORCE_1MROW=1 runs it anyway -- the honest-scale execution
+    evidence matters even when only the CPU backend is reachable."""
     import jax
 
-    if jax.devices()[0].platform != "tpu":
+    if (jax.devices()[0].platform != "tpu"
+            and not os.environ.get("SPGEMM_TPU_FORCE_1MROW")):
         return {"config": "webbase-1Mrow", "skipped":
                 "needs TPU (1M-row scale impractical at CPU kernel rates)"}
     from spgemm_tpu.ops.spgemm import resolve_backend
