@@ -60,9 +60,10 @@ SPGEMM_TPU_BENCH_TIMEOUT=2900 timeout 3000 python bench.py --preset large 2>&1 \
   | tee "$OUT/bench_large.txt" | tail -1 \
   || echo "large-scale bench did not complete (see bench_large.txt)"
 # webbase at its honest 1M-element-row scale, single chip.  extras.jsonl
-# is truncated per capture like every other artifact here (write_table
-# also keeps only the newest row per config as a second guard).
-: > "$OUT/extras.jsonl"
+# is APPENDED, never pre-truncated: it can hold a git-tracked CPU
+# fallback row, and a failed/hung TPU attempt must not destroy it.
+# write_table keeps only the newest row per config, so a successful TPU
+# row appended here supersedes any earlier row on the next table write.
 timeout 1200 python benchmarks/run.py --config webbase-1Mrow 2>&1 \
   | tee "$OUT/webbase_1mrow.txt" | tail -1 | grep '^{' >> "$OUT/extras.jsonl" \
   || echo "webbase-1Mrow did not complete (see webbase_1mrow.txt)"

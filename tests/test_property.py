@@ -98,6 +98,56 @@ def test_u64_field_mulmod_is_true_residue(a, b):
     assert got == (a * b) % MAX  # true residue, canonical rep in [0, MAX-1]
 
 
+@settings(max_examples=200, deadline=None)
+@given(a=st.integers(0, (1 << 30) - 1), b=st.integers(0, (1 << 30) - 1),
+       acc=st.integers(0, (1 << 62) - 1))
+def test_u64_mac_nomod_matches_mac_in_proven_regime(a, b, acc):
+    """mac_nomod (the 28-op proven-regime MAC hybrid dispatch uses) must
+    equal mac whenever product and sum stay below 2^64-1 -- here
+    a*b < 2^60 and acc + a*b < 2^63, comfortably inside the
+    safe_exact_bound envelope."""
+    ah, al = u64.u64_to_hilo(np.array([a], np.uint64))
+    bh, bl = u64.u64_to_hilo(np.array([b], np.uint64))
+    ch, cl = u64.u64_to_hilo(np.array([acc], np.uint64))
+    wh, wl = u64.mac(ch, cl, ah, al, bh, bl)
+    gh, gl = u64.mac_nomod(ch, cl, ah, al, bh, bl)
+    assert int(u64.hilo_to_u64(np.asarray(wh), np.asarray(wl))[0]) \
+        == int(u64.hilo_to_u64(np.asarray(gh), np.asarray(gl))[0]) \
+        == scalar_mac(acc, a, b)
+
+
+@settings(max_examples=25, deadline=None)
+@given(ab=matrix_pairs(), n_dev=st.integers(1, 8))
+def test_plan_ring_covers_join_exactly(ab, n_dev):
+    """Every join pair appears in the ring schedule exactly once, in its
+    key's row, in the slab owning its B tile -- for arbitrary structures,
+    device counts, and the empty-join edge."""
+    from spgemm_tpu.parallel.ring import plan_ring
+
+    a, b = ab
+    join = symbolic_join(a.coords, b.coords)
+    if join.num_keys == 0:
+        return
+    key_chunks, slab_bounds, pa_all, pb_all, s_max = plan_ring(
+        join, b.nnzb, n_dev)
+    seen = []
+    for d, chunk in enumerate(key_chunks):
+        for row, ki in enumerate(chunk):
+            for s in range(n_dev):
+                for pa_v, pb_v in zip(pa_all[d, s, row], pb_all[d, s, row]):
+                    if pa_v < 0:
+                        continue
+                    gb = pb_v + slab_bounds[s]
+                    assert slab_bounds[s] <= gb < slab_bounds[s + 1]
+                    seen.append((int(ki), int(pa_v), int(gb)))
+    want = []
+    for ki in range(join.num_keys):
+        lo, hi = join.pair_ptr[ki], join.pair_ptr[ki + 1]
+        want += [(ki, int(pa_v), int(pb_v))
+                 for pa_v, pb_v in zip(join.pair_a[lo:hi], join.pair_b[lo:hi])]
+    assert sorted(seen) == sorted(want)
+
+
 @settings(max_examples=50, deadline=None)
 @given(ab=matrix_pairs())
 def test_symbolic_join_vs_bruteforce(ab):
