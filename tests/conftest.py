@@ -21,4 +21,21 @@ assert not jax._src.xla_bridge._backends, (
     "a jax backend initialized before conftest -- platform pinning failed")
 jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_repo_script(args, timeout=240, **env_overrides):
+    """Subprocess runner shared by tests that drive repo entry points
+    (bench.py, benchmarks/run.py, the CLI): repo root on PYTHONPATH (no
+    empty entries -- an empty PYTHONPATH element puts the subprocess cwd
+    on sys.path), JAX_PLATFORMS=cpu for the child's own pinning paths."""
+    import subprocess
+
+    extra = [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.pathsep.join([REPO] + extra),
+           **env_overrides}
+    return subprocess.run([sys.executable, *args], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)

@@ -7,15 +7,9 @@ import os
 import subprocess
 import sys
 
+from conftest import run_repo_script as _run
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _run(args, timeout=240):
-    env = {**os.environ,
-           "JAX_PLATFORMS": "cpu",
-           "PYTHONPATH": REPO + ":" + os.environ.get("PYTHONPATH", "")}
-    return subprocess.run([sys.executable, *args], cwd=REPO, env=env,
-                          capture_output=True, text=True, timeout=timeout)
 
 
 def test_bench_prints_one_json_line():

@@ -1,5 +1,7 @@
 """Text format reader/writer: roundtrip, byte-exactness, std::map semantics."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -24,6 +26,27 @@ def test_golden_bytes_exact_format(tmp_path):
     path = tmp_path / "matrix"
     io_text.write_matrix(str(path), m)
     assert path.read_bytes() == golden
+
+
+def test_golden_chain_end_to_end_cli(tmp_path):
+    """COMMITTED golden fixture (SURVEY.md section 4 'golden files'): a tiny
+    adversarial-valued chain directory in the reference text format plus the
+    expected ./matrix bytes, derived from the python-int oracle when the
+    fixture was created -- NOT from the engine.  Pins the full pipeline
+    (reader -> chain engine -> pruning -> writer) byte-for-byte across time;
+    a reader+writer bug pair that cancels in round-trip tests cannot cancel
+    here."""
+    from conftest import run_repo_script
+
+    data = os.path.join(os.path.dirname(__file__), "data")
+    out = tmp_path / "matrix"
+    rc = run_repo_script(
+        ["-m", "spgemm_tpu.cli", os.path.join(data, "golden_chain"),
+         "--device", "cpu", "--output", str(out)], timeout=300)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    with open(os.path.join(data, "golden_chain_expected_matrix"), "rb") as f:
+        want = f.read()
+    assert out.read_bytes() == want
 
 
 def test_reader_roundtrip(tmp_path):
