@@ -59,14 +59,16 @@ def _chain_config(args, rng):
     return mats
 
 
-def _shrink_to_cpu(args) -> None:
+def _shrink_to_cpu(args, reason: str) -> None:
     """Pin CPU and shrink the workload (the CPU backend cannot finish the
-    100k-tile chain in bench-compatible time)."""
+    100k-tile chain in bench-compatible time).  `reason` (the actual probe
+    outcome / init failure) tags the emitted row's detail.fallback."""
     from spgemm_tpu.utils.backend_probe import pin
 
     pin("cpu")
     args.block_dim = min(args.block_dim, 64)
     args.chain = min(args.chain, 4)
+    args.cpu_fallback = reason
 
 
 def _init_platform(args) -> str:
@@ -107,7 +109,7 @@ def _init_platform(args) -> str:
         if outcome != "ok":
             print(f"no accelerator (probe: {outcome}); falling back to cpu",
                   file=sys.stderr)
-            _shrink_to_cpu(args)
+            _shrink_to_cpu(args, f"backend probe: {outcome}")
 
     # persistent compilation cache: the first-ever run pays ~100 s of Pallas/
     # XLA compiles for the round-shape classes; subsequent runs hit the cache
@@ -127,7 +129,7 @@ def _init_platform(args) -> str:
                 pass
             if attempt < 2:
                 time.sleep(5 * (attempt + 1))
-    _shrink_to_cpu(args)
+    _shrink_to_cpu(args, "backend init raised repeatedly")
     return jax.devices()[0].platform
 
 
@@ -397,6 +399,14 @@ def _run(args) -> int:
             "values_dist": args.dist, "multiply": args.multiply,
             "tpu_parity": tpu_parity,
             "phases_s": phases,
+            **({"fallback": {
+                "reason": f"{args.cpu_fallback}; CPU with clamped workload",
+                "standing_evidence": "see the newest BENCH_r*.json with a "
+                                     "tpu-tagged metric (driver-captured "
+                                     "headline) and the current round's "
+                                     "benchmarks/ROUND*_NOTES.md for "
+                                     "in-session honest-scale rows",
+            }} if getattr(args, "cpu_fallback", None) else {}),
         },
     }))
     return 0
