@@ -381,21 +381,25 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     This is the same staging model as a *capacity* mode: operands stay host-
     resident, and each round uploads only the tiles it references --
 
-      peak HBM = TWO rounds' sub-slabs + output tiles (depth-2 pipeline),
+      peak HBM = TWO rounds' sub-slabs + output tiles (depth-2 pipeline;
+      SPGEMM_TPU_OOC_DEPTH=N deepens the pipeline to N rounds of overlap
+      at N rounds of peak HBM when landing D2H is the bottleneck),
 
     bounded by round_size regardless of operand size, at the cost of one
     upload per referenced tile per round (banded/clustered structures re-use
     tiles within a round, so uploads are deduplicated per round).
 
     Sub-slab sizes are padded to the 3/4-pow-2 ladder so the jit cache sees
-    a logarithmic set of shapes, and rounds are pipelined two-deep: round
-    i+1's host-side gather and upload overlap round i's device execution.
+    a logarithmic set of shapes, and rounds are pipelined depth-deep
+    (default two): the next rounds' host-side gathers and uploads overlap
+    round i's device execution.
 
     Semantics, ordering, and output structure are identical to spgemm
     (reference wrap-then-mod, SURVEY.md section 2.9), including per-round
     'hybrid' dispatch (exact host-side value bounds feed the same proof as
     the resident pipeline's).
     """
+    import os  # noqa: PLC0415
     from types import SimpleNamespace  # noqa: PLC0415
 
     from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
@@ -472,15 +476,22 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         out_tiles[key_index] = u64.hilo_to_u64(np.asarray(oh[:n]),
                                                np.asarray(ol[:n]))
 
+    # pipeline depth: round i+depth-1 stages while round i executes;
+    # landing blocks only on the oldest in-flight round.  Depth 2 is the
+    # documented default (peak HBM = two rounds); depth 1 lands every
+    # round before staging the next (minimal HBM, zero overlap); deeper
+    # pipelines trade HBM for more landing/compute overlap when D2H is
+    # the bottleneck (the Large-preset profile, ROUND4_NOTES) -- peak
+    # HBM = `depth` rounds' working sets.
+    depth = max(1, int(os.environ.get("SPGEMM_TPU_OOC_DEPTH", "2")))
     mxu_rounds = 0
-    in_flight: list = []  # [(out_hi, out_lo, key_index)] -- depth 2: round
-    # i+1 stages while round i executes; landing blocks only on round i
+    in_flight: list = []  # [(out_hi, out_lo, key_index)]
     for rnd in rounds:
         with timers.phase("numeric_dispatch"):
             (oh, ol), used_mxu = stage(rnd)
             mxu_rounds += used_mxu
         in_flight.append((oh, ol, rnd.key_index))
-        if len(in_flight) > 1:
+        if len(in_flight) >= depth:
             with timers.phase("assembly"):
                 land(*in_flight.pop(0))
     with timers.phase("assembly"):
