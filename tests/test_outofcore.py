@@ -44,6 +44,20 @@ def test_outofcore_matches_resident_banded():
     assert got == spgemm(a, b)
 
 
+@pytest.mark.parametrize("depth", ["1", "4"])
+def test_outofcore_depth_knob_bit_identical(depth, monkeypatch):
+    """SPGEMM_TPU_OOC_DEPTH (1 = land-every-round minimal HBM, deeper =
+    more landing/compute overlap) must not change a single bit; tiny
+    round_size forces many rounds through the pipeline so the landing
+    cadence genuinely differs between depths."""
+    monkeypatch.setenv("SPGEMM_TPU_OOC_DEPTH", depth)
+    rng = np.random.default_rng(13)
+    a = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    b = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    got = spgemm_outofcore(a, b, round_size=3)
+    assert got == _oracle(a, b)
+
+
 def test_outofcore_tiny_rounds_force_multi_round_pipeline():
     """round_size=2 forces many rounds through the depth-2 pipeline and
     heavy sentinel padding; results must stay bit-identical."""
