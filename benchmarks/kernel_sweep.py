@@ -36,18 +36,20 @@ def _digest(x):
     return int(jnp.asarray(x).ravel()[0])
 
 
-def _time_round(fn, args, flops):
+def _time_round(fn, args, flops, repeats=2):
     import jax
 
     out = fn(*args)
     jax.block_until_ready(out)
     _digest(out[0])  # warm-up completion barrier
-    t0 = time.perf_counter()
-    out = fn(*args)
-    _digest(out[0])
-    _digest(out[1])
-    dt = time.perf_counter() - t0
-    return dt, flops / dt / 1e9
+    best = float("inf")
+    for _ in range(repeats):  # min-of-N: one-shot timings on this tunnel
+        t0 = time.perf_counter()  # are noisy (round-3 sweep variance)
+        out = fn(*args)
+        _digest(out[0])
+        _digest(out[1])
+        best = min(best, time.perf_counter() - t0)
+    return best, flops / best / 1e9
 
 
 def main() -> int:
