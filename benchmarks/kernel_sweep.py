@@ -10,9 +10,10 @@ Times the numeric-phase kernels head to head at bench-realistic shapes
     (ops/mxu_spgemm.py) at 10x10 and bounded 3x3 limb grids -- VERDICT #1.
 
 Run: python benchmarks/kernel_sweep.py [--quick]
-Each timing uses a compile+digest warm-up, then times one dispatch with a
-digest completion barrier (jax.block_until_ready is acknowledged at enqueue
-by this environment's TPU tunnel).
+Each timing uses a compile+digest warm-up, then reports the MIN of two
+timed dispatches, each with a digest completion barrier
+(jax.block_until_ready is acknowledged at enqueue by this environment's
+TPU tunnel; one-shot timings through it are noisy).
 """
 
 from __future__ import annotations

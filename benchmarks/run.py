@@ -515,32 +515,36 @@ def _sweep_section():
     the directory for custom-outdir runs)."""
     ev_dir = os.environ.get("SPGEMM_TPU_EVIDENCE_DIR",
                             os.path.join(REPO, "benchmarks", "evidence"))
-    path = os.path.join(ev_dir, "sweep.txt")
-    if not os.path.exists(path):
-        return []
     rows = []
-    with open(path) as f:
-        for ln in f:
-            ln = ln.strip()
-            if ln.startswith("{"):
-                try:
-                    rows.append(json.loads(ln))
-                except json.JSONDecodeError:
-                    pass
+    # sweep_k64.txt: the best-effort beyond-reference tile-size sweep --
+    # same row schema (each row carries its k), one shared table
+    for name in ("sweep.txt", "sweep_k64.txt"):
+        path = os.path.join(ev_dir, name)
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    try:
+                        rows.append(json.loads(ln))
+                    except json.JSONDecodeError:
+                        pass
     if not rows:
         return []
     lines = ["## Kernel variants (benchmarks/kernel_sweep.py)",
              "",
-             "| variant | K | P | G | platform | wall ms | eff. GFLOP/s |",
-             "|---|---|---|---|---|---|---|"]
+             "| variant | k | K | P | G | platform | wall ms | eff. GFLOP/s |",
+             "|---|---|---|---|---|---|---|---|"]
     for r in rows:
         if "error" in r:
             err = r["error"][:50].replace("|", "\\|")
-            lines.append(f"| {r['variant']} | {r['K']} | {r['P']} | "
-                         f"{r.get('G', '')} | {r['platform']} | ERROR | {err} |")
+            lines.append(f"| {r['variant']} | {r.get('k', '')} | {r['K']} | "
+                         f"{r['P']} | {r.get('G', '')} | {r['platform']} | "
+                         f"ERROR | {err} |")
         else:
-            lines.append(f"| {r['variant']} | {r['K']} | {r['P']} | "
-                         f"{r.get('G', '')} | {r['platform']} | "
+            lines.append(f"| {r['variant']} | {r.get('k', '')} | {r['K']} | "
+                         f"{r['P']} | {r.get('G', '')} | {r['platform']} | "
                          f"{r['wall_ms']} | {r['effective_gflops']} |")
     return lines
 
