@@ -134,7 +134,7 @@ def fold_piece_sums(limbs):
 
 
 def _kernel(pa_ref, pb_ref, *refs, k: int, R: int, blocks: int,
-            La: int, Lb: int, raw: bool):
+            La: int, Lb: int, raw: bool, w_pad: int = 0):
     # refs layout: ah x R, al x R, bh x R, bl x R, out[, scratch]
     ahs = [r[0] for r in refs[0 * R:1 * R]]            # each (k, k) uint32
     als = [r[0] for r in refs[1 * R:2 * R]]
@@ -153,6 +153,12 @@ def _kernel(pa_ref, pb_ref, *refs, k: int, R: int, blocks: int,
     b_cat = jnp.concatenate(
         [jnp.concatenate(_limb_planes_bf16(h, l, Lb), axis=1)   # (k, Lb*k)
          for h, l in zip(bhs, bls)], axis=0)                    # (R*k, Lb*k)
+    if raw and w_pad > Lb * k:
+        # pad the lane dim to a 128 multiple so the raw output block has a
+        # Mosaic-legal minor dim on chip (zero columns add nothing to the
+        # dot); sliced off again in the XLA epilogue
+        b_cat = jnp.concatenate(
+            [b_cat, jnp.zeros((R * k, w_pad - Lb * k), b_cat.dtype)], axis=1)
 
     # The MXU step: every one of the La*Lb limb-pair blocks in one dot.
     s = jax.lax.dot_general(a_cat, b_cat, (((1,), (0,)), ((), ())),
@@ -249,11 +255,15 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
     tile_spec_a = [pl.BlockSpec((1, k, k), a_map(r)) for r in range(R)]
     tile_spec_b = [pl.BlockSpec((1, k, k), b_map(r)) for r in range(R)]
     if raw_epilogue:
-        out_spec = pl.BlockSpec((1, La * k, Lb * k),
+        # lane dim padded to a 128 multiple (Mosaic minor-dim tiling; the
+        # ADVICE r4 on-chip concern) -- zero columns, sliced off post-kernel
+        w_pad = -(-(Lb * k) // 128) * 128
+        out_spec = pl.BlockSpec((1, La * k, w_pad),
                                 lambda kk, pblk, pa, pb: (kk, 0, 0))
-        out_shape = [jax.ShapeDtypeStruct((K, La * k, Lb * k), jnp.int32)]
+        out_shape = [jax.ShapeDtypeStruct((K, La * k, w_pad), jnp.int32)]
         scratch = []
     else:
+        w_pad = 0
         out_spec = pl.BlockSpec((1, 8, k, k),
                                 lambda kk, pblk, pa, pb: (kk, 0, 0, 0))
         out_shape = [jax.ShapeDtypeStruct((K, 8, k, k), jnp.uint32)]
@@ -268,7 +278,7 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
     )
     (out,) = pl.pallas_call(
         partial(_kernel, k=k, R=R, blocks=blocks, La=La, Lb=Lb,
-                raw=raw_epilogue),
+                raw=raw_epilogue, w_pad=w_pad),
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
@@ -281,5 +291,6 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
       *([a_hi] * R), *([a_lo] * R), *([b_hi] * R), *([b_lo] * R))
     # final fold outside the kernel (see module docstring), batched over keys
     if raw_epilogue:
+        out = out[:, :, :Lb * k]
         return fold_piece_sums(piece_sums_batched(out, k, La, Lb))
     return fold_piece_sums([out[:, i] for i in range(8)])

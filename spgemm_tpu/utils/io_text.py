@@ -16,7 +16,7 @@ space-separated values with NO trailing space (:601-605).
 
 The reference parses files with one OpenMP task per file over 16 threads
 (:334-341); here parsing is vectorized numpy per file plus a thread pool
-across files (utils/loader.py), with an optional C++ fast path (native/).
+across files (read_chain, below), with an optional C++ fast path (native/).
 """
 
 from __future__ import annotations

@@ -151,7 +151,7 @@ def test_hybrid_perf_gate_routes_to_measured_winner(tmp_path, monkeypatch,
     for exact_s, mxu_s, expect_mxu in [(0.1, 0.2, False), (0.2, 0.1, True)]:
         cache_dir = tmp_path / f"e{exact_s}"
         monkeypatch.setenv("SPGEMM_TPU_CROSSOVER_CACHE", str(cache_dir))
-        monkeypatch.setattr(crossover, "_CACHE", None)  # drop stale cache
+        monkeypatch.setattr(crossover, "_CACHE", {})  # fresh in-process cache
         times = iter([exact_s, mxu_s] * 64)  # exact measured first, per key
         monkeypatch.setattr(crossover, "_time_call",
                             lambda fn, args, repeats=2: next(times))
@@ -172,7 +172,7 @@ def test_hybrid_perf_gate_routes_to_measured_winner(tmp_path, monkeypatch,
                            DeviceBlockMatrix.from_host(b), backend="hybrid")
         assert dc.val_bound < (1 << 64) - 2, (expect_mxu, dc.val_bound)
         # the decision is persisted: a fresh in-process cache re-reads it
-        monkeypatch.setattr(crossover, "_CACHE", None)
+        monkeypatch.setattr(crossover, "_CACHE", {})
         monkeypatch.setattr(
             crossover, "_time_call",
             lambda *a, **k: pytest.fail("re-measured despite disk cache"))
@@ -201,7 +201,7 @@ def test_hybrid_proven_route_dispatches_nomod_pallas(tmp_path, monkeypatch,
     b = random_block_sparse(6, 6, 4, 0.5, rng, "small")
     monkeypatch.setenv("SPGEMM_TPU_HYBRID_GATE", "auto")
     monkeypatch.setenv("SPGEMM_TPU_CROSSOVER_CACHE", str(tmp_path))
-    monkeypatch.setattr(crossover, "_CACHE", None)
+    monkeypatch.setattr(crossover, "_CACHE", {})
     # exact backend resolves to the Pallas kernel (interpret mode on CPU);
     # an explicit backend name must still pass through untouched
     monkeypatch.setattr(spgemm_mod, "resolve_backend",
@@ -272,3 +272,58 @@ def test_hybrid_mixed_fanout_per_round_dispatch(caplog):
     want = BlockSparseMatrix.from_dict(
         a2.rows, b2.cols, k, spgemm_oracle(a2.to_dict(), b2.to_dict(), k))
     assert c == want  # bit-exact reference semantics from the mixed dispatch
+
+
+def test_time_call_reads_device_output(monkeypatch):
+    """ADVICE r4 (medium): on this environment's TPU tunnel,
+    block_until_ready acks at enqueue, so _time_call must fetch a scalar
+    from every output leaf inside the timed region (kernel_sweep._digest
+    pattern) or the crossover cache records dispatch latency as kernel
+    time.  Pin that the digest touches each leaf of the timed call."""
+    import jax.numpy as jnp
+
+    from spgemm_tpu.ops import crossover
+
+    fetched = []
+    real_digest = crossover._digest
+    monkeypatch.setattr(crossover, "_digest",
+                        lambda out: fetched.append(real_digest(out)))
+
+    def fn(x):
+        return x + 1, x * 2
+
+    dt = crossover._time_call(fn, (jnp.arange(4, dtype=jnp.uint32),))
+    assert dt >= 0.0
+    # warmup + 2 timed repeats, each through the digest
+    assert len(fetched) == 3
+    # and the digest really folds both leaves: (0+1) ^ (0*2) = 1
+    assert fetched[0] == 1
+
+
+def test_crossover_cache_keyed_by_path(tmp_path, monkeypatch):
+    """ADVICE r4 (low): switching SPGEMM_TPU_CROSSOVER_CACHE mid-process
+    must not leak entries between the old and new cache files."""
+    import json
+
+    from spgemm_tpu.ops import crossover
+
+    monkeypatch.setattr(crossover, "_CACHE", {})
+    dir_a, dir_b = tmp_path / "a", tmp_path / "b"
+
+    monkeypatch.setenv("SPGEMM_TPU_CROSSOVER_CACHE", str(dir_a))
+    crossover._load()["k1"] = {"exact_s": 1.0, "mxu_s": 2.0}
+    crossover._save()
+
+    monkeypatch.setenv("SPGEMM_TPU_CROSSOVER_CACHE", str(dir_b))
+    assert "k1" not in crossover._load()  # no leak from dir_a
+    crossover._load()["k2"] = {"exact_s": 3.0, "mxu_s": 1.0}
+    crossover._save()
+
+    with open(dir_a / "hybrid_crossover.json") as f:
+        on_a = json.load(f)
+    with open(dir_b / "hybrid_crossover.json") as f:
+        on_b = json.load(f)
+    assert set(on_a) == {"k1"} and set(on_b) == {"k2"}
+    # and dir_a's in-memory view still serves its own entries
+    monkeypatch.setenv("SPGEMM_TPU_CROSSOVER_CACHE", str(dir_a))
+    assert "k1" in crossover._load() and "k2" not in crossover._load()
