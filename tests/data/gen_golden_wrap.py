@@ -1,0 +1,152 @@
+"""Generator for the golden_wrap adversarial fixture (provenance record).
+
+Run from the repo root:  python tests/data/gen_golden_wrap.py
+
+Produces tests/data/golden_wrap/{size,matrix1,matrix2,matrix3} and
+tests/data/golden_wrap_expected_matrix.  The expected bytes come from the
+SCALAR python-int oracle (utils/semantics.scalar_tile_matmul) -- arbitrary
+precision, no numpy, no engine code -- cross-checked here against the
+vectorized numpy oracle before anything is written.
+
+The chain is hand-constructed so that the reference's wrap-then-mod fold
+order (SURVEY.md section 2.9; sparse_matrix_mult.cu:48,59-61) is load-bearing
+in the expected output.  Three distinct collapses are forced:
+
+  1. product u64 wrap:   2^32 * 2^32 = 2^64 wraps to 0, then %MAX keeps 0
+     (clean mod-(2^64-1) arithmetic would give 1);
+  2. product == MAX:     MAX * 1 -> p' = 0 (same in both semantics --
+     included so the %MAX equality branch is exercised, not just the wrap);
+  3. accumulator u64 wrap: 2^63 + 2^63 = 2^64 wraps to 0 (clean: 1).
+
+Collapse 3 is additionally arranged to zero an ENTIRE output tile, so the
+final zero-tile prune (sparse_matrix_mult.cu:577-592) removes it: under
+clean semantics that tile would be all-ones and kept, making the expected
+file differ STRUCTURALLY (block count), not just in values.  Any "cleanup"
+of the non-associative fold order turns the golden test red.
+
+matrix3 is a block identity, so the wrap-born values of pass 1 must survive
+an exact second chain pass (and the helper2 odd-carry pairing) unchanged.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from spgemm_tpu.utils import io_text, semantics
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+K = 4
+MAX = semantics.MAX_INT
+P63 = 1 << 63
+P32 = 1 << 32
+
+
+def _tile(rows):
+    return np.array(rows, dtype=np.uint64)
+
+
+def build_chain():
+    z = [0] * K
+    # --- M1: 2x2 block grid (8x8 elements) -------------------------------
+    # Block (0,0) row 0 is the three-collapse row: against M2(0,0) col 0
+    #   j=0: 2^63*1, j=1: 2^63*1  -> acc wraps 2^64 -> 0     (collapse 3)
+    #   j=2: 2^32*2^32 = 2^64     -> p wraps to 0            (collapse 1)
+    #   j=3: MAX*1                -> p' = 0                  (collapse 2)
+    # reference C(0,0)[0,0] from this pair: 0; clean arithmetic: 2.
+    m1 = {
+        (0, 0): _tile([[P63, P63, P32, MAX],
+                       [1, 0, 0, 0],
+                       [0, 2, 0, 0],
+                       z]),
+        # second pair into output key (0,0): plain small values, checks the
+        # j-ascending multi-pair fold lands AFTER the (0,0) pair.
+        (0, 1): _tile([[3, 0, 0, 0], z, z, z]),
+        # feeds output tile (1,1): every element 2^63+2^63 -> wraps to an
+        # ALL-ZERO tile (pruned at write); clean semantics: all-ones (kept).
+        (1, 1): _tile([[P63, P63, 0, 0]] * K),
+    }
+    # --- M2 ---------------------------------------------------------------
+    m2 = {
+        (0, 0): _tile([[1, 7, 0, 0],
+                       [1, 0, 0, 0],
+                       [P32, 0, 0, 0],
+                       [1, 0, 0, 0]]),
+        (1, 0): _tile([[5, 0, 0, 0], z, z, z]),
+        (1, 1): _tile([[1, 1, 1, 1],
+                       [1, 1, 1, 1],
+                       z, z]),
+    }
+    # --- M3: block identity (the wrapped values must survive a 2nd pass) --
+    eye = np.eye(K, dtype=np.uint64)
+    m3 = {(0, 0): eye, (1, 1): eye}
+    return [m1, m2, m3]
+
+
+def scalar_chain(mats):
+    """Chain product with helper2 pairing, entirely in python ints."""
+    arr = [{c: [[int(v) for v in row] for row in t] for c, t in m.items()}
+           for m in mats]
+    while len(arr) > 1:
+        nxt = []
+        for i in range(0, len(arr) - 1, 2):
+            a, b = arr[i], arr[i + 1]
+            b_rows = {}
+            for (br, bc) in sorted(b):
+                b_rows.setdefault(br, []).append(bc)
+            out = {}
+            for (ar, ac) in sorted(a):
+                for bc in b_rows.get(ac, ()):
+                    acc = out.setdefault((ar, bc), [[0] * K for _ in range(K)])
+                    out[(ar, bc)] = semantics.scalar_tile_matmul(
+                        acc, a[(ar, ac)], b[(ac, bc)])
+            nxt.append(out)
+        if len(arr) % 2 == 1:
+            nxt.append(arr[-1])
+        arr = nxt
+    return arr[0]
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    mats = build_chain()
+
+    want = scalar_chain(mats)
+    # cross-check scalar vs vectorized-numpy oracle before writing anything
+    vec = semantics.chain_oracle(
+        [{c: t.copy() for c, t in m.items()} for m in mats], K)
+    assert set(vec) == set(want)
+    for key in want:
+        assert np.array_equal(vec[key],
+                              np.array(want[key], dtype=np.uint64)), key
+
+    # assert the fixture is actually adversarial: clean field semantics must
+    # differ in VALUES and in post-prune STRUCTURE
+    f1 = semantics.field_spgemm_oracle(mats[0], mats[1], K)
+    f = semantics.field_spgemm_oracle(f1, mats[2], K)
+    ref_nonzero = {c for c, t in want.items()
+                   if any(v for row in t for v in row)}
+    field_nonzero = {c for c, t in f.items() if np.any(t)}
+    # [0,0]: pair 1 folds to 0 via all three collapses, pair 2 adds 3*5=15;
+    # clean semantics: pair 1 gives 2, so 17.  Pin both exactly.
+    assert want[(0, 0)][0][0] == 15, want[(0, 0)][0][0]
+    assert int(f[(0, 0)][0, 0]) == 17, f[(0, 0)][0, 0]
+    assert (1, 1) not in ref_nonzero and (1, 1) in field_nonzero, \
+        "zero-tile prune must differ between semantics"
+
+    out_dir = os.path.join(here, "golden_wrap")
+    ms = [BlockSparseMatrix.from_dict(8, 8, K, m) for m in mats]
+    io_text.write_chain_dir(out_dir, ms, K)
+    result = BlockSparseMatrix.from_dict(8, 8, K, {
+        c: np.array(t, dtype=np.uint64) for c, t in want.items()
+    }).prune_zeros()
+    with open(os.path.join(here, "golden_wrap_expected_matrix"), "wb") as fh:
+        fh.write(io_text.format_matrix(result))
+    print("wrote", out_dir, "and expected matrix:",
+          result.nnzb, "blocks after prune")
+
+
+if __name__ == "__main__":
+    main()

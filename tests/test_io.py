@@ -49,6 +49,38 @@ def test_golden_chain_end_to_end_cli(tmp_path):
     assert out.read_bytes() == want
 
 
+def test_golden_wrap_chain_end_to_end_cli(tmp_path):
+    """Adversarial committed fixture (tests/data/README.md): a hand-built
+    chain forcing all three section-2.9 collapses -- product u64 wrap
+    (2^32*2^32), product==MAX, and accumulator u64 wrap (2^63+2^63) -- the
+    last of which zeroes a whole output tile so the final prune drops it.
+    Under clean mod-(2^64-1) arithmetic the output differs in values AND in
+    block count, so any 'cleanup' of the wrap-then-mod fold order
+    (sparse_matrix_mult.cu:48,59-61) turns this red.  Generator with the
+    derivation: tests/data/gen_golden_wrap.py."""
+    from conftest import run_repo_script
+
+    data = os.path.join(os.path.dirname(__file__), "data")
+    out = tmp_path / "matrix"
+    rc = run_repo_script(
+        ["-m", "spgemm_tpu.cli", os.path.join(data, "golden_wrap"),
+         "--device", "cpu", "--output", str(out)], timeout=300)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    with open(os.path.join(data, "golden_wrap_expected_matrix"), "rb") as f:
+        want = f.read()
+    assert out.read_bytes() == want
+
+    # Non-vacuity, re-asserted at test time (not only in the generator):
+    # clean field-mode semantics on the same chain keeps the pruned tile.
+    from spgemm_tpu.utils import semantics
+    mats = [m.to_dict() for m in
+            io_text.read_chain(os.path.join(data, "golden_wrap"), 0, 2, 4)]
+    f1 = semantics.field_spgemm_oracle(mats[0], mats[1], 4)
+    fld = semantics.field_spgemm_oracle(f1, mats[2], 4)
+    assert np.any(fld[(1, 1)]), "field-mode must keep the tile ref-mode prunes"
+    assert b"\n1 1\n" not in want
+
+
 def test_reader_roundtrip(tmp_path):
     rng = np.random.default_rng(20)
     m = random_block_sparse(8, 8, 4, 0.3, rng, "full")
