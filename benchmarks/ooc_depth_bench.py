@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Out-of-core pipeline depth ladder (VERDICT r4 item 3).
+
+Measures spgemm_outofcore wall time and phase split at SPGEMM_TPU_OOC_DEPTH
+in {1, 2, 4, 8} on one mid-scale multiply, to pick the default depth from
+data instead of guesswork.  Depth 1 is the synchronous minimal-HBM mode;
+depth >= 2 uses the async landing worker (ops/spgemm.py), so the ladder
+directly exposes how much landing/compute overlap buys on this host.
+
+Run: python benchmarks/ooc_depth_bench.py [--device cpu|tpu] [--tiles N]
+One JSON line per depth: {"depth": d, "wall_s": ..., "phases": {...}}.
+A final line reports the fastest depth.  Bit-exactness across depths is
+pinned separately in tests/test_outofcore.py; this script only times.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--device", choices=["cpu", "tpu"], default=None)
+    p.add_argument("--tiles", type=int, default=100_000,
+                   help="approximate nnzb per operand")
+    p.add_argument("--k", type=int, default=32)
+    p.add_argument("--depths", type=int, nargs="+", default=[1, 2, 4, 8])
+    args = p.parse_args()
+
+    if args.device:
+        from spgemm_tpu.utils import backend_probe
+
+        backend_probe.pin(args.device)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+    from spgemm_tpu.ops import spgemm as eng
+    from spgemm_tpu.utils.gen import banded_block_sparse
+    from spgemm_tpu.utils.timers import ENGINE as timers
+
+    platform = jax.devices()[0].platform
+    # banded structure ~= bandwidth * block_dim tiles; solve for block_dim
+    bandwidth = 9
+    block_dim = max(8, args.tiles // bandwidth)
+    rng = np.random.default_rng(42)
+    a = banded_block_sparse(block_dim, args.k, bandwidth, rng, "full")
+    b = banded_block_sparse(block_dim, args.k, bandwidth, rng, "full")
+    print(json.dumps({"config": "ooc-depth-ladder", "platform": platform,
+                      "nnzb_a": a.nnzb, "nnzb_b": b.nnzb, "k": args.k}),
+          flush=True)
+
+    best = (None, float("inf"))
+    for d in args.depths:
+        os.environ["SPGEMM_TPU_OOC_DEPTH"] = str(d)
+        timers.reset()
+        t0 = time.perf_counter()
+        out = eng.spgemm_outofcore(a, b)
+        wall = time.perf_counter() - t0
+        phases = timers.snapshot()
+        asm = phases.get("assembly", 0.0)
+        row = {"depth": d, "wall_s": round(wall, 3),
+               "assembly_share_pct": round(100 * asm / wall, 1),
+               "nnzb_out": out.nnzb, "phases": phases}
+        print(json.dumps(row), flush=True)
+        if wall < best[1]:
+            best = (d, wall)
+    print(json.dumps({"best_depth": best[0], "best_wall_s": round(best[1], 3)}),
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -476,27 +476,73 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         out_tiles[key_index] = u64.hilo_to_u64(np.asarray(oh[:n]),
                                                np.asarray(ol[:n]))
 
-    # pipeline depth: round i+depth-1 stages while round i executes;
-    # landing blocks only on the oldest in-flight round.  Depth 2 is the
-    # documented default (peak HBM = two rounds); depth 1 lands every
-    # round before staging the next (minimal HBM, zero overlap); deeper
-    # pipelines trade HBM for more landing/compute overlap when D2H is
-    # the bottleneck (the Large-preset profile, ROUND4_NOTES) -- peak
-    # HBM = `depth` rounds' working sets.
+    # pipeline depth: how many un-landed rounds may be in flight.  Depth 1
+    # is the synchronous minimal-HBM mode (land each round before staging
+    # the next, zero overlap).  Depth >= 2 hands landing to a dedicated
+    # worker thread: the producer keeps staging/dispatching while the
+    # worker blocks on each round's D2H fetch (np.asarray releases the GIL
+    # during the device wait), so landing no longer absorbs compute wait
+    # in the main loop -- the round-4 Large profile showed 86% of wall in
+    # that blocking fetch (ROUND4_NOTES).  The queue bound keeps peak HBM
+    # at `depth` rounds' outputs + the staging round's operand sub-slabs.
+    # Landing order across rounds is irrelevant to bit-exactness: each
+    # round writes a disjoint key_index slice of out_tiles, and the fold
+    # order lives inside the kernels (test_outofcore pins depths 1/4
+    # bit-identical).
     depth = max(1, int(os.environ.get("SPGEMM_TPU_OOC_DEPTH", "2")))
     mxu_rounds = 0
-    in_flight: list = []  # [(out_hi, out_lo, key_index)]
-    for rnd in rounds:
-        with timers.phase("numeric_dispatch"):
-            (oh, ol), used_mxu = stage(rnd)
-            mxu_rounds += used_mxu
-        in_flight.append((oh, ol, rnd.key_index))
-        if len(in_flight) >= depth:
+    if depth == 1:
+        for rnd in rounds:
+            with timers.phase("numeric_dispatch"):
+                (oh, ol), used_mxu = stage(rnd)
+                mxu_rounds += used_mxu
             with timers.phase("assembly"):
-                land(*in_flight.pop(0))
-    with timers.phase("assembly"):
-        for entry in in_flight:
-            land(*entry)
+                land(oh, ol, rnd.key_index)
+    else:
+        import queue as queue_mod  # noqa: PLC0415
+        import threading  # noqa: PLC0415
+
+        landq: queue_mod.Queue = queue_mod.Queue()
+        land_err: list = []
+        # `slots` is the peak-HBM bound: a round's output slot is taken
+        # before it is staged and released only once it has LANDED, so at
+        # most `depth` rounds' outputs are alive on device -- the same
+        # bound the old synchronous in_flight list enforced (a bounded
+        # queue alone would under-count the item the worker holds).
+        slots = threading.Semaphore(depth)
+
+        def _lander():
+            while True:
+                item = landq.get()
+                if item is None:
+                    return
+                if not land_err:  # keep draining after a failure so the
+                    try:          # producer can never deadlock
+                        with timers.phase("assembly"):
+                            land(*item)
+                    except Exception as e:  # noqa: BLE001 -- re-raised below
+                        land_err.append(e)
+                slots.release()
+
+        lander = threading.Thread(target=_lander, name="ooc-landing",
+                                  daemon=True)
+        lander.start()
+        try:
+            for rnd in rounds:
+                if land_err:
+                    break
+                slots.acquire()
+                with timers.phase("numeric_dispatch"):
+                    (oh, ol), used_mxu = stage(rnd)
+                    mxu_rounds += used_mxu
+                landq.put((oh, ol, rnd.key_index))
+        finally:
+            # always shut the worker down, also when stage() raises --
+            # a leaked lander would pin out_tiles for process lifetime
+            landq.put(None)
+            lander.join()
+        if land_err:
+            raise land_err[0]
 
     total_pairs = int(join.pair_ptr[-1])
     tag = backend if choose_numeric is None \
