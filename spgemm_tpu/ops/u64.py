@@ -208,3 +208,27 @@ def mac_field(acc_hi, acc_lo, a_hi, a_lo, b_hi, b_lo):
     """acc = (acc + a*b) mod (2^64 - 1), clean ring semantics."""
     p_hi, p_lo = mulmod_field(a_hi, a_lo, b_hi, b_lo)
     return addmod_field(acc_hi, acc_lo, p_hi, p_lo)
+
+
+def operands_below_2_32(*mats) -> bool:
+    """True when every operand's values are provably < 2^32 -- the gate that
+    licenses mac_field_b32 (duck-typed over .nnzb/.tiles so both host
+    BlockSparseMatrix and device wrappers work).  Single-sourced here so the
+    ring and inner engines can never diverge on when the b32 route is legal."""
+    return all(m.nnzb == 0 or int(np.asarray(m.tiles).max()) < 1 << 32
+               for m in mats)
+
+
+def mac_field_b32(acc_hi, acc_lo, a_lo, b_lo):
+    """mac_field for PROVEN a, b < 2^32: ~21 vector ops instead of ~128.
+
+    With both operands below 2^32 the product is a*b <= (2^32-1)^2 =
+    2^64 - 2^33 + 1 < 2^64 - 1, so (i) the full 128-bit mul64_full folds
+    to a single exact mul32_wide, and (ii) the product's mod-(2^64-1)
+    collapse is the identity.  Only the accumulate needs field reduction
+    (the accumulator spans the full residue range).  Callers gate on the
+    operands' val_bound -- exactly the proof discipline of mac_nomod, but
+    for field mode.  The hi operand planes are not even read (callers drop
+    those gathers: half the gather traffic)."""
+    p_hi, p_lo = mul32_wide(a_lo, b_lo)
+    return addmod_field(acc_hi, acc_lo, p_hi, p_lo)

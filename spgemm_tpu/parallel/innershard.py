@@ -31,22 +31,34 @@ from spgemm_tpu.parallel.mesh import default_mesh
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 
 
-def fold_pairs_field(a_hi, a_lo, b_hi, b_lo, pa, pb):
-    """Fold (K, P) pair lists into (K, k, k) partial tiles, field semantics."""
+def fold_pairs_field(a_hi, a_lo, b_hi, b_lo, pa, pb, *, small: bool = False):
+    """Fold (K, P) pair lists into (K, k, k) partial tiles, field semantics.
+
+    small=True is the PROVEN bounded route (operands < 2^32, caller-gated on
+    val_bound): u64.mac_field_b32 -- ~6x fewer vector ops per MAC and the hi
+    operand gathers drop out entirely (u64.py docstring has the proof)."""
     K, Pn = pa.shape
     k = a_hi.shape[-1]
-    ah, al = a_hi[pa], a_lo[pa]
-    bh, bl = b_hi[pb], b_lo[pb]
-    ath = jnp.transpose(ah, (1, 0, 2, 3))  # (P, K, ty, j)
-    atl = jnp.transpose(al, (1, 0, 2, 3))
-    bth = jnp.transpose(bh, (1, 0, 2, 3))  # (P, K, j, tx)
-    btl = jnp.transpose(bl, (1, 0, 2, 3))
+    al = a_lo[pa]
+    bl = b_lo[pb]
+    atl = jnp.transpose(al, (1, 0, 2, 3))  # (P, K, ty, j)
+    btl = jnp.transpose(bl, (1, 0, 2, 3))  # (P, K, j, tx)
+    if not small:
+        ath = jnp.transpose(a_hi[pa], (1, 0, 2, 3))
+        bth = jnp.transpose(b_hi[pb], (1, 0, 2, 3))
 
     def body(p, acc):
         acc_h, acc_l = acc
-        pah, pal = ath[p], atl[p]
-        pbh, pbl = bth[p], btl[p]
-        for j in range(k):  # unrolled: field mode is order-free anyway
+        pal, pbl = atl[p], btl[p]
+        if small:
+            for j in range(k):  # unrolled: field mode is order-free anyway
+                acc_h, acc_l = u64.mac_field_b32(
+                    acc_h, acc_l,
+                    pal[:, :, j : j + 1], pbl[:, j : j + 1, :],
+                )
+            return acc_h, acc_l
+        pah, pbh = ath[p], bth[p]
+        for j in range(k):
             acc_h, acc_l = u64.mac_field(
                 acc_h, acc_l,
                 pah[:, :, j : j + 1], pal[:, :, j : j + 1],
@@ -74,11 +86,12 @@ def butterfly_allreduce_modadd(hi, lo, axis_name: str, n_dev: int):
     return hi, lo
 
 
-def _make_sharded_fold(mesh: Mesh):
+def _make_sharded_fold(mesh: Mesh, small: bool = False):
     n_dev = mesh.devices.size
 
     def per_device(a_hi, a_lo, b_hi, b_lo, pa, pb):
-        part_h, part_l = fold_pairs_field(a_hi, a_lo, b_hi, b_lo, pa, pb)
+        part_h, part_l = fold_pairs_field(a_hi, a_lo, b_hi, b_lo, pa, pb,
+                                          small=small)
         if n_dev & (n_dev - 1) == 0 and n_dev > 1:
             return butterfly_allreduce_modadd(part_h, part_l, "inner", n_dev)
         if n_dev == 1:
@@ -122,7 +135,9 @@ def spgemm_inner(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     b_hi, b_lo = pack_tiles(b)
     rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
                          round_size=512 if round_size is None else round_size)
-    fold = _make_sharded_fold(mesh)
+    # proven bounded operands ride the ~6x cheaper b32 MAC (val_bound gate,
+    # same proof discipline as the exact engine's nomod route)
+    fold = _make_sharded_fold(mesh, u64.operands_below_2_32(a, b))
 
     out = np.zeros((join.num_keys, k, k), dtype=np.uint64)
     for rnd in rounds:

@@ -108,6 +108,11 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k)
 
     from spgemm_tpu.ops.spgemm import pack_tiles
+    # proven bounded operands ride the ~6x cheaper b32 MAC (val_bound gate,
+    # same proof discipline as the exact engine's nomod route); in that mode
+    # the hi planes are never built, uploaded, carried, or ring-rotated --
+    # half the slab HBM and half the per-hop ICI bytes
+    small = u64.operands_below_2_32(a, b)
     a_hi, a_lo = pack_tiles(a)  # replicated; sentinel zero tile at a.nnzb
 
     key_chunks, slab_bounds, pa_all, pb_all, s_max = plan_ring(
@@ -116,14 +121,19 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
     # per-device B slab buffers: (n, s_max + 1, k, k), zero tile at s_max
     bh_np, bl_np = u64.u64_to_hilo(b.tiles)
-    b_slab_h = np.zeros((n_dev, s_max + 1, k, k), np.uint32)
     b_slab_l = np.zeros((n_dev, s_max + 1, k, k), np.uint32)
     for s in range(n_dev):
         lo, hi = slab_bounds[s], slab_bounds[s + 1]
-        b_slab_h[s, : hi - lo] = bh_np[lo:hi]
         b_slab_l[s, : hi - lo] = bl_np[lo:hi]
+    if small:
+        b_slab_h = np.zeros((n_dev, 1, 1, 1), np.uint32)  # dummy, unread
+    else:
+        b_slab_h = np.zeros((n_dev, s_max + 1, k, k), np.uint32)
+        for s in range(n_dev):
+            lo, hi = slab_bounds[s], slab_bounds[s + 1]
+            b_slab_h[s, : hi - lo] = bh_np[lo:hi]
 
-    fold = _make_ring_fold(mesh, n_dev)
+    fold = _make_ring_fold(mesh, n_dev, small)
     shard0 = NamedSharding(mesh, P("ring"))
     oh, ol = fold(
         a_hi, a_lo,
@@ -140,29 +150,43 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
                              coords=join.keys, tiles=out)
 
 
-@partial(jax.jit, static_argnames=("mesh", "n_dev"))
-def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb, *, mesh, n_dev):
+@partial(jax.jit, static_argnames=("mesh", "n_dev", "small"))
+def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb, *, mesh, n_dev,
+                   small=False):
     def per_device(a_hi, a_lo, bh, bl, pa, pb):
-        # local shapes: bh (1, s_max+1, k, k), pa (1, n_slab, K, P)
+        # local shapes: bl (1, s_max+1, k, k), pa (1, n_slab, K, P);
+        # small mode: bh is a (1,1,1,1) dummy, never in the carry, never
+        # rotated -- the b32 route's ICI/HBM saving is structural, not DCE
         d = jax.lax.axis_index("ring")
         K = pa.shape[2]
-        k = a_hi.shape[-1]
+        k = a_lo.shape[-1]
         rot_perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
         def step(t, carry):
-            acc_h, acc_l, bh, bl = carry
+            if small:
+                acc_h, acc_l, bl = carry
+            else:
+                acc_h, acc_l, bh, bl = carry
             s = (d - t) % n_dev  # slab currently resident on this device
             pa_s = pa[0, s]      # (K, P) -- dynamic index over the slab axis
             pb_s = pb[0, s]
-            ph, pl = fold_pairs_field(a_hi, a_lo, bh[0], bl[0], pa_s, pb_s)
+            if small:  # hi args unread by the b32 fold: pass lo stand-ins
+                ph, pl = fold_pairs_field(a_lo, a_lo, bl[0], bl[0],
+                                          pa_s, pb_s, small=True)
+            else:
+                ph, pl = fold_pairs_field(a_hi, a_lo, bh[0], bl[0],
+                                          pa_s, pb_s)
             acc_h, acc_l = u64.addmod_field(acc_h, acc_l, ph, pl)
-            bh = jax.lax.ppermute(bh, "ring", rot_perm)  # rotate B one hop
-            bl = jax.lax.ppermute(bl, "ring", rot_perm)
+            bl = jax.lax.ppermute(bl, "ring", rot_perm)  # rotate B one hop
+            if small:
+                return acc_h, acc_l, bl
+            bh = jax.lax.ppermute(bh, "ring", rot_perm)
             return acc_h, acc_l, bh, bl
 
         zero = jnp.zeros((K, k, k), jnp.uint32)
-        acc_h, acc_l, _, _ = jax.lax.fori_loop(
-            0, n_dev, step, (zero, zero, bh, bl))
+        carry0 = (zero, zero, bl) if small else (zero, zero, bh, bl)
+        out = jax.lax.fori_loop(0, n_dev, step, carry0)
+        acc_h, acc_l = out[0], out[1]
         return acc_h[None], acc_l[None]
 
     return jax.shard_map(
@@ -174,5 +198,5 @@ def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb, *, mesh, n_dev):
     )(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb)
 
 
-def _make_ring_fold(mesh: Mesh, n_dev: int):
-    return partial(_ring_fold_jit, mesh=mesh, n_dev=n_dev)
+def _make_ring_fold(mesh: Mesh, n_dev: int, small: bool = False):
+    return partial(_ring_fold_jit, mesh=mesh, n_dev=n_dev, small=small)
