@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Float/MXU FFN sweep: the perf story for BASELINE.json config 5.
+
+The u64 parity engine answers the reference's kernel-rate claim with exact
+arithmetic; THIS sweep is where the MXU answers it in kind -- bf16 block-
+sparse FFN (d_model=4096, d_ff=16384, k=128 tiles, 90% block-sparse)
+measured as TF/s and MFU against the chip's dense bf16 peak
+(benchmarks/ROOFLINE_FFN.md has the peak math and the target).
+
+Variants:
+  * xla-einsum forward (models/ffn.ffn_forward: gather-einsum + segment-sum)
+  * Pallas forward (ops/pallas_bsmm) over a block_m ladder, fused-gelu A/B
+  * sharded train step (dp x tp shard_map) over the mesh shapes the host
+    offers -- 8 virtual CPU devices in CI, real ICI meshes on a pod
+
+Run: python benchmarks/ffn_sweep.py [--quick] [--device cpu|tpu]
+One JSON line per variant (same contract as kernel_sweep.py: compile+digest
+warm-up, then min-of-2 timed dispatches, each with a D2H digest barrier --
+block_until_ready is acknowledged at enqueue by this environment's tunnel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# dense bf16 MXU peak per chip, for the MFU column (ROOFLINE_FFN.md section 1)
+PEAK_TFS = {"tpu": 197.0}  # v5e / v5-lite class
+
+
+def _digest(x):
+    import jax.numpy as jnp
+
+    return float(jnp.asarray(x).ravel()[0])
+
+
+def _time_call(fn, args, repeats=2):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    leaves = jax.tree.leaves(out)
+    _digest(leaves[0])
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        for leaf in jax.tree.leaves(out)[:2]:
+            _digest(leaf)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="small config (CI-feasible on the 1-core CPU host)")
+    p.add_argument("--device", choices=["cpu", "tpu"], default=None)
+    p.add_argument("--batch", type=int, default=None,
+                   help="override batch (default 8, quick 2)")
+    args = p.parse_args()
+
+    if args.device:
+        from spgemm_tpu.utils import backend_probe
+
+        backend_probe.pin(args.device)
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.expanduser("~/.cache/jax_bench"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+    from spgemm_tpu.models import ffn
+
+    platform = jax.devices()[0].platform
+    peak = PEAK_TFS.get(platform)
+
+    if args.quick:
+        cfg = ffn.BlockSparseFFNConfig(d_model=1024, d_ff=4096, k=128,
+                                       block_density=0.25)
+        B, S = args.batch or 2, 512
+    else:
+        # BASELINE.json config 5: d=4096, 4x FFN, 90% block-sparse, k=128
+        cfg = ffn.BlockSparseFFNConfig()
+        B, S = args.batch or 8, 1024
+    M = B * S
+    # FLOPs: matmul1 = 2*M*k^2*rpc per block-col x nb_ff cols; matmul2 same
+    # with cpc (gelu and the segment-sum adds are noise at these shapes)
+    fwd_flops = 2.0 * M * cfg.k ** 2 * cfg.nb_ff * (cfg.rpc + cfg.cpc)
+
+    key = jax.random.PRNGKey(0)
+    params = ffn.init_params(cfg, key)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, S, cfg.d_model),
+                          jnp.bfloat16)
+
+    def emit(name, dt, flops, extra=None):
+        tfs = flops / dt / 1e12
+        row = {"variant": name, "d_model": cfg.d_model, "d_ff": cfg.d_ff,
+               "k": cfg.k, "density": cfg.block_density, "M": M,
+               "platform": platform, "wall_ms": round(dt * 1e3, 2),
+               "tflops_per_s": round(tfs, 3),
+               "mfu_pct": round(100 * tfs / peak, 2) if peak else None}
+        if extra:
+            row.update(extra)
+        print(json.dumps(row), flush=True)
+
+    def try_emit(name, thunk, flops, extra=None):
+        try:
+            dt = thunk()
+            emit(name, dt, flops, extra)
+        except Exception as e:  # noqa: BLE001 -- record, keep sweeping
+            print(json.dumps({"variant": name, "platform": platform,
+                              "error": repr(e)[:200]}), flush=True)
+
+    # --- single-device forwards ------------------------------------------
+    fwd = jax.jit(lambda pr, xx: ffn.ffn_forward(pr, xx, cfg))
+    try_emit("ffn-xla-einsum-fwd", lambda: _time_call(fwd, (params, x)),
+             fwd_flops)
+
+    pparams = ffn.prepare_pallas_params(params, cfg)
+    for bm in ([256] if args.quick else [128, 256, 512]):
+        if M % bm:
+            continue
+        for fused in (False, True):
+            name = f"ffn-pallas-fwd-bm{bm}" + ("-fusedgelu" if fused else "")
+            fn = jax.jit(lambda pp, xx, _bm=bm, _f=fused:
+                         ffn.ffn_forward_pallas(pp, xx, cfg, block_m=_bm,
+                                                fuse_gelu=_f))
+            try_emit(name, lambda: _time_call(fn, (pparams, x)), fwd_flops)
+
+    # --- sharded train step over available mesh shapes --------------------
+    n_dev = len(jax.devices())
+    mesh_shapes = {(1, n_dev), (n_dev, 1)}
+    if n_dev >= 4:
+        mesh_shapes.add((2, n_dev // 2))
+    y = jax.random.normal(jax.random.PRNGKey(2), x.shape, jnp.bfloat16)
+    # fwd + backward ~= 3x fwd FLOPs (standard training-step accounting)
+    step_flops = 3.0 * fwd_flops
+    for dp, tp in sorted(mesh_shapes):
+        if B % dp or S % tp or cfg.nb_ff % tp:
+            continue
+
+        def run_step(_dp=dp, _tp=tp):
+            mesh = jax.make_mesh((_dp, _tp), ("dp", "tp"))
+            step = ffn.make_sharded_train_step(mesh, cfg)
+            sp = ffn.shard_params(params, mesh)
+            return _time_call(step, (sp, x, y))
+
+        try_emit(f"ffn-trainstep-dp{dp}xtp{tp}", run_step, step_flops,
+                 {"devices": n_dev})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

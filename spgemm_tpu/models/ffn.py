@@ -124,12 +124,13 @@ def prepare_pallas_params(params, cfg: BlockSparseFFNConfig) -> dict:
 
 
 def ffn_forward_pallas(pparams, x, cfg: BlockSparseFFNConfig,
-                       block_m: int = 128) -> jax.Array:
+                       block_m: int = 128, fuse_gelu: bool = False) -> jax.Array:
     """ffn_forward with both matmuls as Pallas MXU kernels (single chip).
 
     pparams: output of prepare_pallas_params.  The batch*seq axis is padded to
     a block_m multiple; weights stream through VMEM via scalar-prefetch index
-    maps (no gather materialization)."""
+    maps (no gather materialization).  fuse_gelu moves the activation into
+    the first kernel's epilogue (benchmarks/ffn_sweep.py A/Bs this)."""
     from spgemm_tpu.ops.pallas_bsmm import bsmm_pallas
 
     B, S, D = x.shape
@@ -139,8 +140,10 @@ def ffn_forward_pallas(pparams, x, cfg: BlockSparseFFNConfig,
     if M_pad != M:
         xf = jnp.concatenate(
             [xf, jnp.zeros((M_pad - M, D), x.dtype)], axis=0)
-    h = jax.nn.gelu(bsmm_pallas(xf, pparams["w1"]["rows"],
-                                pparams["w1"]["tiles"], block_m=block_m))
+    h = bsmm_pallas(xf, pparams["w1"]["rows"], pparams["w1"]["tiles"],
+                    block_m=block_m, fuse_gelu=fuse_gelu)
+    if not fuse_gelu:
+        h = jax.nn.gelu(h)
     y = bsmm_pallas(h, pparams["w2cm"]["rows"], pparams["w2cm"]["tiles"],
                     block_m=block_m)
     return y[:M].reshape(B, S, D).astype(x.dtype)

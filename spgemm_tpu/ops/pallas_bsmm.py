@@ -21,7 +21,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(rows_ref, x_ref, w_ref, out_ref, acc_ref, *, rpc: int):
+def _kernel(rows_ref, x_ref, w_ref, out_ref, acc_ref, *, rpc: int,
+            fuse_gelu: bool):
     r = pl.program_id(2)
 
     @pl.when(r == 0)
@@ -34,16 +35,22 @@ def _kernel(rows_ref, x_ref, w_ref, out_ref, acc_ref, *, rpc: int):
 
     @pl.when(r == rpc - 1)
     def _flush():
-        out_ref[...] = acc_ref[...].astype(out_ref.dtype)
+        acc = acc_ref[...]
+        if fuse_gelu:  # epilogue on the f32 accumulator: saves one HBM
+            acc = jax.nn.gelu(acc)  # round-trip of h vs a separate gelu op
+        out_ref[...] = acc.astype(out_ref.dtype)
 
 
-@partial(jax.jit, static_argnames=("block_m", "interpret"))
-def bsmm_pallas(x, rows, tiles, *, block_m: int = 128, interpret=None):
+@partial(jax.jit, static_argnames=("block_m", "interpret", "fuse_gelu"))
+def bsmm_pallas(x, rows, tiles, *, block_m: int = 128, interpret=None,
+                fuse_gelu: bool = False):
     """x (M, d_in) @ column-major block-sparse W -> (M, nbc * k).
 
     rows  : (nbc, rpc) int32 -- nonzero input block-rows per output block-col.
     tiles : (nbc, rpc, k, k) -- weight tiles, same dtype as x.
     M must be a multiple of block_m; d_in a multiple of k.
+    fuse_gelu applies gelu to the f32 accumulator in the kernel epilogue
+    (activation fusion: h never round-trips HBM in full precision).
     """
     M, d_in = x.shape
     nbc, rpc, k, _ = tiles.shape
@@ -63,7 +70,7 @@ def bsmm_pallas(x, rows, tiles, *, block_m: int = 128, interpret=None):
         scratch_shapes=[pltpu.VMEM((block_m, k), jnp.float32)],
     )
     return pl.pallas_call(
-        partial(_kernel, rpc=rpc),
+        partial(_kernel, rpc=rpc, fuse_gelu=fuse_gelu),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((M, nbc * k), x.dtype),
         interpret=interpret,

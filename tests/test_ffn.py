@@ -122,6 +122,25 @@ def test_pallas_forward_matches_einsum():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_pallas_forward_fused_gelu_matches_unfused():
+    """fuse_gelu moves the activation into the kernel epilogue; numerics must
+    match the unfused path (gelu applied to the same f32 accumulator -- in
+    f32 configs the cast order is identical)."""
+    from spgemm_tpu.models.ffn import ffn_forward_pallas, prepare_pallas_params
+    cfg = BlockSparseFFNConfig(d_model=64, d_ff=128, k=8, block_density=0.5,
+                               dtype="float32")
+    params = init_params(cfg, jax.random.key(24))
+    x = jax.random.normal(jax.random.key(25), (2, 4, cfg.d_model), jnp.float32)
+    pp = prepare_pallas_params(params, cfg)
+    want = ffn_forward_pallas(pp, x, cfg, block_m=8)
+    got = ffn_forward_pallas(pp, x, cfg, block_m=8, fuse_gelu=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+    want_ref = ffn_forward(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_pallas_forward_ragged_w2_fanin():
     """Column fan-in of W2 is ragged -> zero-tile padding must be exact."""
     from spgemm_tpu.models.ffn import ffn_forward_pallas, prepare_pallas_params
