@@ -111,6 +111,21 @@ def _spgemm_config(name, a, b, backend, parity=True, sampled_parity=0):
         ok, n_checked = _sampled_value_parity(a, b, got, sampled_parity)
         result["value_parity_sampled"] = bool(ok)
         result["parity_tiles_checked"] = n_checked
+        # at-scale FULL parity: the native uint64 wrap-then-mod fold
+        # recomputes every output key (native/parityfold.cpp) -- the
+        # python-int oracle stays as the sampled, structure-independent
+        # cross-check; this one covers all keys
+        from spgemm_tpu.utils import native
+
+        full = native.parity_fold_check(a.tiles, b.tiles, join.pair_ptr,
+                                        join.pair_a, join.pair_b, got.tiles)
+        if full is not None:
+            n_bad, first_bad = full
+            result["value_parity_all_keys"] = bool(n_bad == 0)
+            result["parity_keys_checked"] = join.num_keys
+            if n_bad:
+                result["parity_bad_keys"] = n_bad
+                result["parity_first_bad"] = first_bad
     return result
 
 
@@ -491,6 +506,13 @@ def write_table(rows, path=None):
         par = ""
         if "value_parity" in r:
             par = "bit-exact" if r["value_parity"] else "MISMATCH"
+        elif "value_parity_all_keys" in r:
+            # native full fold (parityfold.cpp): every output key recomputed
+            nk = r.get("parity_keys_checked", 0)
+            par = (f"bit-exact (all {nk} keys)"
+                   if (r["value_parity_all_keys"]
+                       and r.get("value_parity_sampled", True))
+                   else "MISMATCH")
         elif "value_parity_sampled" in r:
             n = r.get("parity_tiles_checked", 0)
             par = (f"bit-exact ({n} tiles sampled)"

@@ -1,0 +1,81 @@
+// Native full-parity checker: the reference's exact wrap-then-mod fold
+// (sparse_matrix_mult.cu:48,59-61; SURVEY.md section 2.9) over EVERY output
+// key, in plain uint64 C++ -- the at-scale parity statement the sampled
+// checks cannot make.  Given the symbolic join's per-key pair lists (already
+// in the reference's j-ascending order) and the engine's output slab, it
+// recomputes each output tile independently of the JAX/Pallas numeric phase
+// and counts mismatching keys.  ~1.5e10 MACs for the webbase-1Mrow config:
+// seconds-to-minutes on a host core, vs hours for the python-int oracle.
+//
+// The structure (keys, pair lists) is shared with the engine's planner, but
+// that layer is independently cross-checked bit-identical between
+// native/symbolic.cpp and ops/symbolic.py; the numeric fold here shares no
+// code with the device path.
+//
+// Build: part of libsmmio.so (utils/native.py _build).
+
+#include <cstdint>
+
+extern "C" {
+
+// Returns the number of keys whose recomputed tile differs from out_tiles.
+// first_bad: key index of the first mismatch, or -1.
+int64_t smm_parity_fold(const uint64_t *a_tiles, const uint64_t *b_tiles,
+                        const int64_t *pair_ptr, const int32_t *pair_a,
+                        const int32_t *pair_b, int64_t n_keys, int64_t k,
+                        const uint64_t *out_tiles, int64_t *first_bad) {
+  const uint64_t MAXV = 0xFFFFFFFFFFFFFFFFull;
+  const int64_t kk = k * k;
+  if (k > 128) {  // stack accumulator cap; callers fall back to the oracle
+    *first_bad = -1;
+    return -2;
+  }
+  int64_t bad = 0;
+  int64_t first = -1;
+#pragma omp parallel for schedule(dynamic, 16) reduction(+ : bad)
+  for (int64_t key = 0; key < n_keys; ++key) {
+    // per-key accumulator tile on the stack (k <= 128 in this framework;
+    // VLA-free fixed cap keeps this portable)
+    uint64_t acc[128 * 128];
+    for (int64_t i = 0; i < kk; ++i) acc[i] = 0;
+    for (int64_t p = pair_ptr[key]; p < pair_ptr[key + 1]; ++p) {
+      const uint64_t *A = a_tiles + (int64_t)pair_a[p] * kk;
+      const uint64_t *B = b_tiles + (int64_t)pair_b[p] * kk;
+      for (int64_t ty = 0; ty < k; ++ty) {
+        const uint64_t *Arow = A + ty * k;
+        uint64_t *accrow = acc + ty * k;
+        for (int64_t j = 0; j < k; ++j) {
+          const uint64_t av = Arow[j];
+          const uint64_t *Brow = B + j * k;
+          // per output element (ty, tx): fold order over (pair, j) is
+          // pair-major then j-ascending -- the tx loop innermost keeps
+          // that order for every tx simultaneously (identical sequence
+          // per element as the reference kernel's :56-62 loop)
+          for (int64_t tx = 0; tx < k; ++tx) {
+            uint64_t prod = av * Brow[tx];  // wraps mod 2^64
+            if (prod == MAXV) prod = 0;     // :59
+            uint64_t s = accrow[tx] + prod; // wraps mod 2^64 first
+            if (s == MAXV) s = 0;           // :61
+            accrow[tx] = s;
+          }
+        }
+      }
+    }
+    const uint64_t *want = out_tiles + key * kk;
+    bool ok = true;
+    for (int64_t i = 0; i < kk; ++i)
+      if (acc[i] != want[i]) {
+        ok = false;
+        break;
+      }
+    if (!ok) {
+      ++bad;
+#pragma omp critical
+      if (first < 0 || key < first) first = key;
+    }
+  }
+  *first_bad = first;
+  return bad;
+}
+
+}  // extern "C"

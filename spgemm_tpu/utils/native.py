@@ -21,6 +21,7 @@ import numpy as np
 _DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_DIR, "smmio.cpp")
 _SYM_SRC = os.path.join(_DIR, "symbolic.cpp")
+_FOLD_SRC = os.path.join(_DIR, "parityfold.cpp")
 _SO = os.path.join(_DIR, "libsmmio.so")
 
 _lib = None
@@ -31,8 +32,8 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-o", _SO,
-             _SRC, _SYM_SRC],
+            ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+             "-o", _SO, _SRC, _SYM_SRC, _FOLD_SRC],
             check=True, capture_output=True, timeout=120)
         return True
     except (subprocess.SubprocessError, FileNotFoundError):
@@ -53,8 +54,8 @@ def get_lib():
         # never crash the caller -- get_lib sits on the spgemm critical path.
         try:
             needs_build = (not os.path.exists(_SO)
-                           or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-                           or os.path.getmtime(_SO) < os.path.getmtime(_SYM_SRC))
+                           or any(os.path.getmtime(_SO) < os.path.getmtime(s)
+                                  for s in (_SRC, _SYM_SRC, _FOLD_SRC)))
         except OSError:
             needs_build = not os.path.exists(_SO)
         if needs_build and not _build():
@@ -95,6 +96,17 @@ def get_lib():
             ]
             lib.smm_sym_free.restype = None
             lib.smm_sym_free.argtypes = [ctypes.c_void_p]
+            lib.smm_parity_fold.restype = ctypes.c_int64
+            lib.smm_parity_fold.argtypes = [
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                ctypes.c_int64, ctypes.c_int64,
+                np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS"),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
         except AttributeError:
             return None  # stale .so predating a symbol: numpy fallback
         _lib = lib
@@ -177,6 +189,39 @@ def symbolic_join_native(a_coords: np.ndarray, b_coords: np.ndarray):
             if p:
                 lib.smm_sym_free(p)
     return keys, pair_ptr, pair_a, pair_b
+
+
+def parity_fold_check(a_tiles: np.ndarray, b_tiles: np.ndarray,
+                      pair_ptr: np.ndarray, pair_a: np.ndarray,
+                      pair_b: np.ndarray, out_tiles: np.ndarray):
+    """Full-parity check of EVERY output key against the reference's
+    wrap-then-mod fold, recomputed in native uint64 C++ (parityfold.cpp).
+
+    out_tiles: the engine's (n_keys, k, k) result in join-key order.
+    Returns (n_bad, first_bad_key) -- (0, -1) means bit-exact on all keys --
+    or None if the native library is unavailable (callers fall back to the
+    python-int oracle or sampled parity).
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    k = a_tiles.shape[-1]
+    n_keys = len(pair_ptr) - 1
+    if n_keys == 0:
+        return 0, -1
+    first_bad = ctypes.c_int64(-1)
+    n_bad = lib.smm_parity_fold(
+        np.ascontiguousarray(a_tiles, np.uint64),
+        np.ascontiguousarray(b_tiles, np.uint64),
+        np.ascontiguousarray(pair_ptr, np.int64),
+        np.ascontiguousarray(pair_a, np.int32),
+        np.ascontiguousarray(pair_b, np.int32),
+        n_keys, k,
+        np.ascontiguousarray(out_tiles, np.uint64),
+        ctypes.byref(first_bad))
+    if n_bad == -2:
+        return None  # k beyond the native stack cap: caller falls back
+    return int(n_bad), int(first_bad.value)
 
 
 def write_matrix(path: str, rows: int, cols: int, k: int,

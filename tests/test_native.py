@@ -71,6 +71,53 @@ def test_native_u64_extremes(tmp_path):
     assert tiles[0, 1, 1] == np.uint64(18446744073709551614)
 
 
+# -- native full-parity fold (native/parityfold.cpp) -------------------------
+
+def test_native_parity_fold_vs_oracle_adversarial():
+    """The native uint64 wrap-then-mod fold must agree with the python-int
+    oracle on full-range adversarial values (every key), and flag corrupted
+    tiles with an exact count + first-bad index."""
+    from spgemm_tpu.ops.symbolic import symbolic_join
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+    from spgemm_tpu.utils.semantics import spgemm_oracle
+
+    rng = np.random.default_rng(92)
+    a = random_block_sparse(12, 12, 4, 0.4, rng, "adversarial")
+    b = random_block_sparse(12, 12, 4, 0.4, rng, "adversarial")
+    join = symbolic_join(a.coords, b.coords)
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+    assert np.array_equal(want.coords, join.keys)  # oracle key order == join
+
+    res = native.parity_fold_check(a.tiles, b.tiles, join.pair_ptr,
+                                   join.pair_a, join.pair_b, want.tiles)
+    assert res == (0, -1)
+
+    # corrupt two tiles -> exactly 2 bad keys, first index reported
+    bad = want.tiles.copy()
+    bad[3, 0, 0] ^= np.uint64(1)
+    bad[7, 1, 2] ^= np.uint64(1)
+    n_bad, first = native.parity_fold_check(
+        a.tiles, b.tiles, join.pair_ptr, join.pair_a, join.pair_b, bad)
+    assert n_bad == 2 and first == 3
+
+
+def test_native_parity_fold_engine_output():
+    """End-to-end: the engine's own output passes the native all-keys check
+    (the at-scale parity statement of RESULTS.md, at test scale)."""
+    from spgemm_tpu.ops.spgemm import spgemm
+    from spgemm_tpu.ops.symbolic import symbolic_join
+
+    rng = np.random.default_rng(93)
+    a = random_block_sparse(16, 16, 4, 0.3, rng, "full")
+    b = random_block_sparse(16, 16, 4, 0.3, rng, "full")
+    got = spgemm(a, b)
+    join = symbolic_join(a.coords, b.coords)
+    res = native.parity_fold_check(a.tiles, b.tiles, join.pair_ptr,
+                                   join.pair_a, join.pair_b, got.tiles)
+    assert res == (0, -1)
+
+
 # -- native symbolic join (native/symbolic.cpp) ------------------------------
 
 def test_native_symbolic_join_matches_numpy(monkeypatch):
