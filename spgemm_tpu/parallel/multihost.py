@@ -17,6 +17,24 @@ cu:460-556, an O(P) bottleneck).  The JAX-native multi-host story:
 Launch (per host):
     JAX_COORDINATOR=host0:1234 JAX_NUM_PROCESSES=P JAX_PROCESS_ID=r \
         python -m spgemm_tpu.cli <folder> --distributed
+
+Failure contract under DCN partner loss (the reference has none: a dead MPI
+rank leaves the others blocked forever in MPI_Recv, sparse_matrix_mult.cu:
+508-552, SURVEY.md section 5.3).  Here, every host heartbeats the JAX
+coordination service (heartbeat window: `SPGEMM_TPU_DCN_HEARTBEAT_S`, default
+jax's 100 s); when a partner dies, survivors terminate within that window --
+fail-fast and LOUD, never a hang and never a partial `./matrix` (the writer
+only runs after the replicated combine succeeds).  Two surfacing paths,
+whichever fires first: the distributed service's error poller hard-exits the
+process non-zero ("Terminating process because the JAX distributed service
+detected fatal errors"), or a collective raises and
+`chain_product_multihost` wraps it in `PartnerLostError`.
+Recovery is a rerun: the engine is a single deterministic program over input
+files, so there is no distributed state to salvage -- restart is the
+recovery path, and per-pass checkpoints (utils/checkpoint.py, --checkpoint-
+dir) let the rerun resume from the last completed chain pass.
+Exercised by tests/test_multihost.py::test_partner_loss_fails_fast with a
+real killed worker process.
 """
 
 from __future__ import annotations
@@ -33,6 +51,10 @@ from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 log = logging.getLogger("spgemm_tpu.multihost")
 
 
+class PartnerLostError(RuntimeError):
+    """A DCN collective failed because a partner host died mid-run."""
+
+
 def init_from_env() -> None:
     """Initialize jax.distributed from JAX_COORDINATOR/JAX_NUM_PROCESSES/
     JAX_PROCESS_ID (no-op if unset or already initialized)."""
@@ -41,10 +63,15 @@ def init_from_env() -> None:
         return
     import jax
 
+    kwargs = {}
+    hb = os.environ.get("SPGEMM_TPU_DCN_HEARTBEAT_S")
+    if hb:
+        kwargs["heartbeat_timeout_seconds"] = int(hb)
     jax.distributed.initialize(
         coordinator_address=coord,
         num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
         process_id=int(os.environ["JAX_PROCESS_ID"]),
+        **kwargs,
     )
 
 
@@ -92,7 +119,20 @@ def chain_product_multihost(matrices_for_me: list[BlockSparseMatrix] | None,
     the reference's combine tree (replicated on every host)."""
     partial = (chain_product(matrices_for_me, multiply=multiply, **kwargs)
                if matrices_for_me else None)
-    partials = _allgather_partials(partial, k)
+    try:
+        from jax.errors import JaxRuntimeError as _RuntimeErr
+    except ImportError:  # older jaxlib spelling
+        from jaxlib.xla_extension import XlaRuntimeError as _RuntimeErr
+    try:
+        partials = _allgather_partials(partial, k)
+    except _RuntimeErr as e:  # jaxlib surfaces partner death as XlaRuntimeError;
+        # deliberately narrow -- config bugs (shape mismatch, OOM in numpy)
+        # must surface as themselves, not as a bogus "rerun the job"
+        raise PartnerLostError(
+            "DCN partner lost during partial-product exchange "
+            "(a peer host died or its heartbeat lapsed). No output was "
+            "written; rerun the job -- per-pass checkpoints resume the "
+            "chain (see module docstring failure contract).") from e
     log.info("gathered %d partials over DCN", len(partials))
     if len(partials) == 1:
         return partials[0]

@@ -1,10 +1,14 @@
 """Subprocess worker for the multi-process DCN tests (tests/test_multihost.py).
 
-Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <dir> <n_mats>
+Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <dir> <n_mats> [die]
 Builds a deterministic chain, partitions it by process, runs the multi-host
 reduction, and (process 0) writes the result matrix file into <dir>/out.
+With the optional 'die' flag, the LAST process exits hard right before the
+DCN exchange -- the partner-loss fault injection for
+test_partner_loss_fails_fast (survivors must fail fast, never hang).
 """
 
+import os
 import sys
 
 
@@ -12,6 +16,7 @@ def main():
     coordinator, num_procs, proc_id, workdir, n_mats = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
         int(sys.argv[5]))
+    die = len(sys.argv) > 6 and sys.argv[6] == "die"
 
     import jax
     from jax._src import xla_bridge
@@ -19,7 +24,14 @@ def main():
     assert not xla_bridge._backends
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_procs, process_id=proc_id)
+                               num_processes=num_procs, process_id=proc_id,
+                               heartbeat_timeout_seconds=5)
+
+    if die and proc_id == num_procs - 1:
+        # simulate host death at the DCN boundary: cluster formed, partial
+        # owed, then gone without a goodbye (no MPI_Finalize analog runs)
+        print(f"proc {proc_id} dying deliberately", flush=True)
+        os._exit(17)
 
     sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
     import numpy as np

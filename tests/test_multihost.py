@@ -65,3 +65,42 @@ def test_multi_process_chain(tmp_path, num_procs, n_mats):
     want = chain_product_partitioned(mats, num_procs)
     got = io_text.read_matrix(str(tmp_path / "out"), k)
     assert got == want
+
+
+def test_partner_loss_fails_fast(tmp_path):
+    """Fault injection for the DCN failure contract (multihost.py docstring):
+    worker P-1 dies hard right before the partial-product exchange.  The
+    survivor must (a) exit non-zero well before the test timeout -- the
+    reference would block forever in MPI_Recv (sparse_matrix_mult.cu:508-552)
+    -- (b) surface the loss loudly (the distributed service's error poller
+    terminating the process, or PartnerLostError if the collective raises
+    first), and (c) write no output file."""
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    env = {**os.environ}
+    env.pop("JAX_PLATFORMS", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(r),
+             str(tmp_path), "5", "die"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for r in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            outs.append(out.decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("survivor hung after partner loss (contract: fail fast)")
+
+    assert procs[1].returncode == 17, outs[1][-500:]   # the injected death
+    assert procs[0].returncode not in (0, None), outs[0][-2000:]
+    assert ("PartnerLostError" in outs[0]
+            or "JAX distributed service detected fatal errors" in outs[0]
+            or "unhealthy" in outs[0]), outs[0][-2000:]
+    assert not (tmp_path / "out").exists(), "no output after partner loss"
