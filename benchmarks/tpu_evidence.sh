@@ -47,6 +47,14 @@ timeout 2400 python benchmarks/kernel_sweep.py 2>&1 | tee "$OUT/sweep.txt" | tai
 timeout 900 python benchmarks/kernel_sweep.py --quick --k 64 2>&1 \
   | tee "$OUT/sweep_k64.txt" | tail -4 \
   || echo "k64 sweep did not complete (see sweep_k64.txt)"
+# best-effort float/MXU FFN sweep (TF/s + MFU vs ROOFLINE_FFN.md targets)
+timeout 1800 python benchmarks/ffn_sweep.py 2>&1 \
+  | tee "$OUT/ffn_sweep.txt" | tail -6 \
+  || echo "ffn sweep did not complete (see ffn_sweep.txt)"
+# best-effort out-of-core depth ladder (landing/compute overlap on real D2H)
+timeout 1800 python benchmarks/ooc_depth_bench.py 2>&1 \
+  | tee "$OUT/ooc_depth.txt" | tail -6 \
+  || echo "ooc depth ladder did not complete (see ooc_depth.txt)"
 
 # Best-effort BIG-scale runs, isolated from the fail-gated suite: each has
 # its own timeout, and a hang or failure here can only lose its own row,
