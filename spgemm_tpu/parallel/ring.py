@@ -36,15 +36,29 @@ from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 
 
 def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
-    """Host-side schedule: key chunks per device, pair lists per (device, slab).
+    """Host-side schedule: key chunks per device, COMPACTED pair lists per
+    (device, slab) cell.
 
-    Returns (key_chunks, slab_bounds, pa_all, pb_all, s_max) where
+    Only (key, slab) cells that actually hold pairs occupy a row -- a
+    power-law structure concentrates each key's pairs in 1-2 slabs, and the
+    old dense (device, slab, local_key, pair) layout padded every key into
+    every slab (round-4 measurement: 10.8x padded vs real work on the
+    webbase config; rowshard's fanout-bucketed rounds pad 1.1x).  The fold
+    scatter-adds each step's compacted rows into the device accumulator.
+
+    Returns (key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max):
       key_chunks  : list of n index arrays into join.keys (device d's keys)
       slab_bounds : (n+1,) B tile-slab boundaries (contiguous equal splits)
-      pa_all      : (n, n, K_max, P_max) int32 A-slab indices
-                    [device, slab, local key, pair]
-      pb_all      : (n, n, K_max, P_max) int32 *within-slab* B indices
-      s_max       : max slab size; within-slab sentinel == s_max (zero tile)
+      row_idx     : (n, n, C_max) int32 -- local ACC row of each compacted
+                    cell [device, slab, cell]; padding rows point at the
+                    dummy accumulator row == k_max
+      pa_all      : (n, n, C_max, P_max) int32 A-slab indices (sentinel -1)
+      pb_all      : (n, n, C_max, P_max) int32 *within-slab* B indices
+                    (sentinel == s_max, the slab zero tile)
+      s_max       : max slab size
+      k_max       : max local key count == the dummy accumulator row baked
+                    into row_idx (single-sourced here; the fold's
+                    accumulator MUST be allocated k_max + 1 rows)
     """
     n_keys = join.num_keys
     slab_bounds = np.array([(i * nnzb_b) // n_dev for i in range(n_dev + 1)],
@@ -60,11 +74,9 @@ def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
                   for d in range(n_dev)]
     k_max = max(1, int(np.diff(key_bounds).max()))
 
-    # One scatter instead of a (device x slab x key) Python loop: each pair
-    # maps to a (key, slab) cell; a stable sort by cell id groups every
-    # cell's pairs contiguously while preserving their original j-ascending
-    # order within the cell (order inside a cell is what the field-mode
-    # fold contract leaves free, but keep it deterministic anyway).
+    # Pairs -> (key, slab) cells via one stable sort (preserves the original
+    # j-ascending order within each cell; order inside a cell is what the
+    # field-mode fold contract leaves free, but keep it deterministic).
     pair_ptr = np.asarray(join.pair_ptr, dtype=np.int64)
     key_of_pair = np.repeat(np.arange(n_keys, dtype=np.int64),
                             np.diff(pair_ptr))
@@ -73,23 +85,42 @@ def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
 
     cell = key_of_pair * n_dev + slab_of_pair
     order = np.argsort(cell, kind="stable")
-    cell_counts = np.bincount(cell, minlength=n_keys * n_dev)
-    p_max = max(1, int(cell_counts.max())) if cell.size else 1
+    cell_sorted = cell[order]
+    if cell.size:
+        uc, uc_first, uc_counts = np.unique(cell_sorted, return_index=True,
+                                            return_counts=True)
+    else:
+        uc = np.zeros(0, np.int64)
+        uc_first = uc_counts = np.zeros(0, np.int64)
+    p_max = max(1, int(uc_counts.max())) if uc.size else 1
     # position of each sorted pair within its cell = rank - cell start
-    cell_offsets = np.concatenate(([0], np.cumsum(cell_counts)))
-    pos = np.arange(cell.size, dtype=np.int64) - cell_offsets[cell[order]]
+    ci_of_pair = np.repeat(np.arange(len(uc), dtype=np.int64), uc_counts)
+    pos = np.arange(cell.size, dtype=np.int64) - uc_first[ci_of_pair]
 
-    key_sorted = key_of_pair[order]
-    dev_of_pair = np.searchsorted(key_bounds, key_sorted, side="right") - 1
-    local_row = key_sorted - key_bounds[dev_of_pair]
-    slab_sorted = slab_of_pair[order]
+    # group compacted cells by (device, slab)
+    cell_key = uc // n_dev
+    cell_slab = (uc % n_dev).astype(np.int64)
+    cell_dev = np.searchsorted(key_bounds, cell_key, side="right") - 1
+    cell_local = (cell_key - key_bounds[cell_dev]).astype(np.int32)
+    grp = cell_dev * n_dev + cell_slab
+    grp_counts = np.bincount(grp, minlength=n_dev * n_dev)
+    c_max = max(1, int(grp_counts.max())) if uc.size else 1
+    grp_order = np.argsort(grp, kind="stable")
+    grp_offsets = np.concatenate(([0], np.cumsum(grp_counts)))
+    pos_in_grp = np.empty(len(uc), np.int64)
+    pos_in_grp[grp_order] = (np.arange(len(uc), dtype=np.int64)
+                             - grp_offsets[grp[grp_order]])
 
-    pa_all = np.full((n_dev, n_dev, k_max, p_max), -1, dtype=np.int32)
-    pb_all = np.full((n_dev, n_dev, k_max, p_max), s_max, dtype=np.int32)
-    pa_all[dev_of_pair, slab_sorted, local_row, pos] = join.pair_a[order]
-    pb_all[dev_of_pair, slab_sorted, local_row, pos] = (
-        join.pair_b[order] - slab_bounds[slab_sorted])
-    return key_chunks, slab_bounds, pa_all, pb_all, s_max
+    row_idx = np.full((n_dev, n_dev, c_max), k_max, dtype=np.int32)  # dummy
+    row_idx[cell_dev, cell_slab, pos_in_grp] = cell_local
+    pa_all = np.full((n_dev, n_dev, c_max, p_max), -1, dtype=np.int32)
+    pb_all = np.full((n_dev, n_dev, c_max, p_max), s_max, dtype=np.int32)
+    pa_all[cell_dev[ci_of_pair], cell_slab[ci_of_pair],
+           pos_in_grp[ci_of_pair], pos] = join.pair_a[order]
+    pb_all[cell_dev[ci_of_pair], cell_slab[ci_of_pair],
+           pos_in_grp[ci_of_pair], pos] = (
+        join.pair_b[order] - slab_bounds[cell_slab[ci_of_pair]])
+    return key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max
 
 
 def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
@@ -115,8 +146,8 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     small = u64.operands_below_2_32(a, b)
     a_hi, a_lo = pack_tiles(a)  # replicated; sentinel zero tile at a.nnzb
 
-    key_chunks, slab_bounds, pa_all, pb_all, s_max = plan_ring(
-        join, b.nnzb, n_dev)
+    key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max = \
+        plan_ring(join, b.nnzb, n_dev)
     pa_all = np.where(pa_all < 0, a.nnzb, pa_all)  # A sentinel -> zero tile
 
     # per-device B slab buffers: (n, s_max + 1, k, k), zero tile at s_max
@@ -133,11 +164,12 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
             lo, hi = slab_bounds[s], slab_bounds[s + 1]
             b_slab_h[s, : hi - lo] = bh_np[lo:hi]
 
-    fold = _make_ring_fold(mesh, n_dev, small)
+    fold = _make_ring_fold(mesh, n_dev, small, k_max)
     shard0 = NamedSharding(mesh, P("ring"))
     oh, ol = fold(
         a_hi, a_lo,
         jax.device_put(b_slab_h, shard0), jax.device_put(b_slab_l, shard0),
+        jax.device_put(jnp.asarray(row_idx), shard0),
         jax.device_put(jnp.asarray(pa_all), shard0),
         jax.device_put(jnp.asarray(pb_all), shard0),
     )
@@ -150,15 +182,18 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
                              coords=join.keys, tiles=out)
 
 
-@partial(jax.jit, static_argnames=("mesh", "n_dev", "small"))
-def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb, *, mesh, n_dev,
-                   small=False):
-    def per_device(a_hi, a_lo, bh, bl, pa, pb):
-        # local shapes: bl (1, s_max+1, k, k), pa (1, n_slab, K, P);
-        # small mode: bh is a (1,1,1,1) dummy, never in the carry, never
-        # rotated -- the b32 route's ICI/HBM saving is structural, not DCE
+@partial(jax.jit, static_argnames=("mesh", "n_dev", "small", "k_max"))
+def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, rows, pa, pb, *, mesh,
+                   n_dev, small, k_max):
+    def per_device(a_hi, a_lo, bh, bl, rows, pa, pb):
+        # local shapes: bl (1, s_max+1, k, k), rows (1, n_slab, C),
+        # pa (1, n_slab, C, P) -- C is the COMPACTED cell axis (plan_ring):
+        # each step folds only the (key, slab) cells that hold pairs and
+        # scatter-adds them into the device accumulator; row k_max is the
+        # padding dummy.  small mode: bh is a (1,1,1,1) dummy, never in the
+        # carry, never rotated -- the b32 route's ICI/HBM saving is
+        # structural, not DCE.
         d = jax.lax.axis_index("ring")
-        K = pa.shape[2]
         k = a_lo.shape[-1]
         rot_perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
 
@@ -168,7 +203,8 @@ def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb, *, mesh, n_dev,
             else:
                 acc_h, acc_l, bh, bl = carry
             s = (d - t) % n_dev  # slab currently resident on this device
-            pa_s = pa[0, s]      # (K, P) -- dynamic index over the slab axis
+            rows_s = rows[0, s]  # (C,) -- dynamic index over the slab axis
+            pa_s = pa[0, s]      # (C, P)
             pb_s = pb[0, s]
             if small:  # hi args unread by the b32 fold: pass lo stand-ins
                 ph, pl = fold_pairs_field(a_lo, a_lo, bl[0], bl[0],
@@ -176,27 +212,34 @@ def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb, *, mesh, n_dev,
             else:
                 ph, pl = fold_pairs_field(a_hi, a_lo, bh[0], bl[0],
                                           pa_s, pb_s)
-            acc_h, acc_l = u64.addmod_field(acc_h, acc_l, ph, pl)
+            # scatter-add the compacted cells into their acc rows; rows are
+            # unique within a step (one cell per key per slab) except the
+            # dummy row, whose value is never read
+            nh, nl = u64.addmod_field(acc_h[rows_s], acc_l[rows_s], ph, pl)
+            acc_h = acc_h.at[rows_s].set(nh)
+            acc_l = acc_l.at[rows_s].set(nl)
             bl = jax.lax.ppermute(bl, "ring", rot_perm)  # rotate B one hop
             if small:
                 return acc_h, acc_l, bl
             bh = jax.lax.ppermute(bh, "ring", rot_perm)
             return acc_h, acc_l, bh, bl
 
-        zero = jnp.zeros((K, k, k), jnp.uint32)
+        zero = jnp.zeros((k_max + 1, k, k), jnp.uint32)  # + dummy row
         carry0 = (zero, zero, bl) if small else (zero, zero, bh, bl)
         out = jax.lax.fori_loop(0, n_dev, step, carry0)
-        acc_h, acc_l = out[0], out[1]
+        acc_h, acc_l = out[0][:k_max], out[1][:k_max]
         return acc_h[None], acc_l[None]
 
     return jax.shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(), P(), P("ring"), P("ring"), P("ring"), P("ring")),
+        in_specs=(P(), P(), P("ring"), P("ring"), P("ring"), P("ring"),
+                  P("ring")),
         out_specs=(P("ring"), P("ring")),
         check_vma=False,
-    )(a_hi, a_lo, b_slab_h, b_slab_l, pa, pb)
+    )(a_hi, a_lo, b_slab_h, b_slab_l, rows, pa, pb)
 
 
-def _make_ring_fold(mesh: Mesh, n_dev: int, small: bool = False):
-    return partial(_ring_fold_jit, mesh=mesh, n_dev=n_dev, small=small)
+def _make_ring_fold(mesh: Mesh, n_dev: int, small: bool, k_max: int):
+    return partial(_ring_fold_jit, mesh=mesh, n_dev=n_dev, small=small,
+                   k_max=k_max)

@@ -225,9 +225,12 @@ def test_ring_fewer_keys_than_devices():
 
 
 def test_plan_ring_packing_matches_naive_oracle():
-    """Pin the vectorized planner's packed layout cell by cell: every
-    (device, slab, local key) row must hold exactly that key's pairs whose B
-    tile falls in that slab, in their original order, sentinel-padded."""
+    """Pin the vectorized COMPACTED planner cell by cell: for every
+    (device, slab), the occupied rows must cover exactly the device's keys
+    that have pairs in that slab -- each holding that cell's pairs in their
+    original order, sentinel-padded on the pair axis -- and nothing else
+    (the dense layout's all-keys-in-every-slab padding is the round-4
+    10.8x waste this planner removed)."""
     from spgemm_tpu.ops.symbolic import JoinResult
     from spgemm_tpu.parallel.ring import plan_ring
 
@@ -244,22 +247,35 @@ def test_plan_ring_packing_matches_naive_oracle():
     join = JoinResult(keys=keys, pair_ptr=pair_ptr,
                       pair_a=pair_a, pair_b=pair_b)
 
-    key_chunks, slab_bounds, pa_all, pb_all, s_max = plan_ring(
-        join, nnzb_b, n_dev)
+    key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max = \
+        plan_ring(join, nnzb_b, n_dev)
+    assert k_max == max(len(c) for c in key_chunks)
     slab_of_pair = np.searchsorted(slab_bounds, pair_b, side="right") - 1
     for d, chunk in enumerate(key_chunks):
-        for row, ki in enumerate(chunk):
-            lo, hi = pair_ptr[ki], pair_ptr[ki + 1]
-            for s in range(n_dev):
+        for s in range(n_dev):
+            # cells present in this (device, slab): map acc row -> cell slot
+            occupied = {int(r): slot for slot, r in enumerate(row_idx[d, s])
+                        if r != k_max}
+            assert len(occupied) == np.sum(row_idx[d, s] != k_max), \
+                "duplicate acc row within one (device, slab) step"
+            for row, ki in enumerate(chunk):
+                lo, hi = pair_ptr[ki], pair_ptr[ki + 1]
                 sel = slab_of_pair[lo:hi] == s
                 want_a = pair_a[lo:hi][sel]
                 want_b = pair_b[lo:hi][sel] - slab_bounds[s]
-                got_a = pa_all[d, s, row]
-                got_b = pb_all[d, s, row]
+                if not len(want_a):
+                    assert row not in occupied, "empty cell occupies a row"
+                    continue
+                slot = occupied.pop(row)
+                got_a = pa_all[d, s, slot]
+                got_b = pb_all[d, s, slot]
                 assert np.array_equal(got_a[: len(want_a)], want_a)
                 assert np.array_equal(got_b[: len(want_b)], want_b)
                 assert np.all(got_a[len(want_a):] == -1)
                 assert np.all(got_b[len(want_b):] == s_max)
+            assert not occupied, "planner emitted cells for foreign keys"
+    # padding sentinels on unoccupied cell rows
+    assert np.all(pa_all[row_idx == k_max] == -1)
 
 
 def test_chain_product_on_devices_matches_partitioned():

@@ -128,13 +128,17 @@ def test_plan_ring_covers_join_exactly(ab, n_dev):
     join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:
         return
-    key_chunks, slab_bounds, pa_all, pb_all, s_max = plan_ring(
-        join, b.nnzb, n_dev)
+    key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max = \
+        plan_ring(join, b.nnzb, n_dev)
     seen = []
     for d, chunk in enumerate(key_chunks):
-        for row, ki in enumerate(chunk):
-            for s in range(n_dev):
-                for pa_v, pb_v in zip(pa_all[d, s, row], pb_all[d, s, row]):
+        for s in range(n_dev):
+            for slot, row in enumerate(row_idx[d, s]):
+                if row == k_max:  # padding cell: must hold only sentinels
+                    assert np.all(pa_all[d, s, slot] == -1)
+                    continue
+                ki = chunk[row]  # compacted cell -> this device's key
+                for pa_v, pb_v in zip(pa_all[d, s, slot], pb_all[d, s, slot]):
                     if pa_v < 0:
                         continue
                     gb = pb_v + slab_bounds[s]
