@@ -36,9 +36,15 @@ PEAK_TFS = {"tpu": 197.0}  # v5e / v5-lite class
 
 
 def _digest(x):
+    """Completion barrier: one element D2H.  Slice the first addressable
+    shard ON DEVICE first -- plain [0] indexing on a sharded array is a
+    cross-device gather jax refuses to infer a sharding for, and an
+    np.asarray of the shard would D2H the whole buffer inside the timed
+    region (kernel_sweep._digest documents the same trap)."""
     import jax.numpy as jnp
 
-    return float(jnp.asarray(x).ravel()[0])
+    shard = jnp.asarray(x).addressable_shards[0].data
+    return float(shard.ravel()[0])
 
 
 def _time_call(fn, args, repeats=2):
@@ -149,10 +155,15 @@ def main() -> int:
             continue
 
         def run_step(_dp=dp, _tp=tp):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
             mesh = jax.make_mesh((_dp, _tp), ("dp", "tp"))
             step = ffn.make_sharded_train_step(mesh, cfg)
             sp = ffn.shard_params(params, mesh)
-            return _time_call(step, (sp, x, y))
+            data_sh = NamedSharding(mesh, P("dp", "tp"))
+            xs = jax.device_put(x, data_sh)
+            ys = jax.device_put(y, data_sh)
+            return _time_call(step, (sp, xs, ys))
 
         try_emit(f"ffn-trainstep-dp{dp}xtp{tp}", run_step, step_flops,
                  {"devices": n_dev})
