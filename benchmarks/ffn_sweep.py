@@ -85,6 +85,7 @@ def main() -> int:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
     from spgemm_tpu.models import ffn
+    from spgemm_tpu.ops.pallas_bsmm import resident_panel_fits
 
     platform = jax.devices()[0].platform
     peak = PEAK_TFS.get(platform)
@@ -136,11 +137,19 @@ def main() -> int:
         if M % bm:
             continue
         for fused in (False, True):
-            name = f"ffn-pallas-fwd-bm{bm}" + ("-fusedgelu" if fused else "")
-            fn = jax.jit(lambda pp, xx, _bm=bm, _f=fused:
-                         ffn.ffn_forward_pallas(pp, xx, cfg, block_m=_bm,
-                                                fuse_gelu=_f))
-            try_emit(name, lambda: _time_call(fn, (pparams, x)), fwd_flops)
+            for res in (False, True):  # streaming vs VMEM-resident x panel
+                if res and not (resident_panel_fits(cfg.d_model, bm, 2, cfg.k)
+                                and resident_panel_fits(cfg.d_ff, bm, 2,
+                                                        cfg.k)):
+                    continue  # panel cannot fit VMEM: skip the doomed compile
+                name = (f"ffn-pallas-fwd-bm{bm}"
+                        + ("-fusedgelu" if fused else "")
+                        + ("-resident" if res else ""))
+                fn = jax.jit(lambda pp, xx, _bm=bm, _f=fused, _r=res:
+                             ffn.ffn_forward_pallas(pp, xx, cfg, block_m=_bm,
+                                                    fuse_gelu=_f, resident=_r))
+                try_emit(name, lambda: _time_call(fn, (pparams, x)),
+                         fwd_flops)
 
     # --- sharded train step over available mesh shapes --------------------
     n_dev = len(jax.devices())

@@ -124,14 +124,19 @@ def prepare_pallas_params(params, cfg: BlockSparseFFNConfig) -> dict:
 
 
 def ffn_forward_pallas(pparams, x, cfg: BlockSparseFFNConfig,
-                       block_m: int = 128, fuse_gelu: bool = False) -> jax.Array:
+                       block_m: int = 128, fuse_gelu: bool = False,
+                       resident: bool | None = None) -> jax.Array:
     """ffn_forward with both matmuls as Pallas MXU kernels (single chip).
 
     pparams: output of prepare_pallas_params.  The batch*seq axis is padded to
     a block_m multiple; weights stream through VMEM via scalar-prefetch index
     maps (no gather materialization).  fuse_gelu moves the activation into
-    the first kernel's epilogue (benchmarks/ffn_sweep.py A/Bs this)."""
-    from spgemm_tpu.ops.pallas_bsmm import bsmm_pallas
+    the first kernel's epilogue (benchmarks/ffn_sweep.py A/Bs this).
+    resident keeps each x row-panel VMEM-resident across output block-cols
+    (bsmm_pallas_resident -- the compute-bound layout, ROOFLINE_FFN.md
+    section 3 lever 2); None auto-picks it per matmul when the panel fits."""
+    from spgemm_tpu.ops.pallas_bsmm import (
+        bsmm_pallas, bsmm_pallas_resident, resident_panel_fits)
 
     B, S, D = x.shape
     M = B * S
@@ -140,12 +145,21 @@ def ffn_forward_pallas(pparams, x, cfg: BlockSparseFFNConfig,
     if M_pad != M:
         xf = jnp.concatenate(
             [xf, jnp.zeros((M_pad - M, D), x.dtype)], axis=0)
-    h = bsmm_pallas(xf, pparams["w1"]["rows"], pparams["w1"]["tiles"],
-                    block_m=block_m, fuse_gelu=fuse_gelu)
+
+    def mm(xin, w, fused):
+        use_res = resident
+        if use_res is None:
+            use_res = resident_panel_fits(xin.shape[1], block_m,
+                                          jnp.dtype(xin.dtype).itemsize,
+                                          cfg.k)
+        fn = bsmm_pallas_resident if use_res else bsmm_pallas
+        return fn(xin, w["rows"], w["tiles"], block_m=block_m,
+                  fuse_gelu=fused)
+
+    h = mm(xf, pparams["w1"], fuse_gelu)
     if not fuse_gelu:
         h = jax.nn.gelu(h)
-    y = bsmm_pallas(h, pparams["w2cm"]["rows"], pparams["w2cm"]["tiles"],
-                    block_m=block_m)
+    y = mm(h, pparams["w2cm"], False)
     return y[:M].reshape(B, S, D).astype(x.dtype)
 
 

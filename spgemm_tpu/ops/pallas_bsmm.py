@@ -21,16 +21,26 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(rows_ref, x_ref, w_ref, out_ref, acc_ref, *, rpc: int,
-            fuse_gelu: bool):
+def _kernel(rows_ref, x_ref, w_ref, out_ref, acc_ref, *, rpc: int, k: int,
+            fuse_gelu: bool, resident: bool):
+    """Shared body for the streaming and resident layouts: only the x-slice
+    expression differs (full streamed block vs a dynamic k-slice of the
+    VMEM-resident panel), so the init/accumulate/epilogue logic -- and with
+    it the two kernels' bit-exactness contract -- cannot drift."""
     r = pl.program_id(2)
 
     @pl.when(r == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
+    if resident:
+        c = pl.program_id(1)
+        row = rows_ref[c, r]  # which k-slice of the resident panel
+        x_slice = x_ref[:, pl.ds(row * k, k)]
+    else:
+        x_slice = x_ref[...]
     acc_ref[...] += jnp.dot(
-        x_ref[...], w_ref[0, 0],
+        x_slice, w_ref[0, 0],
         preferred_element_type=jnp.float32)
 
     @pl.when(r == rpc - 1)
@@ -70,7 +80,7 @@ def bsmm_pallas(x, rows, tiles, *, block_m: int = 128, interpret=None,
         scratch_shapes=[pltpu.VMEM((block_m, k), jnp.float32)],
     )
     return pl.pallas_call(
-        partial(_kernel, rpc=rpc, fuse_gelu=fuse_gelu),
+        partial(_kernel, rpc=rpc, k=k, fuse_gelu=fuse_gelu, resident=False),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((M, nbc * k), x.dtype),
         interpret=interpret,
@@ -78,6 +88,71 @@ def bsmm_pallas(x, rows, tiles, *, block_m: int = 128, interpret=None,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(rows, x, tiles)
+
+
+# VMEM budget for the resident x panel (bytes).  The chip has ~16 MB of
+# VMEM, Pallas DOUBLE-BUFFERS input blocks whose index map varies over the
+# grid (the panel changes with m), and the pipeline still needs room for
+# weight tiles, the accumulator, and the output block -- so the single-copy
+# panel budget is 4 MB (8 MB with its double buffer).
+_RESIDENT_PANEL_BUDGET = 4 * 1024 * 1024
+
+
+@partial(jax.jit, static_argnames=("block_m", "interpret", "fuse_gelu"))
+def bsmm_pallas_resident(x, rows, tiles, *, block_m: int = 128,
+                         interpret=None, fuse_gelu: bool = False):
+    """bsmm_pallas with the x row-panel VMEM-RESIDENT across block-columns.
+
+    The streaming kernel re-DMAs one (block_m, k) x block per (col, pair)
+    grid step: x HBM traffic is nbc*rpc*M*k bytes -- the HBM-bound regime
+    ROOFLINE_FFN.md section 3 derives (~64 FLOP/byte per step).  Here the
+    x BlockSpec is the full (block_m, d_in) panel whose index map depends
+    only on m, so Pallas DMAs it ONCE per M-panel and keeps it in VMEM
+    while the (c, r) grid sweeps all output columns; the kernel selects
+    each pair's k-slice with a dynamic lane-dim slice steered by the
+    scalar-prefetched rows table.  x traffic drops to M*d_in bytes --
+    nbc*rpc/nb_in times less (12x on BASELINE config 5) -- lifting the
+    kernel into the compute-bound regime.  Same contract/bits as
+    bsmm_pallas; caller gates on the panel fitting VMEM
+    (resident_panel_fits)."""
+    M, d_in = x.shape
+    nbc, rpc, k, _ = tiles.shape
+    if M % block_m:
+        raise ValueError(f"M={M} not a multiple of block_m={block_m}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # rows
+        grid=(M // block_m, nbc, rpc),
+        in_specs=[
+            # full row-panel; index map ignores (c, r) => one DMA per m
+            pl.BlockSpec((block_m, d_in), lambda m, c, r, rows: (m, 0)),
+            pl.BlockSpec((1, 1, k, k), lambda m, c, r, rows: (c, r, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_m, k), lambda m, c, r, rows: (m, c)),
+        scratch_shapes=[pltpu.VMEM((block_m, k), jnp.float32)],
+    )
+    return pl.pallas_call(
+        partial(_kernel, rpc=rpc, k=k, fuse_gelu=fuse_gelu, resident=True),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((M, nbc * k), x.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(rows, x, tiles)
+
+
+def resident_panel_fits(d_in: int, block_m: int, dtype_bytes: int = 2,
+                        k: int = 128) -> bool:
+    """Whether the resident kernel is safe to AUTO-pick: the (block_m, d_in)
+    x panel fits the VMEM budget (double-buffering included in the budget
+    constant) AND the dynamic lane slice stays 128-lane-aligned on chip
+    (k % 128 == 0 -- interpret-mode tests may still force resident=True at
+    smaller k).  Callers fall back to the streaming bsmm_pallas otherwise."""
+    return (block_m * d_in * dtype_bytes <= _RESIDENT_PANEL_BUDGET
+            and k % 128 == 0)
 
 
 def w2_to_column_major(cols, tiles, nb_out: int):

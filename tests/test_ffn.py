@@ -117,7 +117,7 @@ def test_pallas_forward_matches_einsum():
     x = jax.random.normal(jax.random.key(21), (2, 4, cfg.d_model), jnp.float32)
     want = ffn_forward(params, x, cfg)
     pp = prepare_pallas_params(params, cfg)
-    got = ffn_forward_pallas(pp, x, cfg, block_m=8)
+    got = ffn_forward_pallas(pp, x, cfg, block_m=8, resident=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
 
@@ -132,12 +132,37 @@ def test_pallas_forward_fused_gelu_matches_unfused():
     params = init_params(cfg, jax.random.key(24))
     x = jax.random.normal(jax.random.key(25), (2, 4, cfg.d_model), jnp.float32)
     pp = prepare_pallas_params(params, cfg)
-    want = ffn_forward_pallas(pp, x, cfg, block_m=8)
-    got = ffn_forward_pallas(pp, x, cfg, block_m=8, fuse_gelu=True)
+    want = ffn_forward_pallas(pp, x, cfg, block_m=8, resident=False)
+    got = ffn_forward_pallas(pp, x, cfg, block_m=8, fuse_gelu=True,
+                             resident=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
     want_ref = ffn_forward(params, x, cfg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_resident_matches_streaming():
+    """The VMEM-resident x-panel kernel (bsmm_pallas_resident) must be
+    bit-compatible with the streaming kernel -- same contraction per output
+    column, only the DMA schedule differs."""
+    from spgemm_tpu.models.ffn import ffn_forward_pallas, prepare_pallas_params
+    from spgemm_tpu.ops.pallas_bsmm import bsmm_pallas, bsmm_pallas_resident
+    cfg = BlockSparseFFNConfig(d_model=64, d_ff=128, k=8, block_density=0.5,
+                               dtype="float32")
+    params = init_params(cfg, jax.random.key(26))
+    x2 = jax.random.normal(jax.random.key(27), (16, cfg.d_model), jnp.float32)
+    w = params["w1"]
+    got = bsmm_pallas_resident(x2, w["rows"], w["tiles"], block_m=8)
+    want = bsmm_pallas(x2, w["rows"], w["tiles"], block_m=8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    x3 = jax.random.normal(jax.random.key(28), (2, 4, cfg.d_model), jnp.float32)
+    pp = prepare_pallas_params(params, cfg)
+    full = ffn_forward_pallas(pp, x3, cfg, block_m=8, resident=True,
+                              fuse_gelu=True)
+    ref = ffn_forward(params, x3, cfg)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(ref),
                                rtol=1e-4, atol=1e-4)
 
 
@@ -149,6 +174,7 @@ def test_pallas_forward_ragged_w2_fanin():
     params = init_params(cfg, jax.random.key(22))
     x = jax.random.normal(jax.random.key(23), (1, 3, cfg.d_model), jnp.float32)
     want = ffn_forward(params, x, cfg)
-    got = ffn_forward_pallas(prepare_pallas_params(params, cfg), x, cfg, block_m=8)
+    got = ffn_forward_pallas(prepare_pallas_params(params, cfg), x, cfg,
+                             block_m=8, resident=False)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
