@@ -18,7 +18,6 @@ OUT=${1:-benchmarks/evidence}
 # full pass their failure must never cost the fail-gated core capture
 EXPLICIT=0; [ -n "${SPGEMM_TPU_EVIDENCE_STEPS:-}" ] && EXPLICIT=1
 STEPS=${SPGEMM_TPU_EVIDENCE_STEPS:-"warm headline sweep ffn ooc big suite"}
-mkdir -p "$OUT"
 
 for s in $STEPS; do
   case "$s" in warm|headline|sweep|ffn|ooc|big|suite) ;; *)
@@ -28,6 +27,12 @@ for s in $STEPS; do
     exit 4;;
   esac
 done
+# re-join on single spaces: want() matches literal " step ", and the env
+# value may be tab- or newline-separated
+# shellcheck disable=SC2086
+set -- $STEPS; STEPS="$*"
+
+mkdir -p "$OUT"
 
 want() { case " $STEPS " in *" $1 "*) return 0;; *) return 1;; esac; }
 
@@ -83,7 +88,9 @@ timeout 1800 python benchmarks/ffn_sweep.py 2>&1 \
   || echo "ffn sweep did not complete (see ffn_sweep.txt)"
 # best-effort for the FULL pass, but when selected explicitly (re-arm
 # subset) the exit code must reflect whether on-chip rows actually landed
-[ "$EXPLICIT" -eq 1 ] && { grep -q '"platform": "tpu"' "$OUT/ffn_sweep.txt" || fail=1; }
+# success = at least one measured row (error rows also carry the tpu tag)
+[ "$EXPLICIT" -eq 1 ] && { { grep -q '"platform": "tpu"' "$OUT/ffn_sweep.txt" \
+  && grep -q '"tflops_per_s"' "$OUT/ffn_sweep.txt"; } || fail=1; }
 fi
 # best-effort out-of-core depth ladder (landing/compute overlap on real D2H)
 if want ooc; then
@@ -91,7 +98,9 @@ echo "[step ooc] out-of-core depth ladder"
 timeout 1800 python benchmarks/ooc_depth_bench.py 2>&1 \
   | tee "$OUT/ooc_depth.txt" | tail -6 \
   || echo "ooc depth ladder did not complete (see ooc_depth.txt)"
-[ "$EXPLICIT" -eq 1 ] && { grep -q '"platform": "tpu"' "$OUT/ooc_depth.txt" || fail=1; }
+# best_depth prints only after the whole ladder completed
+[ "$EXPLICIT" -eq 1 ] && { { grep -q '"platform": "tpu"' "$OUT/ooc_depth.txt" \
+  && grep -q '"best_depth"' "$OUT/ooc_depth.txt"; } || fail=1; }
 fi
 
 # Best-effort BIG-scale runs, isolated from the fail-gated suite: each has
@@ -119,7 +128,8 @@ timeout 1200 python benchmarks/run.py --config webbase-1Mrow 2>&1 \
 # bench.py's kill-budget failure JSON also contains "metric"
 [ "$EXPLICIT" -eq 1 ] && { { grep -q '"metric"' "$OUT/bench_large.txt" \
   && ! grep -q '"fallback"' "$OUT/bench_large.txt" \
-  && ! grep -q 'chain_multiply_wall_clock_failed' "$OUT/bench_large.txt"; } || fail=1; }
+  && ! grep -q 'chain_multiply_wall_clock_failed' "$OUT/bench_large.txt" \
+  && grep '"platform": "tpu"' "$OUT/webbase_1mrow.txt" | grep -q '"wall_s"'; } || fail=1; }
 fi
 
 if want suite; then

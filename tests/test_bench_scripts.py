@@ -137,6 +137,23 @@ def test_write_table_merges_extras(tmp_path, monkeypatch):
     assert "60.0" in text and "33.0" not in text
 
 
+def test_evidence_steps_validated_before_probe(tmp_path):
+    """tpu_evidence.sh rejects unknown/malformed step subsets with exit 4
+    (NOT 2 -- the watcher retries on 2 and would loop for hours on a
+    misconfiguration) before touching any backend, and never writes into
+    the output dir on the rejection path."""
+    out = tmp_path / "ev"
+    for bad in ("ffn,ooc", "headlines", "ffn bogus"):
+        rc = subprocess.run(
+            ["bash", os.path.join(REPO, "benchmarks", "tpu_evidence.sh"),
+             str(out)],
+            env={**os.environ, "SPGEMM_TPU_EVIDENCE_STEPS": bad},
+            capture_output=True, text=True, timeout=60)
+        assert rc.returncode == 4, (bad, rc.returncode, rc.stdout)
+        assert "unknown step" in rc.stdout
+        assert not out.exists()  # validation precedes mkdir
+
+
 def test_suite_rc_nonzero_on_config_error(tmp_path):
     """A crashing config yields an error row AND a nonzero exit."""
     code = (
