@@ -249,7 +249,8 @@ def _run(args) -> int:
     platform = _init_platform(args)
     from spgemm_tpu.chain import chain_product
     from spgemm_tpu.ops.device import DeviceBlockMatrix
-    from spgemm_tpu.ops.spgemm import resolve_backend, spgemm_device
+    from spgemm_tpu.ops.spgemm import (resolve_backend, round_batch_enabled,
+                                       spgemm_device)
     from spgemm_tpu.ops.symbolic import symbolic_join
 
     backend = resolve_backend(args.backend)
@@ -301,7 +302,7 @@ def _run(args) -> int:
     # completion barrier tail (kernel execution beyond dispatch overlap).
     from spgemm_tpu.utils.timers import ENGINE
 
-    times, phase_tables = [], []
+    times, phase_tables, counter_tables = [], [], []
     for _ in range(args.iters):
         ENGINE.reset()
         t0 = time.perf_counter()
@@ -311,8 +312,12 @@ def _run(args) -> int:
         table = ENGINE.snapshot()
         table["device_wait"] = round(t1 - t0 - t_dispatch, 4)
         phase_tables.append(table)
+        counter_tables.append(ENGINE.counter_snapshot())
     best = min(times)
     phases = phase_tables[times.index(best)]
+    # launch counters (chain total): the round-batching regression guard --
+    # detail.dispatches must scale with shape classes, not rounds
+    dispatches = counter_tables[times.index(best)].get("dispatches", 0)
 
     # kernel-rate detail: a genuinely mid-chain SpGEMM (two level-1 partial
     # products, i.e. doubled bandwidth and real fill-in), same kernel
@@ -399,6 +404,8 @@ def _run(args) -> int:
             "values_dist": args.dist, "multiply": args.multiply,
             "tpu_parity": tpu_parity,
             "phases_s": phases,
+            "dispatches": dispatches,
+            "round_batch": int(round_batch_enabled()),
             **({"fallback": {
                 "reason": f"{args.cpu_fallback}; CPU with clamped workload",
                 "standing_evidence": "see the newest BENCH_r*.json with a "

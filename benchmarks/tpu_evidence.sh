@@ -13,9 +13,12 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 OUT=${1:-benchmarks/evidence}
-# EXPLICIT=1 when the operator chose a subset: only then do the normally
-# best-effort steps (ffn/ooc/big) gate the exit code -- on the default
-# full pass their failure must never cost the fail-gated core capture
+# EXPLICIT=1 whenever SPGEMM_TPU_EVIDENCE_STEPS is set -- INCLUDING when it
+# spells out the full default list: any explicit value arms the strict
+# per-step gates below (a selected ffn/ooc/big step that produced no real
+# on-chip row flips the exit code to 1).  Only the unset default keeps
+# those steps best-effort, so their failure can never cost the fail-gated
+# core capture of a full pass.
 EXPLICIT=0; [ -n "${SPGEMM_TPU_EVIDENCE_STEPS:-}" ] && EXPLICIT=1
 STEPS=${SPGEMM_TPU_EVIDENCE_STEPS:-"warm headline sweep ffn ooc big suite"}
 
@@ -31,6 +34,10 @@ done
 # value may be tab- or newline-separated
 # shellcheck disable=SC2086
 set -- $STEPS; STEPS="$*"
+# a whitespace-only SPGEMM_TPU_EVIDENCE_STEPS (quoting typo) would pass the
+# zero-iteration validation loop and exit 0 having captured nothing --
+# vacuous success; 4 stops the watcher immediately (2 would make it retry)
+[ -z "$STEPS" ] && { echo "empty SPGEMM_TPU_EVIDENCE_STEPS"; exit 4; }
 
 mkdir -p "$OUT"
 
@@ -87,10 +94,14 @@ timeout 1800 python benchmarks/ffn_sweep.py 2>&1 \
   | tee "$OUT/ffn_sweep.txt" | tail -6 \
   || echo "ffn sweep did not complete (see ffn_sweep.txt)"
 # best-effort for the FULL pass, but when selected explicitly (re-arm
-# subset) the exit code must reflect whether on-chip rows actually landed
-# success = at least one measured row (error rows also carry the tpu tag)
-[ "$EXPLICIT" -eq 1 ] && { { grep -q '"platform": "tpu"' "$OUT/ffn_sweep.txt" \
-  && grep -q '"tflops_per_s"' "$OUT/ffn_sweep.txt"; } || fail=1; }
+# subset) the exit code must reflect whether on-chip rows actually landed.
+# Line-level check (same form as the webbase gate below): success = at
+# least one MEASURED tpu row -- two file-level greps could be satisfied by
+# an error row carrying the tpu tag plus an unrelated tflops_per_s line.
+# grep -c (not -q): -q exits at the first match and under pipefail the
+# upstream grep's SIGPIPE (141) would flip a successful capture to fail=1
+[ "$EXPLICIT" -eq 1 ] && { grep '"platform": "tpu"' "$OUT/ffn_sweep.txt" \
+  | grep -c '"tflops_per_s"' >/dev/null || fail=1; }
 fi
 # best-effort out-of-core depth ladder (landing/compute overlap on real D2H)
 if want ooc; then
@@ -129,7 +140,7 @@ timeout 1200 python benchmarks/run.py --config webbase-1Mrow 2>&1 \
 [ "$EXPLICIT" -eq 1 ] && { { grep -q '"metric"' "$OUT/bench_large.txt" \
   && ! grep -q '"fallback"' "$OUT/bench_large.txt" \
   && ! grep -q 'chain_multiply_wall_clock_failed' "$OUT/bench_large.txt" \
-  && grep '"platform": "tpu"' "$OUT/webbase_1mrow.txt" | grep -q '"wall_s"'; } || fail=1; }
+  && grep '"platform": "tpu"' "$OUT/webbase_1mrow.txt" | grep -c '"wall_s"' >/dev/null; } || fail=1; }
 fi
 
 if want suite; then

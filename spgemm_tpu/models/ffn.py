@@ -31,6 +31,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from spgemm_tpu.utils import jaxcompat
+
 
 @dataclass(frozen=True)
 class BlockSparseFFNConfig:
@@ -212,7 +214,7 @@ def make_sharded_train_step(mesh: Mesh, cfg: BlockSparseFFNConfig, lr: float = 1
              "w2": {"cols": P("tp"), "tiles": P("tp")}}
     data_spec = P("dp", "tp")  # batch dp-sharded, seq tp-sharded (SP at rest)
 
-    step = jax.shard_map(
+    step = jaxcompat.shard_map(
         per_shard_step,
         mesh=mesh,
         in_specs=(pspec, data_spec, data_spec),

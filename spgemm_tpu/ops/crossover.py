@@ -121,6 +121,12 @@ def mxu_wins(numeric_exact, numeric_mxu, *, key: str, k: int, K: int,
         import jax.numpy as jnp  # noqa: PLC0415
         import numpy as np  # noqa: PLC0415
 
+        # round-batched dispatch merges whole fanout classes, so key axes
+        # now reach 8192; both kernels' per-key cost is shape-stationary
+        # beyond a few thousand keys, so cap the one-time measurement shape
+        # while still keying the cache on the true class -- the ranking is
+        # what is persisted, and it is K-stable in that regime.
+        K = min(K, 4096)
         rng = np.random.default_rng(0)
         plane = rng.integers(0, 1 << 32, size=(nnzb + 1, k, k),
                              dtype=np.int64).astype(np.uint32)

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spgemm_tpu.ops import u64
+from spgemm_tpu.ops.symbolic import accept_round_stack
 
 N_LIMBS = 10  # ceil(64 / 7)
 _M32 = np.uint32(0xFFFFFFFF)
@@ -139,13 +140,16 @@ def _combine_mod_m(S, k: int):
     return u64.addmod_field(acc[3], acc[2], acc[1], acc[0])
 
 
+@accept_round_stack
 @jax.jit
 def numeric_round_mxu(a_hi, a_lo, b_hi, b_lo, pa, pb):
     """Same contract as ops.spgemm.numeric_round_impl, field-mode semantics.
 
     a_*/b_* : (nnzb + 1, k, k) uint32 slabs (sentinel zero tile last).
     pa, pb  : (K, P) int32 slab indices, sentinel-padded (zero tiles
-              contribute exactly 0 in field mode too).
+              contribute exactly 0 in field mode too).  A stacked (R, K, P)
+              batch of same-shape rounds is also accepted and returns
+              (R, K, k, k) (symbolic.accept_round_stack).
     Returns (out_hi, out_lo): (K, k, k) uint32, residues mod 2^64-1.
     """
     K, P = pa.shape

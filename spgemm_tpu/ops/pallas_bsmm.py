@@ -20,6 +20,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from spgemm_tpu.utils import jaxcompat
+
 
 def _kernel(rows_ref, x_ref, w_ref, out_ref, acc_ref, *, rpc: int, k: int,
             fuse_gelu: bool, resident: bool):
@@ -84,7 +86,7 @@ def bsmm_pallas(x, rows, tiles, *, block_m: int = 128, interpret=None,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((M, nbc * k), x.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jaxcompat.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(rows, x, tiles)
@@ -138,7 +140,7 @@ def bsmm_pallas_resident(x, rows, tiles, *, block_m: int = 128,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((M, nbc * k), x.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jaxcompat.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
     )(rows, x, tiles)

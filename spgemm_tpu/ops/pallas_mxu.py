@@ -50,7 +50,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from spgemm_tpu.ops import u64
+from spgemm_tpu.utils import jaxcompat
 from spgemm_tpu.ops.mxu_spgemm import N_LIMBS
+from spgemm_tpu.ops.symbolic import accept_round_stack
 
 _M32_U32 = jnp.uint32(0xFFFFFFFF)
 
@@ -194,6 +196,7 @@ def limbs_for_bound(val_bound: int | None) -> int:
     return min(N_LIMBS, max(1, -(-int(val_bound).bit_length() // 7)))
 
 
+@accept_round_stack
 @partial(jax.jit,
          static_argnames=("interpret", "a_limbs", "b_limbs", "pair_width",
                           "raw_epilogue"))
@@ -219,6 +222,9 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
               lane slicing -- at 3x3 limbs the output is ~= the same size,
               so this should win there; the sweep decides.
     Returns (out_hi, out_lo): (K, k, k) uint32, residues mod 2^64-1.
+
+    A stacked (R, K, P) pa/pb is also accepted and returns (R, K, k, k)
+    (symbolic.accept_round_stack -- round-batched dispatch).
     """
     K, P = pa.shape
     k = a_hi.shape[-1]
@@ -282,7 +288,7 @@ def numeric_round_mxu_pallas(a_hi, a_lo, b_hi, b_lo, pa, pb, interpret=None,
         grid_spec=grid_spec,
         out_shape=out_shape,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=jaxcompat.CompilerParams(
             # pair axis must be sequential (scratch accumulation); the key
             # axis revisits the scratch too, so both stay "arbitrary"
             dimension_semantics=("arbitrary", "arbitrary"),

@@ -20,6 +20,7 @@ provides the Pallas TPU kernel for the same contract (selected via backend=).
 from __future__ import annotations
 
 import logging
+import os
 from functools import partial
 
 import jax
@@ -27,10 +28,34 @@ import jax.numpy as jnp
 import numpy as np
 
 from spgemm_tpu.ops import u64
-from spgemm_tpu.ops.symbolic import plan_rounds, symbolic_join
+from spgemm_tpu.ops.symbolic import (accept_round_stack, assembly_permutation,
+                                     plan_rounds, symbolic_join)
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 
 log = logging.getLogger("spgemm_tpu.spgemm")
+
+
+def round_batch_enabled() -> bool:
+    """SPGEMM_TPU_ROUND_BATCH=0|1 (default 1): whole-engine A/B of the
+    round-batched dispatch path -- 1 = one mega-launch per (fanout class,
+    kernel choice) with the fused single-gather assembly, 0 = the legacy
+    one-launch-per-round loop with per-round output slicing.  Both produce
+    identical bits; the knob exists so the dispatch/assembly overhead win
+    is measurable in one flag flip (bench.py detail.phases_s/dispatches)."""
+    env = os.environ.get("SPGEMM_TPU_ROUND_BATCH", "1")
+    if env not in ("0", "1"):
+        raise ValueError(
+            f"SPGEMM_TPU_ROUND_BATCH must be '0' or '1', got {env!r}")
+    return env == "1"
+
+
+def _batch_entries(k: int) -> int:
+    """Per-mega-launch key*pair entry budget: bounds the XLA backend's
+    gather materialization (4 planes of entries * k * k uint32, ~1 GB at
+    k=32) while leaving every fanout class at realistic scales in one
+    launch.  Scales with 1/k^2 because the per-entry footprint scales with
+    k^2; the SMEM budget (max_entries) still applies on top for Pallas."""
+    return max(1024, (1 << 26) // (k * k))
 
 
 def pack_tiles(m: BlockSparseMatrix, device=None):
@@ -48,6 +73,7 @@ def pack_tiles(m: BlockSparseMatrix, device=None):
     return jnp.asarray(hi), jnp.asarray(lo)
 
 
+@accept_round_stack
 def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
     """One fixed-shape numeric round (unjitted impl -- wrapped by _numeric_round
     and by parallel/rowshard's shard_map).
@@ -56,6 +82,9 @@ def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
     pa, pb  : (K, P) int32 slab indices; per-key pair lists in j-ascending
               order, padded with the sentinel.
     Returns (out_hi, out_lo): (K, k, k) uint32.
+
+    A stacked (R, K, P) pa/pb is also accepted and returns (R, K, k, k)
+    (symbolic.accept_round_stack -- round-batched dispatch).
 
     The fold runs sequentially over the flattened (pair, j) axis -- P*k steps
     of vectorized (K, k, k) limb arithmetic -- because addmod is not
@@ -112,6 +141,36 @@ def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
 _numeric_round = jax.jit(numeric_round_impl)
 
 
+@jax.jit
+def _assemble(outs_h, outs_l, take):
+    """Round-batched assembly: pad-concat the (whole, padded) round outputs,
+    append one zero row, and gather both planes through the precomputed
+    inverse permutation (ops/symbolic.assembly_permutation) -- one executable
+    for the entire epilogue, replacing the legacy per-round slice + concat
+    chain.  Bit-identical: every real key reads its own output row; the
+    sentinel slot reads the appended zero row."""
+    k = outs_h[0].shape[-1]
+    zero = jnp.zeros((1, k, k), jnp.uint32)
+    cat_h = jnp.concatenate(list(outs_h) + [zero], axis=0)
+    cat_l = jnp.concatenate(list(outs_l) + [zero], axis=0)
+    return cat_h[take], cat_l[take]
+
+
+def _proof_fanout_cap(a_bound: int, b_bound: int, k: int) -> int | None:
+    """Largest fanout for which mxu_spgemm.safe_exact_bound holds at these
+    operand bounds (None = every fanout proves, no partition needed).  Used
+    by round-batched hybrid planning to partition each fanout class at the
+    proof threshold BEFORE merging, so kernel routing keeps the per-key
+    granularity the per-round path had."""
+    denom = a_bound * b_bound * k
+    if denom == 0:
+        return None  # zero operands: every product is 0, any fanout proves
+    cap = ((1 << 64) - 2) // denom
+    # safe_exact_bound treats fanout 0 as 1; a cap of 0 still partitions
+    # correctly (everything lands in the unproven part)
+    return cap if cap < (1 << 63) else None
+
+
 def resolve_backend(backend: str | None) -> str:
     """None -> 'pallas' on TPU, 'xla' elsewhere (the Pallas kernel runs in
     interpret mode on CPU, which is correct but slow -- tests opt in).
@@ -131,17 +190,28 @@ def _select_numeric(backend: str, a, b):
     default_round_size) for operands a, b (their val_bounds parameterize
     the MXU limb grids)."""
     if backend == "pallas":
-        import os  # noqa: PLC0415
-
-        from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas  # noqa: PLC0415
+        from spgemm_tpu.ops.pallas_spgemm import (  # noqa: PLC0415
+            numeric_round_pallas, validate_vpu_config)
 
         # manual A/B hooks: SPGEMM_TPU_VPU_ALGO=vecj runs the whole engine
         # (CLI, bench) on the alternate kernel layout, SPGEMM_TPU_VPU_PB=N
         # on pair-axis blocking; defaults are the tuned values.  jit caches
-        # per static value, so this costs nothing.
-        numeric = partial(numeric_round_pallas,
-                          algo=os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast"),
-                          pair_block=int(os.environ.get("SPGEMM_TPU_VPU_PB", "1")))
+        # per static value, so this costs nothing.  Validate at ENTRY: the
+        # unsupported combinations die on TPU hardware with a bare
+        # JaxRuntimeError deep inside Mosaic (round-5 VERDICT "What's weak"
+        # #2), so the engine rejects them here with the knob named.
+        algo = os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast")
+        try:
+            pair_block = int(os.environ.get("SPGEMM_TPU_VPU_PB", "1"))
+        except ValueError as e:
+            raise ValueError(
+                f"SPGEMM_TPU_VPU_PB must be an integer >= 1, got "
+                f"{os.environ['SPGEMM_TPU_VPU_PB']!r}") from e
+        platform = jax.devices()[0].platform
+        validate_vpu_config(algo, pair_block, platform=platform,
+                            interpret=platform == "cpu")
+        numeric = partial(numeric_round_pallas, algo=algo,
+                          pair_block=pair_block)
         # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
         # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to 8),
         # not by gather materialization: merge key chunks into fewer, bigger
@@ -162,8 +232,6 @@ def _select_numeric(backend: str, a, b):
             # SPGEMM_TPU_MXU_R: whole-engine A/B of the pair width R, like
             # the VPU's ALGO/PB hooks above (static -> one jit cache entry
             # per value)
-            import os  # noqa: PLC0415
-
             numeric = partial(numeric_round_mxu_pallas,
                               a_limbs=limbs_for_bound(a.val_bound),
                               b_limbs=limbs_for_bound(b.val_bound),
@@ -197,8 +265,6 @@ def _hybrid_setup(a, b, k):
     hardware data showed the proof-only gate routing provably-safe rounds
     to a kernel ~6x slower than the exact one).
     """
-    import os  # noqa: PLC0415
-
     from spgemm_tpu.ops import crossover  # noqa: PLC0415
     from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
     from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
@@ -261,8 +327,12 @@ def _hybrid_setup(a, b, k):
             # is gather- and fold-shape-bound, not slab-size-bound).  The
             # VPU side of the measurement is the PROVEN-round kernel
             # (nomod where available) -- that is what an MXU loss would
-            # actually run, so the routing is unbiased.
-            Kc, P = _shape_class(rnd.pa.shape[0]), rnd.pa.shape[1]
+            # actually run, so the routing is unbiased.  Kc is capped at
+            # the measured ceiling (crossover measures at <= 4096 keys --
+            # per-key cost is shape-stationary there), so mega-round
+            # classes above it share one cache entry and one measurement.
+            Kc = min(_shape_class(rnd.pa.shape[0]), 4096)
+            P = rnd.pa.shape[1]
             if not crossover.mxu_wins(
                     numeric_exact_proven, numeric_mxu,
                     key=f"{key_prefix}:K{Kc}:P{P}", k=k, K=Kc, P=P,
@@ -303,18 +373,37 @@ def spgemm_device(a, b, *, round_size: int | None = None,
         numeric, max_entries, default_rs, choose_numeric = _hybrid_setup(a, b, k)
     else:
         numeric, max_entries, default_rs = _select_numeric(backend, a, b)
-    round_size = default_rs if round_size is None else round_size
 
+    batch = round_batch_enabled()
     with timers.phase("plan_rounds"):
-        rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                             round_size=round_size, max_entries=max_entries)
+        if batch:
+            # round-batched dispatch: one mega-round per fanout class
+            # (partitioned at the hybrid proof threshold so kernel routing
+            # stays key-exact), bounded by the gather/SMEM budgets.  An
+            # explicit round_size still caps the key axis.
+            split = None
+            if (choose_numeric is not None and a.val_bound is not None
+                    and b.val_bound is not None):
+                split = _proof_fanout_cap(a.val_bound, b.val_bound, k)
+            rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+                                 round_size=round_size,
+                                 max_entries=max_entries, batch=True,
+                                 batch_entries=_batch_entries(k),
+                                 split_fanout=split)
+        else:
+            round_size = default_rs if round_size is None else round_size
+            rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+                                 round_size=round_size,
+                                 max_entries=max_entries)
+        # the assembly gather's inverse permutation is precomputed on host
+        # here, off the dispatch/assembly spans
+        take_np = assembly_permutation(rounds, join.num_keys) if batch else None
 
     # All rounds dispatch asynchronously; outputs are assembled into one
-    # key-ordered slab on device (concat + gather), never touching host.
-    # Timed phases are host-side spans (dispatch, not device completion --
-    # the device tail is the caller's block_until_ready); the reference's
-    # Table-2 analog phases are symbolic_join / plan_rounds /
-    # numeric_dispatch / assembly.
+    # key-ordered slab on device, never touching host.  Timed phases are
+    # host-side spans (dispatch, not device completion -- the device tail is
+    # the caller's block_until_ready); the reference's Table-2 analog phases
+    # are symbolic_join / plan_rounds / numeric_dispatch / assembly.
     mxu_rounds = proof_rounds = 0
     with timers.phase("numeric_dispatch"):
         outs_h, outs_l, order = [], [], []
@@ -326,22 +415,39 @@ def spgemm_device(a, b, *, round_size: int | None = None,
                 proof_rounds += proof_ok
             oh, ol = fn(a.hi, a.lo, b.hi, b.lo,
                         jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
-            n_valid = len(rnd.key_index)
-            outs_h.append(oh[:n_valid])
-            outs_l.append(ol[:n_valid])
-            order.append(rnd.key_index)
+            timers.incr("dispatches")
+            if batch:
+                # outputs are consumed whole (padded tails included): the
+                # precomputed permutation skips the pad rows, so no per-round
+                # slice op is ever enqueued
+                outs_h.append(oh)
+                outs_l.append(ol)
+            else:
+                n_valid = len(rnd.key_index)
+                outs_h.append(oh[:n_valid])
+                outs_l.append(ol[:n_valid])
+                order.append(rnd.key_index)
 
-    # inv[key] = position of that key in the concatenated round outputs;
-    # the extra last entry maps the sentinel slot to the appended zero tile.
     with timers.phase("assembly"):
-        cat_idx = np.concatenate(order)
-        inv = np.empty(join.num_keys + 1, np.int64)
-        inv[cat_idx] = np.arange(len(cat_idx))
-        inv[-1] = len(cat_idx)
-        take = jnp.asarray(inv)
-        zero = jnp.zeros((1, k, k), jnp.uint32)
-        out_hi = jnp.concatenate(outs_h + [zero], axis=0)[take]
-        out_lo = jnp.concatenate(outs_l + [zero], axis=0)[take]
+        if batch:
+            # one fused jit call: pad-concat + single gather through the
+            # precomputed inverse permutation into the output slab (the
+            # legacy path's per-round slice + unjitted concat chain enqueued
+            # 2-3 executables PER ROUND -- enough to stall the host on the
+            # backend's in-flight dispatch throttle at chain scales)
+            out_hi, out_lo = _assemble(outs_h, outs_l, jnp.asarray(take_np))
+        else:
+            # inv[key] = position of that key in the concatenated round
+            # outputs; the extra last entry maps the sentinel slot to the
+            # appended zero tile.
+            cat_idx = np.concatenate(order)
+            inv = np.empty(join.num_keys + 1, np.int64)
+            inv[cat_idx] = np.arange(len(cat_idx))
+            inv[-1] = len(cat_idx)
+            take = jnp.asarray(inv)
+            zero = jnp.zeros((1, k, k), jnp.uint32)
+            out_hi = jnp.concatenate(outs_h + [zero], axis=0)[take]
+            out_lo = jnp.concatenate(outs_l + [zero], axis=0)[take]
 
     # structured observability (SURVEY.md section 5.5): size, fill-in, work
     total_pairs = int(join.pair_ptr[-1])
@@ -360,9 +466,10 @@ def spgemm_device(a, b, *, round_size: int | None = None,
                                       int(join.fanouts.max()), k)
             if proven is not None:
                 out_bound = proven
-    log.info("spgemm[%s]: nnzb %d x %d -> keys=%d pairs=%d rounds=%d work=%.3f GFLOP",
+    log.info("spgemm[%s]: nnzb %d x %d -> keys=%d pairs=%d dispatches=%d "
+             "batch=%d work=%.3f GFLOP",
              tag, a.nnzb, b.nnzb, join.num_keys, total_pairs, len(rounds),
-             2.0 * total_pairs * k ** 3 / 1e9)
+             batch, 2.0 * total_pairs * k ** 3 / 1e9)
 
     return DeviceBlockMatrix(rows=a.rows, cols=b.cols, k=k,
                              coords=join.keys, hi=out_hi, lo=out_lo,
@@ -391,15 +498,16 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
     Sub-slab sizes are padded to the 3/4-pow-2 ladder so the jit cache sees
     a logarithmic set of shapes, and rounds are pipelined depth-deep
-    (default two): the next rounds' host-side gathers and uploads overlap
-    round i's device execution.
+    (default two) through a 3-stage worker pipeline -- staging thread (host
+    gather/pack) -> main thread (upload + launch) -> landing thread (D2H +
+    host scatter) -- so round i+1's host gather, round i's device execution,
+    and round i-1's result landing all overlap.
 
     Semantics, ordering, and output structure are identical to spgemm
     (reference wrap-then-mod, SURVEY.md section 2.9), including per-round
     'hybrid' dispatch (exact host-side value bounds feed the same proof as
     the resident pipeline's).
     """
-    import os  # noqa: PLC0415
     from types import SimpleNamespace  # noqa: PLC0415
 
     from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
@@ -440,8 +548,12 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
                              round_size=round_size, max_entries=max_entries)
 
-    def stage(rnd):
-        """Host gather + upload of one round's referenced tiles."""
+    def host_prep(rnd):
+        """Stage 1 (host-only): gather + pad one round's referenced tiles
+        into upload-ready (hi, lo) planes.  Pure numpy -- under depth >= 2
+        this runs on the staging worker thread, so the unique/searchsorted/
+        pack cost overlaps the device compute and D2H of earlier rounds
+        instead of sitting on the dispatch critical path."""
         ua = np.unique(rnd.pa)
         ua = ua[ua < a.nnzb]          # drop the global sentinel
         ub = np.unique(rnd.pb)
@@ -460,11 +572,19 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         b_sub[: len(ub)] = b.tiles[ub]
         ah, al = u64.u64_to_hilo(a_sub)
         bh, bl = u64.u64_to_hilo(b_sub)
+        return ah, al, bh, bl, sub_pa, sub_pb
+
+    def dispatch(rnd, prep):
+        """Stage 2 (main thread): upload the prepped planes + one numeric
+        launch.  Kernel choice stays on the main thread because the hybrid
+        gate may run a one-time crossover measurement on the device."""
+        ah, al, bh, bl, sub_pa, sub_pb = prep
         fn, used_mxu = (numeric, False) if choose_numeric is None \
             else choose_numeric(rnd)[:2]
         out = fn(jnp.asarray(ah), jnp.asarray(al),
                  jnp.asarray(bh), jnp.asarray(bl),
                  jnp.asarray(sub_pa), jnp.asarray(sub_pb))
+        timers.incr("dispatches")
         return out, used_mxu
 
     out_tiles = np.zeros((join.num_keys, k, k), np.uint64)
@@ -478,13 +598,23 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
     # pipeline depth: how many un-landed rounds may be in flight.  Depth 1
     # is the synchronous minimal-HBM mode (land each round before staging
-    # the next, zero overlap).  Depth >= 2 hands landing to a dedicated
-    # worker thread: the producer keeps staging/dispatching while the
-    # worker blocks on each round's D2H fetch (np.asarray releases the GIL
-    # during the device wait), so landing no longer absorbs compute wait
-    # in the main loop -- the round-4 Large profile showed 86% of wall in
-    # that blocking fetch (ROUND4_NOTES).  The queue bound keeps peak HBM
-    # at `depth` rounds' outputs + the staging round's operand sub-slabs.
+    # the next, zero overlap).  Depth >= 2 runs the full 3-stage pipeline:
+    #
+    #   staging worker (host gather/pack)  ->  main thread (upload +
+    #   launch)  ->  landing worker (D2H fetch + host scatter)
+    #
+    # The landing worker blocks on each round's D2H fetch (np.asarray
+    # releases the GIL during the device wait), so landing never absorbs
+    # compute wait in the main loop -- the round-4 Large profile showed 86%
+    # of wall in that blocking fetch (ROUND4_NOTES).  The staging worker
+    # runs host_prep (np.unique/searchsorted/pack -- numpy releases the GIL
+    # for the bulk of it) ahead of the main loop, so the next round's host
+    # gather overlaps the current round's device execution instead of
+    # sitting on the producer's critical path.  `slots` is the peak-HBM
+    # bound: a round's output slot is taken before its sub-slabs are
+    # UPLOADED and released only once it has LANDED, so at most `depth`
+    # rounds' sub-slabs + outputs are alive on device; staged-but-not-
+    # dispatched preps are host RAM, bounded by the stage queue's depth.
     # Landing order across rounds is irrelevant to bit-exactness: each
     # round writes a disjoint key_index slice of out_tiles, and the fold
     # order lives inside the kernels (test_outofcore pins depths 1/4
@@ -494,7 +624,7 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     if depth == 1:
         for rnd in rounds:
             with timers.phase("numeric_dispatch"):
-                (oh, ol), used_mxu = stage(rnd)
+                (oh, ol), used_mxu = dispatch(rnd, host_prep(rnd))
                 mxu_rounds += used_mxu
             with timers.phase("assembly"):
                 land(oh, ol, rnd.key_index)
@@ -503,13 +633,36 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         import threading  # noqa: PLC0415
 
         landq: queue_mod.Queue = queue_mod.Queue()
+        stageq: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
+        stop = threading.Event()
         land_err: list = []
-        # `slots` is the peak-HBM bound: a round's output slot is taken
-        # before it is staged and released only once it has LANDED, so at
-        # most `depth` rounds' outputs are alive on device -- the same
-        # bound the old synchronous in_flight list enforced (a bounded
-        # queue alone would under-count the item the worker holds).
+        prep_err: list = []
         slots = threading.Semaphore(depth)
+
+        def _put(q, item):
+            """Bounded put that can never deadlock a dying pipeline: bail
+            out once the main thread has signalled shutdown."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def _stager():
+            try:
+                for rnd in rounds:
+                    if stop.is_set() or land_err:
+                        return
+                    with timers.phase("stage_prep"):
+                        prep = host_prep(rnd)
+                    if not _put(stageq, (rnd, prep)):
+                        return
+            except Exception as e:  # noqa: BLE001 -- re-raised below
+                prep_err.append(e)
+            finally:
+                _put(stageq, None)
 
         def _lander():
             while True:
@@ -526,21 +679,30 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
         lander = threading.Thread(target=_lander, name="ooc-landing",
                                   daemon=True)
+        stager = threading.Thread(target=_stager, name="ooc-staging",
+                                  daemon=True)
         lander.start()
+        stager.start()
         try:
-            for rnd in rounds:
-                if land_err:
+            while True:
+                item = stageq.get()
+                if item is None or land_err:
                     break
+                rnd, prep = item
                 slots.acquire()
                 with timers.phase("numeric_dispatch"):
-                    (oh, ol), used_mxu = stage(rnd)
+                    (oh, ol), used_mxu = dispatch(rnd, prep)
                     mxu_rounds += used_mxu
                 landq.put((oh, ol, rnd.key_index))
         finally:
-            # always shut the worker down, also when stage() raises --
-            # a leaked lander would pin out_tiles for process lifetime
+            # always shut both workers down, also when dispatch raises --
+            # a leaked worker would pin out_tiles for process lifetime
+            stop.set()
             landq.put(None)
             lander.join()
+            stager.join()
+        if prep_err:
+            raise prep_err[0]
         if land_err:
             raise land_err[0]
 

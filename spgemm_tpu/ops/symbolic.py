@@ -20,9 +20,32 @@ the order the reference's sorted-map traversal produces.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import numpy as np
+
+
+def accept_round_stack(numeric_fn):
+    """Wrap a numeric-round kernel so a stacked (R, K, P) pa/pb -- R
+    same-shape rounds batched along a leading axis -- is accepted and
+    returns (R, K, k, k).
+
+    The stack flattens into the key axis: keys are disjoint across rounds
+    and each key's fold order lives inside its own pair list, so batching
+    is bit-exact by construction (round-batched dispatch).  One definition
+    shared by all four numeric kernels; array-library agnostic (only
+    ndim/shape/reshape)."""
+    @functools.wraps(numeric_fn)
+    def wrapped(a_hi, a_lo, b_hi, b_lo, pa, pb, **kw):
+        if pa.ndim != 3:
+            return numeric_fn(a_hi, a_lo, b_hi, b_lo, pa, pb, **kw)
+        R, K, P = pa.shape
+        k = a_hi.shape[-1]
+        oh, ol = numeric_fn(a_hi, a_lo, b_hi, b_lo,
+                            pa.reshape(R * K, P), pb.reshape(R * K, P), **kw)
+        return oh.reshape(R, K, k, k), ol.reshape(R, K, k, k)
+    return wrapped
 
 
 @dataclass
@@ -173,6 +196,14 @@ def _floor_pow2(x: int) -> int:
     return 1 << (max(int(x), 1).bit_length() - 1)
 
 
+def _ladder_floor(x: int) -> int:
+    """Largest pow2-or-3/4-pow2 ladder value <= x (floor twin of
+    _shape_class's ceiling)."""
+    p = _floor_pow2(x)
+    c = 3 * p // 2  # = 3/4 of the next pow2 rung
+    return c if p >= 2 and c <= x else p
+
+
 def _shape_class_vec(f: np.ndarray) -> np.ndarray:
     """Round up to {1, 2, 3, 4, 6, 8, 12, 16, ...}: pow2 plus 3/4-pow2.
 
@@ -189,9 +220,46 @@ def _shape_class(x: int) -> int:
     return int(_shape_class_vec(np.array([x]))[0])
 
 
+def assembly_permutation(rounds: list["Round"], num_keys: int) -> np.ndarray:
+    """Precomputed inverse permutation for the assembly gather.
+
+    inv[key] = row of that key in the PADDED concatenation of the rounds'
+    outputs (padded tail rows stay in place -- the numeric outputs are
+    consumed whole, no per-round device slicing); the extra last entry maps
+    the sentinel slot to a zero row appended after the concatenation.
+    Host-side numpy, so the device assembly phase is exactly one gather."""
+    total = sum(r.pa.shape[0] for r in rounds)
+    inv = np.full(num_keys + 1, total, np.int64)
+    off = 0
+    for r in rounds:
+        inv[r.key_index] = off + np.arange(len(r.key_index))
+        off += r.pa.shape[0]
+    return inv
+
+
+def _smem_key_cap(P: int, max_entries: int) -> int:
+    """Key-chunk cap for fanout class P under a per-round index-array entry
+    budget (the Pallas kernels' scalar-prefetch arrays live in SMEM).
+
+    The kernel ships pa/pb with the LONGER axis in lanes (lane-padded to
+    128, sublanes to 8), so the per-array footprint is
+    pad8(short) * max(long, 128) entries; solve for the key-chunk size."""
+    pad8_p = -(-P // 8) * 8
+    if P <= 512:
+        return max_entries // pad8_p              # (P, K): P sublanes
+    # (K, P): P rides the lanes and is padded to a 128 multiple by Mosaic --
+    # budget against the padded footprint, not raw P, or the shipped arrays
+    # overshoot SMEM for non-128-multiple fanout classes
+    pad128_p = -(-P // 128) * 128
+    return max(max_entries // pad128_p, 1)
+
+
 def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
-                round_size: int = 512,
-                max_entries: int | None = None) -> list[Round]:
+                round_size: int | None = 512,
+                max_entries: int | None = None,
+                batch: bool = False,
+                batch_entries: int | None = None,
+                split_fanout: int | None = None) -> list[Round]:
     """Bucket output keys by fanout class and chop into fixed-shape rounds.
 
     a_sentinel/b_sentinel: index of the appended all-zero tile in each slab.
@@ -204,57 +272,91 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
     bigger launches for a backend whose per-round index arrays are bounded by
     a memory budget (the Pallas kernel's scalar-prefetch arrays live in SMEM)
     rather than by gather-materialization size (the XLA backend's constraint).
+
+    batch: round-batched ("mega-round") planning -- each fanout class's keys
+    merge into ONE round (the (R, K, P) stack of the per-round plan,
+    flattened into the key axis: keys are disjoint across rounds and the
+    fold order lives entirely inside each key's pair list, so the merge is
+    bit-exact by construction).  Dispatch count then scales with the number
+    of shape classes, not the number of keys.  round_size becomes an
+    OPTIONAL explicit cap (None = uncapped); batch_entries bounds the
+    per-launch key*pair entry count (the XLA backend's gather
+    materialization); the SMEM cap still applies when max_entries is set.
+    The key axis pads to the finer 3/4-pow-2 ladder instead of pow4: a
+    mega-round's tail padding is a fraction of the WHOLE class, so the 25%
+    ladder matters where the pow4 ladder's 4x tail would not.
+
+    split_fanout: if set (batch mode), each class's keys are partitioned
+    into fanout <= split_fanout and > split_fanout before merging -- the
+    hybrid dispatcher's exactness proof is a fanout threshold, so this
+    keeps proof granularity at the key level while still dispatching one
+    launch per (class, kernel-choice) partition.
     """
-    if round_size < 1:
+    if round_size is not None and round_size < 1:
         raise ValueError(f"round_size must be >= 1, got {round_size}")
+    if round_size is None and not batch:
+        round_size = 512
     rounds: list[Round] = []
     if join.num_keys == 0:
         return rounds
     fan = join.fanouts
     classes = _shape_class_vec(fan)
     for cls in np.unique(classes):
-        members = np.flatnonzero(classes == cls)
+        members_all = np.flatnonzero(classes == cls)
         P = int(cls)
-        if max_entries is None:
+        if batch and split_fanout is not None:
+            f = fan[members_all]
+            parts = [members_all[f <= split_fanout],
+                     members_all[f > split_fanout]]
+            parts = [p for p in parts if len(p)]
+        else:
+            parts = [members_all]
+        if batch:
+            # one launch per class partition, bounded by every budget that
+            # applies: the caller's explicit cap, the gather-materialization
+            # entry budget, the SMEM index-array budget, and the 8192
+            # compiled-shape ceiling.  The cap lands on the 3/4-pow-2 ladder
+            # so tail rounds pad to <= 1/3 waste.
+            caps = [8192]
+            if round_size is not None:
+                caps.append(round_size)
+            if batch_entries is not None:
+                caps.append(max(1, batch_entries // P))
+            if max_entries is not None:
+                caps.append(_smem_key_cap(P, max_entries))
+            chunk_cap = max(1, _ladder_floor(min(caps)))
+        elif max_entries is None:
             chunk_cap = round_size
         else:
-            # SMEM-derived cap.  The kernel ships pa/pb with the LONGER axis
-            # in lanes (lane-padded to 128, sublanes to 8), so the per-array
-            # footprint is pad8(short) * max(long, 128) entries; solve for
-            # the key-chunk size under the max_entries budget.
-            pad8_p = -(-P // 8) * 8
-            if P <= 512:
-                cap = max_entries // pad8_p       # (P, K): P sublanes
-            else:
-                # (K, P): P rides the lanes and is padded to a 128 multiple
-                # by Mosaic -- budget against the padded footprint, not raw
-                # P, or the shipped arrays overshoot SMEM for non-128-multiple
-                # fanout classes
-                pad128_p = -(-P // 128) * 128
-                cap = max(max_entries // pad128_p, 1)
+            cap = _smem_key_cap(P, max_entries)
             chunk_cap = max(1, min(8192, _floor_pow2(cap)))
             chunk_cap = min(chunk_cap, max(round_size, 1))
-        for start in range(0, len(members), chunk_cap):
-            chunk = members[start : start + chunk_cap]
-            K = len(chunk)
-            # key-axis ladder is pow4 (4, 16, 64, 256, 1024, 4096): padded
-            # keys compute discarded zeros only on the one tail round per
-            # class, while the compiled-shape count -- the expensive resource
-            # on the slow-AOT TPU toolchain -- stays at <= 6 per fanout
-            # class.  The pair axis keeps the finer 3/4-pow2 ladder because
-            # its padding costs real work on every round.
-            K_pad = 4
-            while K_pad < K:
-                K_pad *= 4
-            K_pad = min(K_pad, chunk_cap)
-            pa = np.full((K_pad, P), a_sentinel, dtype=np.int32)
-            pb = np.full((K_pad, P), b_sentinel, dtype=np.int32)
-            # scatter each key's pair list into its row (vectorized over keys)
-            lens = fan[chunk]
-            rows, cols = _segment_expand(lens)
-            src = np.repeat(join.pair_ptr[chunk], lens) + cols
-            pa[rows, cols] = join.pair_a[src]
-            pb[rows, cols] = join.pair_b[src]
-            rounds.append(Round(key_index=chunk, pa=pa, pb=pb,
-                                max_fanout=int(lens.max())))
+        for members in parts:
+            for start in range(0, len(members), chunk_cap):
+                chunk = members[start : start + chunk_cap]
+                K = len(chunk)
+                if batch:
+                    K_pad = min(_shape_class(K), chunk_cap)
+                else:
+                    # key-axis ladder is pow4 (4, 16, 64, 256, 1024, 4096):
+                    # padded keys compute discarded zeros only on the one
+                    # tail round per class, while the compiled-shape count --
+                    # the expensive resource on the slow-AOT TPU toolchain --
+                    # stays at <= 6 per fanout class.  The pair axis keeps
+                    # the finer 3/4-pow2 ladder because its padding costs
+                    # real work on every round.
+                    K_pad = 4
+                    while K_pad < K:
+                        K_pad *= 4
+                    K_pad = min(K_pad, chunk_cap)
+                pa = np.full((K_pad, P), a_sentinel, dtype=np.int32)
+                pb = np.full((K_pad, P), b_sentinel, dtype=np.int32)
+                # scatter each key's pair list into its row (vectorized)
+                lens = fan[chunk]
+                rows, cols = _segment_expand(lens)
+                src = np.repeat(join.pair_ptr[chunk], lens) + cols
+                pa[rows, cols] = join.pair_a[src]
+                pb[rows, cols] = join.pair_b[src]
+                rounds.append(Round(key_index=chunk, pa=pa, pb=pb,
+                                    max_fanout=int(lens.max())))
     return rounds

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from spgemm_tpu.ops import u64
 from spgemm_tpu.ops.spgemm import pack_tiles
+from spgemm_tpu.utils import jaxcompat
 from spgemm_tpu.ops.symbolic import plan_rounds, symbolic_join
 from spgemm_tpu.parallel.mesh import default_mesh
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
@@ -106,7 +107,7 @@ def _make_sharded_fold(mesh: Mesh, small: bool = False):
         zero = jnp.zeros_like(part_h)
         return jax.lax.fori_loop(0, n_dev, body, (zero, zero))
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(jaxcompat.shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P(None, "inner"), P(None, "inner")),

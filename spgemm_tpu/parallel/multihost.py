@@ -61,13 +61,13 @@ def init_from_env() -> None:
     coord = os.environ.get("JAX_COORDINATOR")
     if not coord:
         return
-    import jax
+    from spgemm_tpu.utils import jaxcompat
 
     kwargs = {}
     hb = os.environ.get("SPGEMM_TPU_DCN_HEARTBEAT_S")
     if hb:
         kwargs["heartbeat_timeout_seconds"] = int(hb)
-    jax.distributed.initialize(
+    jaxcompat.distributed_initialize(
         coordinator_address=coord,
         num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
         process_id=int(os.environ["JAX_PROCESS_ID"]),

@@ -33,6 +33,7 @@ from spgemm_tpu.ops import u64
 from spgemm_tpu.ops.symbolic import JoinResult, symbolic_join
 from spgemm_tpu.parallel.innershard import fold_pairs_field
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils import jaxcompat
 
 
 def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
@@ -230,7 +231,7 @@ def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, rows, pa, pb, *, mesh,
         acc_h, acc_l = out[0][:k_max], out[1][:k_max]
         return acc_h[None], acc_l[None]
 
-    return jax.shard_map(
+    return jaxcompat.shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P(), P(), P("ring"), P("ring"), P("ring"), P("ring"),

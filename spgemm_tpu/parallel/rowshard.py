@@ -27,13 +27,14 @@ from jax.sharding import Mesh, PartitionSpec as P
 from spgemm_tpu.ops import u64
 from spgemm_tpu.ops.spgemm import numeric_round_impl, pack_tiles
 from spgemm_tpu.ops.symbolic import plan_rounds, symbolic_join
+from spgemm_tpu.utils import jaxcompat
 from spgemm_tpu.parallel.mesh import default_mesh
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 
 
 @partial(jax.jit, static_argnames=("mesh",))
 def _numeric_round_sharded(a_hi, a_lo, b_hi, b_lo, pa, pb, *, mesh: Mesh):
-    shard = jax.shard_map(
+    shard = jaxcompat.shard_map(
         numeric_round_impl,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P("keys"), P("keys")),

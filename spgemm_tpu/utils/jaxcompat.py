@@ -1,0 +1,60 @@
+"""Version-skew shims for the pinned jax_graft toolchain.
+
+The repo targets the newest jax API names; the baked-in toolchain may lag a
+release or two.  Every cross-version symbol is resolved HERE, once, so kernel
+and sharding modules never branch on jax versions themselves:
+
+  * ``shard_map``: ``jax.shard_map`` (new) vs
+    ``jax.experimental.shard_map.shard_map`` (<= 0.4.x), whose
+    ``check_vma`` kwarg was then spelled ``check_rep``.
+  * ``CompilerParams``: ``pallas.tpu.CompilerParams`` (new) vs the older
+    ``TPUCompilerParams`` spelling -- same fields.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # <= 0.4.x spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+def __getattr__(name):
+    # CompilerParams resolves LAZILY (PEP 562): consumers of the
+    # non-Pallas shims (multihost distributed init, shard_map) must not
+    # crash at import time on a toolchain whose pallas.tpu is itself
+    # missing or broken -- exactly the skew window this module exists for.
+    if name == "CompilerParams":
+        from jax.experimental.pallas import tpu as _pltpu
+
+        return getattr(_pltpu, "CompilerParams", None) \
+            or _pltpu.TPUCompilerParams
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def distributed_initialize(**kwargs):
+    """``jax.distributed.initialize`` minus the kwargs this jax predates
+    (``heartbeat_timeout_seconds`` postdates 0.4.x).  Dropping an
+    unsupported kwarg falls back to the runtime's default detection window
+    -- slower partner-loss detection, same correctness.
+
+    On a CPU backend, 0.4.x additionally needs the gloo collectives
+    implementation selected BEFORE backend init or every cross-process
+    collective dies with "Multiprocess computations aren't implemented on
+    the CPU backend" (newer jax defaults to gloo on CPU)."""
+    import inspect
+
+    try:
+        if jax.config.jax_platforms == "cpu":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:  # newer jax: option gone, gloo is the default
+        pass
+    params = inspect.signature(jax.distributed.initialize).parameters
+    jax.distributed.initialize(
+        **{k: v for k, v in kwargs.items() if k in params})

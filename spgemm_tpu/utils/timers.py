@@ -17,11 +17,15 @@ log = logging.getLogger("spgemm_tpu.timers")
 
 
 class PhaseTimers:
-    """Accumulates wall-clock per named phase (re-entrant by name)."""
+    """Accumulates wall-clock per named phase (re-entrant by name), plus
+    named event counters (dispatch/launch counts -- the round-batching
+    regression guard: wall time alone cannot distinguish one mega-launch
+    from fifty small ones on an async backend)."""
 
     def __init__(self):
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+        self.counters: dict[str, int] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -33,17 +37,32 @@ class PhaseTimers:
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
 
+    def incr(self, name: str, n: int = 1):
+        """Bump a named event counter (e.g. 'dispatches' per numeric launch).
+
+        Each counter name is written from a single thread (the OOC pipeline
+        threads each own their phase/counter names), so the GIL-atomic dict
+        update needs no lock."""
+        self.counters[name] = self.counters.get(name, 0) + n
+
     def log_report(self):
         for name in self.totals:
             log.info("phase %s: %.4fs (x%d)", name, self.totals[name], self.counts[name])
+        for name in self.counters:
+            log.info("counter %s: %d", name, self.counters[name])
 
     def reset(self):
         self.totals.clear()
         self.counts.clear()
+        self.counters.clear()
 
     def snapshot(self) -> dict[str, float]:
         """Rounded totals, for embedding in structured bench/CLI output."""
         return {name: round(t, 4) for name, t in self.totals.items()}
+
+    def counter_snapshot(self) -> dict[str, int]:
+        """Event counters, for embedding next to snapshot() in bench output."""
+        return dict(self.counters)
 
 
 # Global registry for the SpGEMM engine's internal phases (symbolic join /

@@ -23,9 +23,15 @@ def main():
 
     assert not xla_bridge._backends
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_procs, process_id=proc_id,
-                               heartbeat_timeout_seconds=5)
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    from spgemm_tpu.utils import jaxcompat
+
+    # version-skew shim: heartbeat_timeout_seconds postdates the pinned
+    # 0.4.x toolchain (partner-loss detection then uses the runtime default)
+    jaxcompat.distributed_initialize(coordinator_address=coordinator,
+                                     num_processes=num_procs,
+                                     process_id=proc_id,
+                                     heartbeat_timeout_seconds=5)
 
     if die and proc_id == num_procs - 1:
         # simulate host death at the DCN boundary: cluster formed, partial
@@ -33,7 +39,6 @@ def main():
         print(f"proc {proc_id} dying deliberately", flush=True)
         os._exit(17)
 
-    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
     import numpy as np
 
     from spgemm_tpu.parallel import multihost

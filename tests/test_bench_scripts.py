@@ -13,9 +13,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_bench_prints_one_json_line():
+    # pin the knob: the child inherits os.environ, and an operator's
+    # exported SPGEMM_TPU_ROUND_BATCH=0 A/B session must not flip the
+    # round_batch assertion below
     rc = _run(["bench.py", "--chain", "3", "--block-dim", "12",
                "--bandwidth", "1", "--k", "8", "--iters", "1",
-               "--device", "cpu"])
+               "--device", "cpu"], SPGEMM_TPU_ROUND_BATCH="1")
     assert rc.returncode == 0, rc.stderr[-2000:]
     lines = [ln for ln in rc.stdout.splitlines() if ln.startswith("{")]
     assert len(lines) == 1
@@ -24,6 +27,11 @@ def test_bench_prints_one_json_line():
     assert row["unit"] == "s" and row["value"] > 0
     # tiny config matches no published scale: must NOT claim a baseline
     assert row["vs_baseline"] is None
+    # launch-count observability (round-batched dispatch): the counter must
+    # ride along in detail so a silent de-batching regression is visible in
+    # every captured bench row
+    assert row["detail"]["dispatches"] > 0
+    assert row["detail"]["round_batch"] == 1
 
 
 def test_bench_single_chain_no_crash():

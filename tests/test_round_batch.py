@@ -1,0 +1,346 @@
+"""Round-batched dispatch: mega-launch planning, bit-exact parity vs the
+per-round path, and the launch-count regression guard.
+
+SPGEMM_TPU_ROUND_BATCH=1 (the default) merges each fanout class's keys into
+one launch and assembles through a precomputed inverse permutation; =0 is
+the legacy one-launch-per-round loop.  Both must produce identical bits on
+every backend -- the arithmetic is non-associative (SURVEY.md section 2.9),
+so these tests run adversarial values where any fold-order change shows.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.ops.spgemm import (_proof_fanout_cap, round_batch_enabled,
+                                   spgemm, spgemm_outofcore)
+from spgemm_tpu.ops.symbolic import (_shape_class, assembly_permutation,
+                                     plan_rounds, symbolic_join)
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import banded_block_sparse, random_block_sparse
+from spgemm_tpu.utils.semantics import spgemm_oracle
+from spgemm_tpu.utils.timers import ENGINE
+
+
+def _oracle(a, b):
+    return BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+
+
+def _is_ladder(x: int) -> bool:
+    """Member of the pow2 + 3/4-pow2 ladder {1, 2, 3, 4, 6, 8, 12, ...}."""
+    if x & (x - 1) == 0:
+        return True
+    return x % 3 == 0 and ((x // 3) & (x // 3 - 1)) == 0
+
+
+# ---------------------------------------------------------------- planner
+
+
+def test_plan_rounds_batch_one_round_per_class():
+    """Batched planning: each fanout class collapses to ONE mega-round
+    (ladder-padded key axis), covering every key exactly once."""
+    rng = np.random.default_rng(21)
+    a = banded_block_sparse(64, 2, 2, rng, "full")
+    join = symbolic_join(a.coords, a.coords)
+    base = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=a.nnzb,
+                       round_size=16)
+    batched = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=a.nnzb,
+                          round_size=None, batch=True)
+    classes = {r.pa.shape[1] for r in base}
+    assert len(batched) == len(classes) < len(base)
+    covered = np.concatenate([r.key_index for r in batched])
+    assert sorted(covered.tolist()) == list(range(join.num_keys))
+    for r in batched:
+        assert _is_ladder(r.pa.shape[0]) and _is_ladder(r.pa.shape[1])
+        # pair lists must match the join exactly, sentinel-padded tails
+        for row, ki in enumerate(r.key_index):
+            s, e = join.pair_ptr[ki], join.pair_ptr[ki + 1]
+            assert list(r.pa[row][: e - s]) == list(join.pair_a[s:e])
+            assert all(v == a.nnzb for v in r.pa[row][e - s:])
+
+
+def test_plan_rounds_batch_respects_entry_budget_and_round_size():
+    rng = np.random.default_rng(22)
+    a = banded_block_sparse(96, 2, 1, rng, "full")
+    join = symbolic_join(a.coords, a.coords)
+    # tiny entry budget: chunks of class P are capped at ~64 // P keys
+    small = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=a.nnzb,
+                        round_size=None, batch=True, batch_entries=64)
+    assert all(r.pa.shape[0] * r.pa.shape[1] <= 64 for r in small)
+    # an explicit round_size still caps the key axis in batch mode
+    capped = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=a.nnzb,
+                         round_size=8, batch=True)
+    assert all(r.pa.shape[0] <= 8 for r in capped)
+
+
+def test_plan_rounds_split_fanout_partitions_classes():
+    """split_fanout must partition a class's keys at the proof threshold:
+    rounds on each side carry max_fanout <=/> the split."""
+    # fanouts 5 and 6 share shape class 6; split at 5 must separate them
+    coords = [(0, j) for j in range(5)] + [(1, j) for j in range(6)]
+    a_coords = np.array(coords, np.int64)
+    b_coords = np.array([(j, 0) for j in range(6)], np.int64)
+    join = symbolic_join(a_coords, b_coords)
+    assert sorted(join.fanouts.tolist()) == [5, 6]
+    rounds = plan_rounds(join, a_sentinel=len(a_coords),
+                         b_sentinel=len(b_coords), round_size=None,
+                         batch=True, split_fanout=5)
+    assert len(rounds) == 2
+    assert sorted(r.max_fanout for r in rounds) == [5, 6]
+    assert all(r.pa.shape[1] == 6 for r in rounds)
+    # without the split, one mega-round carries both
+    merged = plan_rounds(join, a_sentinel=len(a_coords),
+                         b_sentinel=len(b_coords), round_size=None,
+                         batch=True)
+    assert len(merged) == 1 and merged[0].max_fanout == 6
+
+
+def test_assembly_permutation_maps_keys_and_sentinel():
+    rng = np.random.default_rng(23)
+    a = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    join = symbolic_join(a.coords, a.coords)
+    rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=a.nnzb,
+                         round_size=None, batch=True)
+    inv = assembly_permutation(rounds, join.num_keys)
+    total = sum(r.pa.shape[0] for r in rounds)
+    assert inv.shape == (join.num_keys + 1,)
+    assert inv[-1] == total  # sentinel slot -> appended zero row
+    # each key maps into its round's (offset + position) row, all distinct
+    assert len(set(inv[:-1].tolist())) == join.num_keys
+    off = 0
+    for r in rounds:
+        got = inv[r.key_index]
+        assert list(got) == list(off + np.arange(len(r.key_index)))
+        off += r.pa.shape[0]
+
+
+def test_proof_fanout_cap_matches_safe_exact_bound():
+    from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound
+
+    for a_b, b_b, k in [(1, 1, 4), ((1 << 30) - 3, (1 << 30) + 5, 4),
+                        ((1 << 32) - 1, (1 << 32) - 1, 32),
+                        ((1 << 20), (1 << 20), 8)]:
+        cap = _proof_fanout_cap(a_b, b_b, k)
+        if cap is None:
+            continue  # every fanout proves; nothing to check at a boundary
+        if cap >= 1:  # cap 0 = nothing proves (safe_exact_bound floors f at 1)
+            assert safe_exact_bound(a_b, b_b, cap, k) is not None
+        assert safe_exact_bound(a_b, b_b, cap + 1, k) is None
+
+
+# ------------------------------------------------------ engine bit parity
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas", "hybrid"])
+def test_batched_vs_per_round_bit_identical(backend, monkeypatch):
+    """The tentpole contract: ROUND_BATCH=1 and =0 produce the same bits on
+    every backend, on adversarial (fold-order-sensitive) values."""
+    rng = np.random.default_rng(31 + len(backend))
+    a = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    b = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "0")
+    legacy = spgemm(a, b, backend=backend)
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "1")
+    batched = spgemm(a, b, backend=backend)
+    assert batched == legacy == _oracle(a, b)
+
+
+def test_golden_fold_order_duplicate_heavy_classes(monkeypatch):
+    """Golden case: every output key shares ONE fanout class (duplicate-
+    heavy), values adversarial, so the whole multiply collapses into a
+    single mega-launch whose per-key fold order must still match the
+    reference exactly."""
+    k = 2
+    n = 24
+    # dense band: every interior key has the same fanout -> one fat class
+    a = banded_block_sparse(n, k, 2, np.random.default_rng(41), "adversarial")
+    b = banded_block_sparse(n, k, 2, np.random.default_rng(42), "adversarial")
+    join = symbolic_join(a.coords, b.coords)
+    classes, counts = np.unique(
+        [_shape_class(int(f)) for f in join.fanouts], return_counts=True)
+    assert counts.max() > n  # genuinely duplicate-heavy
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "1")
+    ENGINE.reset()
+    got = spgemm(a, b, backend="xla")
+    assert ENGINE.counter_snapshot()["dispatches"] == len(classes)
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "0")
+    legacy = spgemm(a, b, backend="xla")
+    assert got == legacy == _oracle(a, b)
+
+
+@pytest.mark.parametrize("depth", ["2", "3"])
+def test_outofcore_staging_worker_bit_identical(depth, monkeypatch):
+    """OOC depth >= 2 now stages on a worker thread (3-stage pipeline);
+    results must stay bit-identical to depth 1 and the oracle, and the
+    stage_prep phase must actually have run off the main dispatch span."""
+    rng = np.random.default_rng(51)
+    a = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    b = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    monkeypatch.setenv("SPGEMM_TPU_OOC_DEPTH", depth)
+    ENGINE.reset()
+    got = spgemm_outofcore(a, b, round_size=3)
+    assert "stage_prep" in ENGINE.snapshot()
+    assert ENGINE.counter_snapshot()["dispatches"] > 1
+    monkeypatch.setenv("SPGEMM_TPU_OOC_DEPTH", "1")
+    sync = spgemm_outofcore(a, b, round_size=3)
+    assert got == sync == _oracle(a, b)
+
+
+def test_outofcore_staging_worker_propagates_prep_errors(monkeypatch):
+    """A staging-thread failure must surface on the caller, not hang the
+    pipeline or leak workers."""
+    import spgemm_tpu.ops.spgemm as mod
+
+    rng = np.random.default_rng(52)
+    a = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    b = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    monkeypatch.setenv("SPGEMM_TPU_OOC_DEPTH", "2")
+    calls = []
+    orig = np.unique
+
+    def boom(*args, **kw):
+        calls.append(1)
+        if len(calls) > 4:
+            raise RuntimeError("staged failure")
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(mod.np, "unique", boom)
+    with pytest.raises(RuntimeError, match="staged failure"):
+        spgemm_outofcore(a, b, round_size=2)
+
+
+# ------------------------------------------------- launch-count regression
+
+
+def test_dispatch_count_scales_with_classes_not_keys(monkeypatch):
+    """The regression guard for silent de-batching: a multiply whose legacy
+    plan needs many rounds must dispatch <= #shape-classes x #kernel-choices
+    launches under ROUND_BATCH=1."""
+    rng = np.random.default_rng(61)
+    a = banded_block_sparse(700, 2, 1, rng, "full")
+    b = banded_block_sparse(700, 2, 1, rng, "full")
+    join = symbolic_join(a.coords, b.coords)
+    n_classes = len({_shape_class(int(f)) for f in join.fanouts})
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "1")
+    ENGINE.reset()
+    got = spgemm(a, b, backend="xla")
+    batched_dispatches = ENGINE.counter_snapshot()["dispatches"]
+    assert batched_dispatches <= n_classes * 1  # one kernel choice (xla)
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "0")
+    ENGINE.reset()
+    legacy = spgemm(a, b, backend="xla")
+    legacy_dispatches = ENGINE.counter_snapshot()["dispatches"]
+    assert legacy_dispatches > batched_dispatches  # the A/B genuinely differs
+    assert got == legacy
+
+
+def test_hybrid_dispatch_count_bounded_by_partitions(monkeypatch, caplog):
+    """Hybrid + batching: <= 2 launches per class (proven/unproven
+    partition), and the structured log still reports the split."""
+    import re
+
+    rng = np.random.default_rng(62)
+    a = random_block_sparse(8, 8, 4, 0.6, rng, "small")
+    b = random_block_sparse(8, 8, 4, 0.6, rng, "small")
+    join = symbolic_join(a.coords, b.coords)
+    n_classes = len({_shape_class(int(f)) for f in join.fanouts})
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "1")
+    ENGINE.reset()
+    with caplog.at_level(logging.INFO, logger="spgemm_tpu.spgemm"):
+        got = spgemm(a, b, backend="hybrid")
+    assert got == _oracle(a, b)
+    assert ENGINE.counter_snapshot()["dispatches"] <= n_classes * 2
+    assert re.search(r"hybrid mxu=(\d+)/(\d+)", caplog.text)
+
+
+# -------------------------------------------------------- knob validation
+
+
+def test_round_batch_env_validation(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "yes")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_ROUND_BATCH"):
+        round_batch_enabled()
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "0")
+    assert round_batch_enabled() is False
+    monkeypatch.delenv("SPGEMM_TPU_ROUND_BATCH")
+    assert round_batch_enabled() is True
+
+
+def test_vpu_knob_validation_rejects_broken_tpu_combos():
+    """VERDICT round-5 "What's weak" #2: the advertised knobs crash on TPU
+    hardware with a bare JaxRuntimeError -- the engine must reject them at
+    entry with the knob named."""
+    from spgemm_tpu.ops.pallas_spgemm import validate_vpu_config
+
+    # fine everywhere
+    validate_vpu_config("colbcast", 1, platform="tpu")
+    # fine in interpret mode (parity tests run these)
+    validate_vpu_config("vecj", 4, platform="cpu", interpret=True)
+    validate_vpu_config("vecj", 2, platform="tpu", interpret=True)
+    with pytest.raises(ValueError, match="SPGEMM_TPU_VPU_ALGO"):
+        validate_vpu_config("vecj", 1, platform="tpu")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_VPU_PB"):
+        validate_vpu_config("colbcast", 4, platform="tpu")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_VPU_ALGO"):
+        validate_vpu_config("nope", 1, platform="cpu", interpret=True)
+    with pytest.raises(ValueError, match="SPGEMM_TPU_VPU_PB"):
+        validate_vpu_config("colbcast", 0, platform="cpu", interpret=True)
+
+
+def test_engine_rejects_bad_vpu_env(monkeypatch):
+    """_select_numeric must validate the env knobs before any kernel call."""
+    rng = np.random.default_rng(63)
+    a = random_block_sparse(4, 4, 2, 0.5, rng, "full")
+    b = random_block_sparse(4, 4, 2, 0.5, rng, "full")
+    monkeypatch.setenv("SPGEMM_TPU_VPU_ALGO", "bogus")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_VPU_ALGO"):
+        spgemm(a, b, backend="pallas")
+    monkeypatch.delenv("SPGEMM_TPU_VPU_ALGO")
+    monkeypatch.setenv("SPGEMM_TPU_VPU_PB", "zero")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_VPU_PB"):
+        spgemm(a, b, backend="pallas")
+    monkeypatch.setenv("SPGEMM_TPU_VPU_PB", "0")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_VPU_PB"):
+        spgemm(a, b, backend="pallas")
+
+
+# -------------------------------------------- stacked (R, K, P) kernel API
+
+
+def test_kernels_accept_stacked_round_axis():
+    """Every numeric kernel accepts a stacked (R, K, P) batch and returns
+    per-round slices bit-identical to separate calls."""
+    import jax.numpy as jnp
+
+    from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu
+    from spgemm_tpu.ops.pallas_spgemm import numeric_round_pallas
+    from spgemm_tpu.ops.spgemm import numeric_round_impl, pack_tiles
+
+    rng = np.random.default_rng(71)
+    m = random_block_sparse(6, 6, 2, 0.8, rng, "adversarial")
+    hi, lo = pack_tiles(m)
+    pa = rng.integers(0, m.nnzb + 1, size=(3, 4, 2)).astype(np.int32)
+    pb = rng.integers(0, m.nnzb + 1, size=(3, 4, 2)).astype(np.int32)
+    kernels = [
+        lambda *args: numeric_round_impl(*args),
+        lambda *args: numeric_round_pallas(*args, interpret=True),
+    ]
+    for fn in kernels:
+        sh, sl = fn(hi, lo, hi, lo, jnp.asarray(pa), jnp.asarray(pb))
+        assert sh.shape == (3, 4, 2, 2)
+        for r in range(3):
+            oh, ol = fn(hi, lo, hi, lo, jnp.asarray(pa[r]), jnp.asarray(pb[r]))
+            assert (np.asarray(sh[r]) == np.asarray(oh)).all()
+            assert (np.asarray(sl[r]) == np.asarray(ol)).all()
+    # field-mode kernel: same check, small values so residues are plain sums
+    m2 = random_block_sparse(6, 6, 2, 0.8, rng, "small")
+    hi2, lo2 = pack_tiles(m2)
+    sh, sl = numeric_round_mxu(hi2, lo2, hi2, lo2,
+                               jnp.asarray(pa), jnp.asarray(pb))
+    for r in range(3):
+        oh, ol = numeric_round_mxu(hi2, lo2, hi2, lo2,
+                                   jnp.asarray(pa[r]), jnp.asarray(pb[r]))
+        assert (np.asarray(sh[r]) == np.asarray(oh)).all()
+        assert (np.asarray(sl[r]) == np.asarray(ol)).all()
