@@ -71,6 +71,34 @@ def _shrink_to_cpu(args, reason: str) -> None:
     args.cpu_fallback = reason
 
 
+def _watch_log_saw_chip(window_s: float = 3600.0) -> bool:
+    """Did benchmarks/tpu_watch.sh see the chip alive recently?
+
+    The watcher's ledger (benchmarks/watch.log) records every probe and
+    capture; a fresh entry whose NEWEST probe answered means the chip was
+    alive within the window, so a probe timeout NOW is likelier a transient
+    tunnel blip than the hours-long hang mode -- worth one more retry before
+    forfeiting the driver-captured TPU headline to the CPU fallback
+    (round-5 VERDICT weak #7).  Only the text after the LAST '[watch ...]
+    probing' marker counts: the watcher appends failures every few minutes
+    with a fresh mtime, so an hours-old 'tpu ok' higher up the tail must
+    not read as 'recently alive'."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "watch.log")
+    try:
+        if time.time() - os.stat(path).st_mtime > window_s:
+            return False
+        with open(path, errors="replace") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - 8192))
+            tail = f.read()
+    except OSError:
+        return False
+    last_probe = tail.rsplit("] probing", 1)[-1]
+    return ("tpu ok" in last_probe or "evidence captured" in last_probe
+            or "partial evidence" in last_probe)
+
+
 def _init_platform(args) -> str:
     """Fail-soft backend init (round-2 VERDICT #3).
 
@@ -89,22 +117,30 @@ def _init_platform(args) -> str:
     if args.device:
         pin(args.device)
     else:
+        # retry window: the observed hang mode persists for hours (round-3
+        # notes), so timeouts normally get ONE retry (more just burns the
+        # driver's budget 150 s at a time) -- unless the watch.log ledger
+        # says the chip was alive within the hour, which makes a timeout
+        # look transient and buys a third backed-off attempt
+        chip_was_up = _watch_log_saw_chip()
+        max_timeouts = 3 if chip_was_up else 2
+        if chip_was_up:
+            print("watch.log saw the chip recently; widening the probe "
+                  "retry window", file=sys.stderr)
         outcome = None
         timeouts = 0
-        for attempt in range(3):
+        attempts = max_timeouts + 1
+        for attempt in range(attempts):
             outcome = probe_default_backend()
             if outcome in ("ok", "cpu"):
                 break  # 'cpu' is deterministic -- retrying cannot change it
             print(f"backend probe attempt {attempt + 1}: {outcome}",
                   file=sys.stderr)
             if outcome == "timeout":
-                # the observed hang mode persists for hours (round-3 notes):
-                # one retry covers a racy tunnel re-attach, more just burns
-                # the driver's budget 150 s at a time
                 timeouts += 1
-                if timeouts >= 2:
+                if timeouts >= max_timeouts:
                     break
-            if attempt < 2:
+            if attempt < attempts - 1:
                 time.sleep(5 * (attempt + 1))
         if outcome != "ok":
             print(f"no accelerator (probe: {outcome}); falling back to cpu",

@@ -310,10 +310,14 @@ def _webbase_config(config_name, dist, strategy, backend_label, n_dev=4):
     flops = 2.0 * int(join.pair_ptr[-1]) * a.k ** 3
     devices = jax.devices()[:n_dev]
 
+    from spgemm_tpu.utils.timers import ENGINE
+
     strategy(a, b, devices)  # warm/compile
+    ENGINE.reset()
     t0 = time.perf_counter()
     got = strategy(a, b, devices)
     wall = time.perf_counter() - t0
+    phases = ENGINE.snapshot()  # ring_plan/ring_hop/ring_fold for the ring row
     want = BlockSparseMatrix.from_dict(
         a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
     return {"config": config_name, "backend": f"{backend_label} x{n_dev}",
@@ -321,6 +325,7 @@ def _webbase_config(config_name, dist, strategy, backend_label, n_dev=4):
             "nnzb_a": a.nnzb, "nnzb_b": b.nnzb, "out_keys": join.num_keys,
             "tile_pairs": int(join.pair_ptr[-1]), "wall_s": round(wall, 4),
             "effective_gflops": round(flops / wall / 1e9, 2),
+            **({"phases_s": phases} if phases else {}),
             "nnz_parity": bool(got.nnz == want.nnz),
             "value_parity": bool(got == want)}
 
@@ -489,19 +494,37 @@ def write_table(rows, path=None):
             rows.append(extra)
     if path is None:
         path = os.path.join(REPO, "benchmarks", "RESULTS.md")
+    # ring-vs-rowshard ratio column: the overlap layer's standing regression
+    # guard (round 7) -- ring is the only operand-exceeds-HBM multi-chip
+    # path, so its distance from the rowshard strategy on the same webbase
+    # structure must stay visible in RESULTS.md (target <= ~2.0x).  Same
+    # metric as ROUND5's standing 2.9x: ring rides bounded 'small' values
+    # (b32 field MAC) vs rowshard's full-width exact fold, so this tracks
+    # the end-to-end strategy gap, not equal-arithmetic kernel overhead.
+    # Only rows from the same capture (same platform) are comparable -- an
+    # extras-merged TPU row must not divide by a CPU-host core-suite row.
+    rowshard_row = next((r for r in rows
+                         if r.get("config") == "webbase-1M"), None)
     lines = ["# Benchmark suite results (BASELINE.json configs, synthesized)",
              "",
-             "Regenerate: `python benchmarks/run.py --write-table`", "",
-             "| config | backend | platform | wall s | eff. GFLOP/s | parity |",
-             "|---|---|---|---|---|---|"]
+             "Regenerate: `python benchmarks/run.py --write-table`",
+             "",
+             "Wall-clock rows are from whatever host ran the capture (each "
+             "row's `platform` names the backend, not the host speed): "
+             "compare across regenerations only on the same host -- the "
+             "round's `benchmarks/ROUND*_NOTES.md` records the capture "
+             "context.",
+             "",
+             "| config | backend | platform | wall s | eff. GFLOP/s | vs rowshard | parity |",
+             "|---|---|---|---|---|---|---|"]
     for r in rows:
         if "error" in r:
             err = r["error"][:60].replace("|", "\\|")
-            lines.append(f"| {r['config']} | — | — | — | — | ERROR: {err} |")
+            lines.append(f"| {r['config']} | — | — | — | — | — | ERROR: {err} |")
             continue
         if "skipped" in r:
             note = r["skipped"][:60].replace("|", "\\|")
-            lines.append(f"| {r['config']} | — | — | — | — | skipped: {note} |")
+            lines.append(f"| {r['config']} | — | — | — | — | — | skipped: {note} |")
             continue
         par = ""
         if "value_parity" in r:
@@ -520,14 +543,41 @@ def write_table(rows, path=None):
         gf = r.get("effective_gflops", r.get("sparse_tflops"))
         if "sparse_tflops" in r:
             gf = f"{r['sparse_tflops']} TF/s"
+        ratio = ""
+        if (r.get("config") == "webbase-ring" and rowshard_row
+                and rowshard_row.get("wall_s") and r.get("wall_s")
+                and r.get("platform") == rowshard_row.get("platform")):
+            ratio = (f"{r['wall_s'] / rowshard_row['wall_s']:.2f}x "
+                     "(target <=2.0x)")
         lines.append(f"| {r['config']} | {r['backend']} | {r['platform']} | "
-                     f"{r['wall_s']} | {gf or ''} | {par} |")
+                     f"{r['wall_s']} | {gf or ''} | {ratio} | {par} |")
     sweep = _sweep_section()
+    if not sweep:
+        # no sweep capture on disk (the evidence dir's sweep.txt is
+        # transient): PRESERVE the previous table's kernel-variants section
+        # instead of silently dropping hard-won on-chip evidence -- a
+        # CPU-host suite regeneration must never destroy the TPU sweep
+        sweep = _existing_sweep_section(path)
     if sweep:
         lines += [""] + sweep
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
     return path
+
+
+def _existing_sweep_section(path):
+    """The '## Kernel variants' section of the table being overwritten, if
+    any (kept verbatim when the current capture has no sweep of its own)."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return []
+    marker = "## Kernel variants"
+    if marker not in text:
+        return []
+    section = text[text.index(marker):].rstrip("\n")
+    return section.split("\n")
 
 
 def _sweep_section():

@@ -57,11 +57,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard over the visible device mesh: 'keys' = output-"
                         "tile sharding per multiply (bit-exact), 'inner' = "
                         "contraction sharding + ICI all-reduce, 'ring' = rotate "
-                        "B around the ring, O(1/n) operand memory ('inner'/"
-                        "'ring' use clean mod-(2^64-1) arithmetic, see "
-                        "parallel/), 'chain' = one chain rank per device "
-                        "executing concurrently (bit-exact, the reference's "
-                        "MPI data parallelism at P = n_devices)")
+                        "B around the ring, O(1/n) operand memory, hop "
+                        "double-buffered behind the fold "
+                        "(SPGEMM_TPU_RING_OVERLAP=0 serializes it, bit-"
+                        "identical) ('inner'/'ring' use clean mod-(2^64-1) "
+                        "arithmetic, see parallel/), 'chain' = one chain rank "
+                        "per device executing concurrently (bit-exact, the "
+                        "reference's MPI data parallelism at P = n_devices)")
     p.add_argument("--stream", action="store_true",
                    help="host-resident chain partials: each multiply uploads "
                         "its two operands, computes on device, and fetches "
@@ -93,7 +95,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--distributed", action="store_true",
                    help="multi-host mode: partition the chain across JAX "
                         "processes (set JAX_COORDINATOR/JAX_NUM_PROCESSES/"
-                        "JAX_PROCESS_ID per host; replaces `mpirun -np P`)")
+                        "JAX_PROCESS_ID per host; replaces `mpirun -np P`). "
+                        "Partial products exchange over DCN in bounded "
+                        "chunks of SPGEMM_TPU_DCN_CHUNK_MB (default 64) per "
+                        "rank; 0 = legacy padded all-gather")
     p.add_argument("--verbose", "-v", action="store_true")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace to DIR")

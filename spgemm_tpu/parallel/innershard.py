@@ -68,6 +68,11 @@ def fold_pairs_field(a_hi, a_lo, b_hi, b_lo, pa, pb, *, small: bool = False):
         return acc_h, acc_l
 
     zero = jnp.zeros((K, k, k), jnp.uint32)
+    if Pn == 1:
+        # rank-compacted callers (parallel/ring) fold one pair per cell per
+        # pass: inline the single iteration instead of paying a one-trip
+        # while loop per (step, rank)
+        return body(0, (zero, zero))
     return jax.lax.fori_loop(0, Pn, body, (zero, zero))
 
 

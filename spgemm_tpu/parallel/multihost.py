@@ -7,10 +7,19 @@ cu:460-556, an O(P) bottleneck).  The JAX-native multi-host story:
   * each *process* (host) owns the same chain slice arithmetic as an MPI rank
     (parallel/chainpart.partition_chain -- bit-for-bit the reference's N/P
     split) and reduces its sub-chain locally;
-  * partial products are exchanged with one padded all-gather over DCN
-    (jax.experimental.multihost_utils) -- O(log P) collective, not a serial
-    gather, and every host then runs the identical combine tree, so the
-    result is replicated and any host can write it (no rank-0 hot spot);
+  * partial products are exchanged over DCN in fixed-size CHUNKS
+    (jax.experimental.multihost_utils all-gathers, O(log P) each -- not a
+    serial gather): every rank's partial ships `SPGEMM_TPU_DCN_CHUNK_MB`
+    (default 64 MiB) at a time, so the transient exchange buffer is bounded
+    at O(P x chunk) regardless of how skewed the partials are -- the padded
+    all-gather it replaces materialized O(P x max_nnzb) on every host, a
+    host-RAM cliff the reference's chunked point-to-point sends
+    (sparse_matrix_mult.cu:467-506) never had.  The bound is logged before
+    the first collective; a chunk budget too small for even one tile raises
+    immediately (never a silent mid-exchange OOM), and `=0` keeps the legacy
+    padded path behind a loud warning for A/B runs.  Every host then runs
+    the identical combine tree, so the result is replicated and any host can
+    write it (no rank-0 hot spot);
   * within each host, the per-multiply numeric phase can additionally shard
     over local devices (rowshard/innershard/ring).
 
@@ -75,27 +84,157 @@ def init_from_env() -> None:
     )
 
 
+DEFAULT_DCN_CHUNK_MB = 64.0
+
+
+def _dcn_chunk_mb() -> float:
+    """SPGEMM_TPU_DCN_CHUNK_MB: per-rank chunk budget (MiB, float) for the
+    partial-product exchange; 0 selects the legacy padded all-gather
+    (guard-railed -- its peak is logged loudly because it is unbounded in
+    max_nnzb)."""
+    raw = os.environ.get("SPGEMM_TPU_DCN_CHUNK_MB", "").strip()
+    if not raw:
+        return DEFAULT_DCN_CHUNK_MB
+    try:
+        mb = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"SPGEMM_TPU_DCN_CHUNK_MB must be a number (MiB), got {raw!r}"
+        ) from None
+    if mb < 0:
+        raise ValueError(
+            f"SPGEMM_TPU_DCN_CHUNK_MB must be >= 0 (0 = legacy padded "
+            f"exchange), got {raw!r}")
+    return mb
+
+
 def _allgather_partials(partial: BlockSparseMatrix | None, k: int):
-    """Exchange per-process partial products (variable nnzb) via two padded
-    all-gathers: metadata first, then coord/tile slabs padded to the max."""
+    """Exchange per-process partial products (variable nnzb) over DCN with a
+    BOUNDED transient footprint: metadata all-gather first, then the
+    coord+tile payload ships in fixed-size chunks of at most
+    `SPGEMM_TPU_DCN_CHUNK_MB` per rank, one packed uint32 buffer per chunk
+    round (coords as 2 int32 words + the hi/lo tile planes -- uint64 is not
+    a DCN-friendly dtype everywhere).  Peak transient memory is
+    P x chunk_tiles x tile_bytes no matter how skewed the partials are; the
+    accumulated result only ever holds each rank's REAL tiles (the padded
+    path also materialized every rank at max_nnzb).  The computed bound is
+    logged before the first payload collective; a budget that cannot fit
+    even one tile raises a ValueError naming the knob."""
     import jax
     from jax.experimental import multihost_utils
 
-    p = jax.process_count()
-    meta_local = np.array(
-        [partial.rows, partial.cols, partial.nnzb] if partial is not None
-        else [-1, -1, -1], dtype=np.int64)
-    metas = np.asarray(multihost_utils.process_allgather(meta_local))  # (P, 3)
-    max_nnzb = max(1, int(metas[:, 2].max()))
+    from spgemm_tpu.ops import u64 as u64mod
+    from spgemm_tpu.utils.timers import ENGINE
 
+    p = jax.process_count()
+    chunk_mb = _dcn_chunk_mb()  # validate the knob before any collective
+    # the chunk budget rides in the metadata gather (as exact bytes): every
+    # rank must agree on the chunk ROUND COUNT or the collectives deadlock,
+    # so a per-host env skew must surface as a config error, not as a hang
+    # the heartbeat later mislabels partner loss
+    budget_bytes = int(chunk_mb * (1 << 20))
+    meta_local = np.array(
+        ([partial.rows, partial.cols, partial.nnzb] if partial is not None
+         else [-1, -1, -1]) + [budget_bytes], dtype=np.int64)
+    with ENGINE.phase("dcn_exchange"):
+        metas = np.asarray(multihost_utils.process_allgather(meta_local))
+        budgets = metas[:, 3]
+        if not np.all(budgets == budget_bytes):
+            raise ValueError(
+                "SPGEMM_TPU_DCN_CHUNK_MB differs across hosts (budgets in "
+                f"bytes, by rank: {budgets.tolist()}): every host must set "
+                "the same chunk budget -- the exchange round count is "
+                "derived from it")
+        max_nnzb = max(1, int(metas[:, 2].max()))
+        tile_words = 2 + 2 * k * k  # int32 coord pair + hi/lo u32 planes
+        tile_bytes = 4 * tile_words
+        if chunk_mb == 0:
+            return _allgather_partials_padded(partial, k, metas, max_nnzb,
+                                              tile_bytes)
+        budget = chunk_mb * (1 << 20)
+        if budget < tile_bytes:
+            raise ValueError(
+                f"SPGEMM_TPU_DCN_CHUNK_MB={chunk_mb:g} cannot fit even one "
+                f"k={k} tile ({tile_bytes} B including coords): raise the "
+                f"chunk budget to at least {tile_bytes / (1 << 20):.4f} MiB")
+        chunk_tiles = min(max_nnzb, int(budget // tile_bytes))
+        n_chunks = -(-max_nnzb // chunk_tiles)
+        peak = p * chunk_tiles * tile_bytes
+        # the memory guard's ledger line: logged BEFORE the first payload
+        # collective so an exchange that dies mid-flight still shows what
+        # it was about to allocate
+        log.info(
+            "dcn exchange: %d ranks, max partial %d tiles -> %d chunk "
+            "rounds of <=%d tiles; peak exchange buffer %.3f MiB "
+            "(bound: P x SPGEMM_TPU_DCN_CHUNK_MB = %.3f MiB)",
+            p, max_nnzb, n_chunks, chunk_tiles, peak / (1 << 20),
+            p * chunk_mb)
+        nnzb_local = int(partial.nnzb) if partial is not None else 0
+        pieces: list[list[np.ndarray]] = [[] for _ in range(p)]
+        for c in range(n_chunks):
+            lo = c * chunk_tiles
+            width = min(chunk_tiles, max_nnzb - lo)
+            buf = np.zeros((width, tile_words), dtype=np.uint32)
+            n_here = min(max(nnzb_local - lo, 0), width)
+            if n_here:
+                sl = slice(lo, lo + n_here)
+                buf[:n_here, :2] = (
+                    partial.coords[sl].astype(np.int32).view(np.uint32))
+                t_hi, t_lo = u64mod.u64_to_hilo(partial.tiles[sl])
+                buf[:n_here, 2: 2 + k * k] = t_hi.reshape(n_here, -1)
+                buf[:n_here, 2 + k * k:] = t_lo.reshape(n_here, -1)
+            got = np.asarray(multihost_utils.process_allgather(buf))
+            ENGINE.incr("dcn_chunks")
+            for r in range(p):
+                n_r = min(max(int(metas[r, 2]) - lo, 0), width)
+                if n_r:  # keep only rank r's REAL tiles from this round --
+                    # COPIED, so the (P, width) gather buffer dies with the
+                    # round instead of being pinned by slice views until the
+                    # final concatenate (which would retain O(P x max_nnzb),
+                    # the exact cliff this path removes)
+                    pieces[r].append(got[r, :n_r].copy())
+            del got
+    partials = []
+    for r in range(p):
+        rows, cols, nnzb = (int(v) for v in metas[r, :3])
+        if rows < 0:
+            continue  # idle rank (N < P degenerate branch)
+        if nnzb:
+            flat = np.concatenate(pieces[r], axis=0)
+            coords = flat[:, :2].view(np.int32).astype(np.int64)
+            tiles = u64mod.hilo_to_u64(
+                flat[:, 2: 2 + k * k].reshape(nnzb, k, k),
+                flat[:, 2 + k * k:].reshape(nnzb, k, k))
+            partials.append(BlockSparseMatrix(rows=rows, cols=cols, k=k,
+                                              coords=coords, tiles=tiles))
+        else:
+            partials.append(BlockSparseMatrix(rows=rows, cols=cols, k=k))
+    return partials
+
+
+def _allgather_partials_padded(partial, k, metas, max_nnzb, tile_bytes):
+    """The legacy padded exchange (pre-round-7), kept ONLY behind
+    SPGEMM_TPU_DCN_CHUNK_MB=0 for A/B runs: every rank pads to max_nnzb and
+    all-gathers to all hosts -- O(P x max_nnzb) transient host RAM, the
+    skewed-chain cliff the chunked path exists to remove."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    from spgemm_tpu.ops import u64 as u64mod
+
+    p = jax.process_count()
+    log.warning(
+        "dcn exchange: LEGACY PADDED path (SPGEMM_TPU_DCN_CHUNK_MB=0): peak "
+        "exchange buffer %.3f MiB = P(%d) x max_nnzb(%d) x %d B -- unbounded "
+        "in the largest partial; unset the knob for the chunked bounded "
+        "exchange", p * max_nnzb * tile_bytes / (1 << 20), p, max_nnzb,
+        tile_bytes)
     coords = np.full((max_nnzb, 2), -1, dtype=np.int64)
     tiles = np.zeros((max_nnzb, k, k), dtype=np.uint64)
     if partial is not None and partial.nnzb:
         coords[: partial.nnzb] = partial.coords
         tiles[: partial.nnzb] = partial.tiles
     # uint64 is not a DCN-friendly dtype everywhere; ship as two uint32 planes
-    from spgemm_tpu.ops import u64 as u64mod
-
     t_hi, t_lo = u64mod.u64_to_hilo(tiles)
     all_coords = np.asarray(multihost_utils.process_allgather(coords))
     all_hi = np.asarray(multihost_utils.process_allgather(t_hi))
@@ -103,7 +242,7 @@ def _allgather_partials(partial: BlockSparseMatrix | None, k: int):
 
     partials = []
     for r in range(p):
-        rows, cols, nnzb = (int(v) for v in metas[r])
+        rows, cols, nnzb = (int(v) for v in metas[r, :3])
         if rows < 0:
             continue  # idle rank (N < P degenerate branch)
         partials.append(BlockSparseMatrix(

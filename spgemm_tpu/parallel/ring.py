@@ -8,6 +8,15 @@ into n chunks that rotate one hop per step via `jax.lax.ppermute` over ICI.
 After n steps every device has seen all of B while only ever holding 1/n of
 it -- this is what lets a `webbase-1M`-scale operand exceed single-chip HBM.
 
+Communication/compute overlap (round 7): the step body is double-buffered --
+the `ppermute` for slab t+1 is issued into a second buffer BEFORE the fold
+over slab t, so XLA's async collectives can put the ICI hop behind the MAC
+work instead of serializing hop-after-fold (the structure ring attention and
+the distributed-SpGEMM literature -- Deveci et al. 1801.03065, Nagasaka et
+al. 1804.01698 -- both use).  `SPGEMM_TPU_RING_OVERLAP=0|1` (default 1)
+selects the legacy fold-then-hop body for A/B runs; the two are bit-identical
+because each slab's fold order is unchanged, only the hop issue point moves.
+
 Arithmetic: field mode (clean mod-(2^64-1), ops/u64.py) -- the rotation
 schedule visits each key's pairs grouped by B-slab, not in the reference's
 j-ascending order, so only an associative reduction is correct here.  Use
@@ -22,6 +31,8 @@ device-to-device over ICI, nothing touches the host.
 
 from __future__ import annotations
 
+import os
+import time
 from functools import partial
 
 import jax
@@ -34,28 +45,63 @@ from spgemm_tpu.ops.symbolic import JoinResult, symbolic_join
 from spgemm_tpu.parallel.innershard import fold_pairs_field
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 from spgemm_tpu.utils import jaxcompat
+from spgemm_tpu.utils.timers import ENGINE
+
+
+def overlap_enabled() -> bool:
+    """SPGEMM_TPU_RING_OVERLAP=0|1 (default 1): double-buffer the rotation so
+    the hop for slab t+1 is in flight while slab t folds.  Bit-identical
+    either way (the fold order never changes); 0 keeps the legacy serialized
+    fold-then-hop body for A/B measurement."""
+    raw = os.environ.get("SPGEMM_TPU_RING_OVERLAP", "1").strip()
+    if raw not in ("0", "1"):
+        raise ValueError(
+            f"SPGEMM_TPU_RING_OVERLAP must be 0 or 1, got {raw!r}")
+    return raw == "1"
+
+
+# rank lists are UNROLLED in the fold's step body (one fold+scatter per
+# rank), so their count must stay O(1): cells deeper than this many pairs
+# spill their remainder into ONE dense (cell, pair) tail block folded with a
+# bounded-size loop -- an adversarial key with thousands of same-slab pairs
+# costs tail padding, never an unbounded XLA graph
+RANK_UNROLL_MAX = 8
 
 
 def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
-    """Host-side schedule: key chunks per device, COMPACTED pair lists per
-    (device, slab) cell.
+    """Host-side schedule: key chunks per device, RANK-COMPACTED pair lists
+    per (device, slab) step.
 
-    Only (key, slab) cells that actually hold pairs occupy a row -- a
-    power-law structure concentrates each key's pairs in 1-2 slabs, and the
-    old dense (device, slab, local_key, pair) layout padded every key into
-    every slab (round-4 measurement: 10.8x padded vs real work on the
-    webbase config; rowshard's fanout-bucketed rounds pad 1.1x).  The fold
-    scatter-adds each step's compacted rows into the device accumulator.
+    Pairs land in (key, slab) cells (slab = which contiguous B chunk owns the
+    pair's B tile).  A power-law structure makes almost every cell hold ONE
+    pair (webbase config: 2812 of 2885 occupied cells), so a dense per-cell
+    pair axis pads nearly everything: the old (cell, p_max) layout carried
+    4.2x the real MAC work on that config.  Instead the schedule is sliced by
+    pair RANK: list r holds the r-th pair of every cell.  Within one rank each
+    cell appears at most once, so rows are unique and the fold can scatter-add
+    straight into the device accumulator -- and the padded MAC count collapses
+    to sum_r max_over_(dev,slab)(cells with >= r+1 pairs) ~= 1.1-1.5x real.
+    (Field-mode addition is an abelian group op, so folding a cell's pairs as
+    r scatter-adds instead of one pre-reduced tile is bit-identical.)
 
-    Returns (key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max):
+    Cells deeper than RANK_UNROLL_MAX pairs spill ranks >= RANK_UNROLL_MAX
+    into the dense TAIL block (the old (cell, p_max) layout restricted to
+    deep cells): rank lists bound the unrolled graph, the tail bounds the
+    pathological depth.
+
+    Returns (key_chunks, slab_bounds, ranks, tail, s_max, k_max):
       key_chunks  : list of n index arrays into join.keys (device d's keys)
       slab_bounds : (n+1,) B tile-slab boundaries (contiguous equal splits)
-      row_idx     : (n, n, C_max) int32 -- local ACC row of each compacted
-                    cell [device, slab, cell]; padding rows point at the
-                    dummy accumulator row == k_max
-      pa_all      : (n, n, C_max, P_max) int32 A-slab indices (sentinel -1)
-      pb_all      : (n, n, C_max, P_max) int32 *within-slab* B indices
-                    (sentinel == s_max, the slab zero tile)
+      ranks       : list over pair rank r < RANK_UNROLL_MAX of
+                    (row_idx, pa, pb), each (n, n, C_r) int32
+                    [device, slab, compacted cell]:
+                    row_idx = local ACC row (padding rows point at the dummy
+                    accumulator row == k_max), pa = A-slab indices (sentinel
+                    -1), pb = *within-slab* B indices (sentinel == s_max,
+                    the slab zero tile)
+      tail        : None, or (row_idx, pa, pb) with pa/pb (n, n, C_t, P_t)
+                    holding every deep cell's pairs at ranks >=
+                    RANK_UNROLL_MAX (same sentinels; rows unique per step)
       s_max       : max slab size
       k_max       : max local key count == the dummy accumulator row baked
                     into row_idx (single-sourced here; the fold's
@@ -94,34 +140,69 @@ def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
         uc = np.zeros(0, np.int64)
         uc_first = uc_counts = np.zeros(0, np.int64)
     p_max = max(1, int(uc_counts.max())) if uc.size else 1
-    # position of each sorted pair within its cell = rank - cell start
+    # rank of each sorted pair within its cell = position - cell start
     ci_of_pair = np.repeat(np.arange(len(uc), dtype=np.int64), uc_counts)
     pos = np.arange(cell.size, dtype=np.int64) - uc_first[ci_of_pair]
 
-    # group compacted cells by (device, slab)
+    # cell -> (device, slab, local acc row)
     cell_key = uc // n_dev
     cell_slab = (uc % n_dev).astype(np.int64)
     cell_dev = np.searchsorted(key_bounds, cell_key, side="right") - 1
     cell_local = (cell_key - key_bounds[cell_dev]).astype(np.int32)
     grp = cell_dev * n_dev + cell_slab
-    grp_counts = np.bincount(grp, minlength=n_dev * n_dev)
-    c_max = max(1, int(grp_counts.max())) if uc.size else 1
-    grp_order = np.argsort(grp, kind="stable")
-    grp_offsets = np.concatenate(([0], np.cumsum(grp_counts)))
-    pos_in_grp = np.empty(len(uc), np.int64)
-    pos_in_grp[grp_order] = (np.arange(len(uc), dtype=np.int64)
-                             - grp_offsets[grp[grp_order]])
 
-    row_idx = np.full((n_dev, n_dev, c_max), k_max, dtype=np.int32)  # dummy
-    row_idx[cell_dev, cell_slab, pos_in_grp] = cell_local
-    pa_all = np.full((n_dev, n_dev, c_max, p_max), -1, dtype=np.int32)
-    pb_all = np.full((n_dev, n_dev, c_max, p_max), s_max, dtype=np.int32)
-    pa_all[cell_dev[ci_of_pair], cell_slab[ci_of_pair],
-           pos_in_grp[ci_of_pair], pos] = join.pair_a[order]
-    pb_all[cell_dev[ci_of_pair], cell_slab[ci_of_pair],
-           pos_in_grp[ci_of_pair], pos] = (
-        join.pair_b[order] - slab_bounds[cell_slab[ci_of_pair]])
-    return key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max
+    pair_a_sorted = np.asarray(join.pair_a)[order]
+    pair_b_sorted = np.asarray(join.pair_b)[order]
+
+    def grp_slots(ci):
+        """Compact a set of cells (indices into uc) within their (device,
+        slab) groups: returns (slot of each cell in its group, max group
+        size)."""
+        grp_c = grp[ci]
+        counts = np.bincount(grp_c, minlength=n_dev * n_dev)
+        c_max = max(1, int(counts.max())) if ci.size else 1
+        order_c = np.argsort(grp_c, kind="stable")
+        offsets = np.concatenate(([0], np.cumsum(counts)))
+        slots = np.empty(len(ci), np.int64)
+        slots[order_c] = (np.arange(len(ci), dtype=np.int64)
+                          - offsets[grp_c[order_c]])
+        return slots, c_max
+
+    ranks = []
+    for r in range(min(p_max, RANK_UNROLL_MAX)):
+        sel = pos == r            # at most one pair per cell at each rank
+        ci = ci_of_pair[sel]
+        slots, c_r = grp_slots(ci)
+        row_idx = np.full((n_dev, n_dev, c_r), k_max, dtype=np.int32)  # dummy
+        pa = np.full((n_dev, n_dev, c_r), -1, dtype=np.int32)
+        pb = np.full((n_dev, n_dev, c_r), s_max, dtype=np.int32)
+        d_i, s_i = cell_dev[ci], cell_slab[ci]
+        row_idx[d_i, s_i, slots] = cell_local[ci]
+        pa[d_i, s_i, slots] = pair_a_sorted[sel]
+        pb[d_i, s_i, slots] = pair_b_sorted[sel] - slab_bounds[s_i]
+        ranks.append((row_idx, pa, pb))
+
+    tail = None
+    if p_max > RANK_UNROLL_MAX:
+        ci_deep = np.flatnonzero(uc_counts > RANK_UNROLL_MAX)
+        slots_deep, c_t = grp_slots(ci_deep)
+        p_t = p_max - RANK_UNROLL_MAX
+        row_idx = np.full((n_dev, n_dev, c_t), k_max, dtype=np.int32)
+        pa = np.full((n_dev, n_dev, c_t, p_t), -1, dtype=np.int32)
+        pb = np.full((n_dev, n_dev, c_t, p_t), s_max, dtype=np.int32)
+        d_i, s_i = cell_dev[ci_deep], cell_slab[ci_deep]
+        row_idx[d_i, s_i, slots_deep] = cell_local[ci_deep]
+        slot_of_cell = np.full(len(uc), -1, np.int64)
+        slot_of_cell[ci_deep] = slots_deep
+        selp = pos >= RANK_UNROLL_MAX     # the deep cells' spilled pairs
+        cip = ci_of_pair[selp]
+        pa[cell_dev[cip], cell_slab[cip], slot_of_cell[cip],
+           pos[selp] - RANK_UNROLL_MAX] = pair_a_sorted[selp]
+        pb[cell_dev[cip], cell_slab[cip], slot_of_cell[cip],
+           pos[selp] - RANK_UNROLL_MAX] = (
+            pair_b_sorted[selp] - slab_bounds[cell_slab[cip]])
+        tail = (row_idx, pa, pb)
+    return key_chunks, slab_bounds, ranks, tail, s_max, k_max
 
 
 def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
@@ -130,6 +211,7 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     if a.k != b.k:
         raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
     k = a.k
+    overlap = overlap_enabled()  # validate the knob before any work
     if mesh is None:
         from spgemm_tpu.parallel.mesh import default_mesh
         mesh = default_mesh(axis="ring")
@@ -147,9 +229,14 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     small = u64.operands_below_2_32(a, b)
     a_hi, a_lo = pack_tiles(a)  # replicated; sentinel zero tile at a.nnzb
 
-    key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max = \
-        plan_ring(join, b.nnzb, n_dev)
-    pa_all = np.where(pa_all < 0, a.nnzb, pa_all)  # A sentinel -> zero tile
+    with ENGINE.phase("ring_plan"):
+        key_chunks, slab_bounds, ranks, tail, s_max, k_max = \
+            plan_ring(join, b.nnzb, n_dev)
+    # A sentinel -> zero tile (rank lists and the deep-cell tail alike)
+    ranks = [(rows, np.where(pa < 0, a.nnzb, pa), pb)
+             for rows, pa, pb in ranks]
+    if tail is not None:
+        tail = (tail[0], np.where(tail[1] < 0, a.nnzb, tail[1]), tail[2])
 
     # per-device B slab buffers: (n, s_max + 1, k, k), zero tile at s_max
     bh_np, bl_np = u64.u64_to_hilo(b.tiles)
@@ -165,15 +252,47 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
             lo, hi = slab_bounds[s], slab_bounds[s + 1]
             b_slab_h[s, : hi - lo] = bh_np[lo:hi]
 
-    fold = _make_ring_fold(mesh, n_dev, small, k_max)
     shard0 = NamedSharding(mesh, P("ring"))
-    oh, ol = fold(
-        a_hi, a_lo,
-        jax.device_put(b_slab_h, shard0), jax.device_put(b_slab_l, shard0),
-        jax.device_put(jnp.asarray(row_idx), shard0),
-        jax.device_put(jnp.asarray(pa_all), shard0),
-        jax.device_put(jnp.asarray(pb_all), shard0),
-    )
+    bsh = jax.device_put(b_slab_h, shard0)
+    bsl = jax.device_put(b_slab_l, shard0)
+    trips = ranks + ([tail] if tail is not None else [])
+    rank_args = [jax.device_put(jnp.asarray(x), shard0)
+                 for trip in trips for x in trip]
+
+    # one-hop wire probe: the measured cost of rotating the resident B slab
+    # a single hop -- exactly the latency the double-buffered body hides
+    # behind the fold.  Timed on its own (output discarded) because the real
+    # hops overlap the MACs and are invisible to host wall-clock.  Measured
+    # ONCE per (mesh, slab shape, width) per process -- later calls
+    # re-record the cached figure, so every ENGINE snapshot carries the hop
+    # number without paying an extra hop (or its compile) inside each timed
+    # multiply.
+    # SPGEMM_TPU_RING_HOP_PROBE=0 skips the probe entirely (saves its one
+    # compiled shape + two hops per process per slab shape -- e.g. a
+    # one-shot CLI run that never reads the phase registry)
+    probe_on = os.environ.get("SPGEMM_TPU_RING_HOP_PROBE", "1") != "0"
+    probe_key = (mesh, n_dev, small, bsl.shape, bsh.shape)
+    hop_s = _HOP_PROBE_CACHE.get(probe_key) if probe_on else None
+    if probe_on and hop_s is None:
+        # first execution pays jit trace + compile, which would swamp the
+        # wire time by orders of magnitude -- compile un-timed, then time a
+        # second execution
+        jax.block_until_ready(_ring_hop_jit(bsh, bsl, mesh=mesh, n_dev=n_dev,
+                                            small=small))
+        t0 = time.perf_counter()
+        jax.block_until_ready(_ring_hop_jit(bsh, bsl, mesh=mesh, n_dev=n_dev,
+                                            small=small))
+        hop_s = time.perf_counter() - t0
+        _HOP_PROBE_CACHE[probe_key] = hop_s
+    if hop_s is not None:
+        ENGINE.record("ring_hop", hop_s)
+
+    fold = _make_ring_fold(mesh, n_dev, small, k_max, len(ranks),
+                           tail is not None, overlap)
+    with ENGINE.phase("ring_fold"):
+        oh, ol = fold(a_hi, a_lo, bsh, bsl, *rank_args)
+        jax.block_until_ready((oh, ol))
+    ENGINE.incr("ring_steps", n_dev)
     vals = u64.hilo_to_u64(np.asarray(oh), np.asarray(ol))  # (n, K_max, k, k)
 
     out = np.zeros((join.num_keys, k, k), dtype=np.uint64)
@@ -183,64 +302,110 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
                              coords=join.keys, tiles=out)
 
 
-@partial(jax.jit, static_argnames=("mesh", "n_dev", "small", "k_max"))
-def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, rows, pa, pb, *, mesh,
-                   n_dev, small, k_max):
-    def per_device(a_hi, a_lo, bh, bl, rows, pa, pb):
-        # local shapes: bl (1, s_max+1, k, k), rows (1, n_slab, C),
-        # pa (1, n_slab, C, P) -- C is the COMPACTED cell axis (plan_ring):
-        # each step folds only the (key, slab) cells that hold pairs and
-        # scatter-adds them into the device accumulator; row k_max is the
-        # padding dummy.  small mode: bh is a (1,1,1,1) dummy, never in the
-        # carry, never rotated -- the b32 route's ICI/HBM saving is
-        # structural, not DCE.
+# one-hop wire measurements, keyed by (mesh, n_dev, small, slab shapes);
+# first spgemm_ring call per shape pays the probe, the rest replay it
+_HOP_PROBE_CACHE: dict = {}
+
+
+@partial(jax.jit, static_argnames=("mesh", "n_dev", "small"))
+def _ring_hop_jit(b_slab_h, b_slab_l, *, mesh, n_dev, small):
+    """One rotation hop of the resident B slab(s) -- the wire-time probe."""
+    def per_device(bh, bl):
+        rot_perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        bl = jax.lax.ppermute(bl, "ring", rot_perm)
+        if not small:
+            bh = jax.lax.ppermute(bh, "ring", rot_perm)
+        return bh, bl
+
+    return jaxcompat.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P("ring"), P("ring")),
+        out_specs=(P("ring"), P("ring")),
+        check_vma=False,
+    )(b_slab_h, b_slab_l)
+
+
+@partial(jax.jit, static_argnames=("mesh", "n_dev", "small", "k_max",
+                                   "n_ranks", "has_tail", "overlap"))
+def _ring_fold_jit(a_hi, a_lo, b_slab_h, b_slab_l, *rank_args, mesh,
+                   n_dev, small, k_max, n_ranks, has_tail, overlap):
+    def per_device(a_hi, a_lo, bh, bl, *rank_args):
+        # local shapes: bl (1, s_max+1, k, k); per rank r: rows (1, n_slab,
+        # C_r), pa/pb (1, n_slab, C_r) -- C_r is the RANK-COMPACTED cell axis
+        # (plan_ring): each step folds, per rank, only the cells that hold an
+        # r-th pair and scatter-adds them into the device accumulator; row
+        # k_max is the padding dummy.  has_tail appends one dense (cell,
+        # pair) trip for cells deeper than RANK_UNROLL_MAX.  small mode: bh
+        # is a (1,1,1,1) dummy, never rotated -- the b32 route's ICI/HBM
+        # saving is structural, not DCE (it rides the carry untouched).
         d = jax.lax.axis_index("ring")
         k = a_lo.shape[-1]
         rot_perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        trips = [rank_args[3 * r: 3 * r + 3]
+                 for r in range(n_ranks + int(has_tail))]
+
+        def rotate(bh, bl):
+            bl = jax.lax.ppermute(bl, "ring", rot_perm)
+            if not small:
+                bh = jax.lax.ppermute(bh, "ring", rot_perm)
+            return bh, bl
+
+        def fold_slab(acc_h, acc_l, bh, bl, s):
+            for rows, pa, pb in trips:
+                rows_s = rows[0, s]      # (C,) -- dynamic slab index
+                pa_s = pa[0, s]          # rank lists are (C,); tail (C, P_t)
+                pb_s = pb[0, s]
+                if pa_s.ndim == 1:
+                    pa_s, pb_s = pa_s[:, None], pb_s[:, None]
+                if small:  # hi args unread by the b32 fold: lo stand-ins
+                    ph, pl = fold_pairs_field(a_lo, a_lo, bl[0], bl[0],
+                                              pa_s, pb_s, small=True)
+                else:
+                    ph, pl = fold_pairs_field(a_hi, a_lo, bh[0], bl[0],
+                                              pa_s, pb_s)
+                # scatter-add the compacted cells into their acc rows; rows
+                # are unique within one trip (at most one r-th pair per
+                # cell; one tail slot per deep cell) except the dummy row,
+                # whose value is never read
+                nh, nl = u64.addmod_field(acc_h[rows_s], acc_l[rows_s],
+                                          ph, pl)
+                acc_h = acc_h.at[rows_s].set(nh)
+                acc_l = acc_l.at[rows_s].set(nl)
+            return acc_h, acc_l
 
         def step(t, carry):
-            if small:
-                acc_h, acc_l, bl = carry
-            else:
-                acc_h, acc_l, bh, bl = carry
+            acc_h, acc_l, bh, bl = carry
             s = (d - t) % n_dev  # slab currently resident on this device
-            rows_s = rows[0, s]  # (C,) -- dynamic index over the slab axis
-            pa_s = pa[0, s]      # (C, P)
-            pb_s = pb[0, s]
-            if small:  # hi args unread by the b32 fold: pass lo stand-ins
-                ph, pl = fold_pairs_field(a_lo, a_lo, bl[0], bl[0],
-                                          pa_s, pb_s, small=True)
-            else:
-                ph, pl = fold_pairs_field(a_hi, a_lo, bh[0], bl[0],
-                                          pa_s, pb_s)
-            # scatter-add the compacted cells into their acc rows; rows are
-            # unique within a step (one cell per key per slab) except the
-            # dummy row, whose value is never read
-            nh, nl = u64.addmod_field(acc_h[rows_s], acc_l[rows_s], ph, pl)
-            acc_h = acc_h.at[rows_s].set(nh)
-            acc_l = acc_l.at[rows_s].set(nl)
-            bl = jax.lax.ppermute(bl, "ring", rot_perm)  # rotate B one hop
-            if small:
-                return acc_h, acc_l, bl
-            bh = jax.lax.ppermute(bh, "ring", rot_perm)
-            return acc_h, acc_l, bh, bl
+            if overlap:
+                # double buffer: issue the hop for slab t+1 FIRST -- the
+                # fold below reads only the t-resident buffers, so the wire
+                # transfer and the MAC work have no data dependence and XLA
+                # may run them concurrently (async collective start/done)
+                bh_next, bl_next = rotate(bh, bl)
+                acc_h, acc_l = fold_slab(acc_h, acc_l, bh, bl, s)
+                return acc_h, acc_l, bh_next, bl_next
+            # legacy serialized body: fold, then hop
+            acc_h, acc_l = fold_slab(acc_h, acc_l, bh, bl, s)
+            bh_next, bl_next = rotate(bh, bl)
+            return acc_h, acc_l, bh_next, bl_next
 
         zero = jnp.zeros((k_max + 1, k, k), jnp.uint32)  # + dummy row
-        carry0 = (zero, zero, bl) if small else (zero, zero, bh, bl)
-        out = jax.lax.fori_loop(0, n_dev, step, carry0)
+        out = jax.lax.fori_loop(0, n_dev, step, (zero, zero, bh, bl))
         acc_h, acc_l = out[0][:k_max], out[1][:k_max]
         return acc_h[None], acc_l[None]
 
     return jaxcompat.shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(), P(), P("ring"), P("ring"), P("ring"), P("ring"),
-                  P("ring")),
+        in_specs=(P(), P()) + (P("ring"),) * (2 + 3 * (n_ranks + int(has_tail))),
         out_specs=(P("ring"), P("ring")),
         check_vma=False,
-    )(a_hi, a_lo, b_slab_h, b_slab_l, rows, pa, pb)
+    )(a_hi, a_lo, b_slab_h, b_slab_l, *rank_args)
 
 
-def _make_ring_fold(mesh: Mesh, n_dev: int, small: bool, k_max: int):
+def _make_ring_fold(mesh: Mesh, n_dev: int, small: bool, k_max: int,
+                    n_ranks: int, has_tail: bool, overlap: bool):
     return partial(_ring_fold_jit, mesh=mesh, n_dev=n_dev, small=small,
-                   k_max=k_max)
+                   k_max=k_max, n_ranks=n_ranks, has_tail=has_tail,
+                   overlap=overlap)

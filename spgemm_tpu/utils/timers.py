@@ -37,6 +37,14 @@ class PhaseTimers:
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
 
+    def record(self, name: str, seconds: float):
+        """Accumulate an externally measured duration under a phase name --
+        for spans whose endpoints the caller must place itself (e.g. the ring
+        layer's one-hop wire probe, timed around its own completion barrier
+        rather than a `with` block)."""
+        self.totals[name] = self.totals.get(name, 0.0) + seconds
+        self.counts[name] = self.counts.get(name, 0) + 1
+
     def incr(self, name: str, n: int = 1):
         """Bump a named event counter (e.g. 'dispatches' per numeric launch).
 

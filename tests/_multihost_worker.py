@@ -1,13 +1,19 @@
 """Subprocess worker for the multi-process DCN tests (tests/test_multihost.py).
 
-Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <dir> <n_mats> [die]
+Usage: python _multihost_worker.py <coordinator> <num_procs> <proc_id> <dir> <n_mats> [die|exchange]
 Builds a deterministic chain, partitions it by process, runs the multi-host
 reduction, and (process 0) writes the result matrix file into <dir>/out.
 With the optional 'die' flag, the LAST process exits hard right before the
 DCN exchange -- the partner-loss fault injection for
 test_partner_loss_fails_fast (survivors must fail fast, never hang).
+With 'exchange', each rank builds a SKEWED synthetic partial directly (rank 0
+holds <n_mats> tiles, every other rank 7) and runs only the partial-product
+exchange -- the chunked-vs-padded A/B harness for
+test_skewed_partials_chunked_exchange (process 0 dumps every gathered partial
+to <dir>/exchange_out.npz; SPGEMM_TPU_DCN_CHUNK_MB comes in via the env).
 """
 
+import logging
 import os
 import sys
 
@@ -16,7 +22,8 @@ def main():
     coordinator, num_procs, proc_id, workdir, n_mats = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
         int(sys.argv[5]))
-    die = len(sys.argv) > 6 and sys.argv[6] == "die"
+    mode = sys.argv[6] if len(sys.argv) > 6 else ""
+    die = mode == "die"
 
     import jax
     from jax._src import xla_bridge
@@ -44,6 +51,37 @@ def main():
     from spgemm_tpu.parallel import multihost
     from spgemm_tpu.utils import io_text
     from spgemm_tpu.utils.gen import random_chain
+
+    if mode == "exchange":
+        # surface multihost's dcn-exchange ledger line on stdout: the test
+        # asserts the logged peak bound against the knob
+        logging.basicConfig(level=logging.INFO, stream=sys.stdout,
+                            format="%(name)s %(message)s")
+        from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+        k = 4
+        side = 64
+        nnzb = n_mats if proc_id == 0 else 7  # one rank dwarfs the others
+        rng = np.random.default_rng(1000 + proc_id)
+        idx = rng.choice(side * side, size=nnzb, replace=False)
+        idx.sort()
+        coords = np.stack(np.divmod(idx, side), axis=1).astype(np.int64)
+        tiles = rng.integers(0, 1 << 64, size=(nnzb, k, k), dtype=np.uint64)
+        partial = BlockSparseMatrix(rows=side, cols=side, k=k,
+                                    coords=coords, tiles=tiles)
+        # chunked exchange first (SPGEMM_TPU_DCN_CHUNK_MB from the test's
+        # env), then the legacy padded path in the SAME session -- one
+        # cluster bring-up, two exchange flavors to A/B
+        chunked = multihost._allgather_partials(partial, k)
+        os.environ["SPGEMM_TPU_DCN_CHUNK_MB"] = "0"
+        padded = multihost._allgather_partials(partial, k)
+        if proc_id == 0:
+            for name, parts in (("chunked", chunked), ("padded", padded)):
+                np.savez(os.path.join(workdir, f"exchange_{name}.npz"),
+                         **{f"coords{i}": p.coords for i, p in enumerate(parts)},
+                         **{f"tiles{i}": p.tiles for i, p in enumerate(parts)})
+        print(f"proc {proc_id} done", flush=True)
+        return
 
     k = 2
     mats = random_chain(n_mats, 4, k, 0.5, np.random.default_rng(777), "full")

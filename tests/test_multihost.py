@@ -67,6 +67,70 @@ def test_multi_process_chain(tmp_path, num_procs, n_mats):
     assert got == want
 
 
+def test_skewed_partials_chunked_exchange(tmp_path):
+    """Skewed partials (rank 0's is ~86x rank 1's) through the chunked DCN
+    exchange with a chunk budget SMALLER than the big partial: the combined
+    result must be byte-identical to the legacy padded path, and the logged
+    peak-exchange buffer must respect P x SPGEMM_TPU_DCN_CHUNK_MB -- the
+    bounded-memory contract the padded path (O(P x max_nnzb)) never had.
+    Two real JAX processes run ONLY the partial exchange, both flavors in
+    one session (rank 0: 600 tiles, rank 1: 7)."""
+    import re
+
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
+    # k=4 tile = 2 coord words + 32 plane words = 136 B; 0.01 MiB holds 77
+    # tiles, so the 600-tile partial needs 8 chunk rounds
+    env = {**os.environ, "SPGEMM_TPU_DCN_CHUNK_MB": "0.01"}
+    env.pop("JAX_PLATFORMS", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coord, "2", str(r),
+             str(tmp_path), "600", "exchange"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+        for r in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            outs.append(out.decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("exchange workers timed out")
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+
+    # the memory-guard ledger line, logged BEFORE the first payload
+    # collective, must respect the advertised P x chunk bound
+    out = outs[0]
+    ledger = re.search(
+        r"dcn exchange: (\d+) ranks, max partial (\d+) tiles -> (\d+) chunk "
+        r"rounds of <=(\d+) tiles; peak exchange buffer ([\d.]+) MiB "
+        r"\(bound: P x SPGEMM_TPU_DCN_CHUNK_MB = ([\d.]+) MiB\)", out)
+    assert ledger, f"missing exchange ledger line in:\n{out[-2000:]}"
+    p, max_nnzb, n_chunks, chunk_tiles, peak_mb, bound_mb = ledger.groups()
+    assert int(max_nnzb) == 600
+    assert int(chunk_tiles) < 600, "chunk budget must be below the big partial"
+    assert int(n_chunks) > 1, "skew must force a multi-round exchange"
+    assert float(peak_mb) <= float(bound_mb), \
+        "logged peak exceeds the advertised P x chunk bound"
+    assert float(bound_mb) == float(p) * 0.01
+    # the guard-railed legacy path announces itself loudly
+    assert "LEGACY PADDED" in out
+
+    # A/B: both flavors must combine to the exact same per-rank partials
+    chunked = dict(np.load(tmp_path / "exchange_chunked.npz"))
+    padded = dict(np.load(tmp_path / "exchange_padded.npz"))
+    assert sorted(chunked) == sorted(padded)
+    assert len(chunked) == 4  # coords+tiles for each of the 2 ranks
+    for name in chunked:
+        assert np.array_equal(chunked[name], padded[name]), name
+
+
 def test_partner_loss_fails_fast(tmp_path):
     """Fault injection for the DCN failure contract (multihost.py docstring):
     worker P-1 dies hard right before the partial-product exchange.  The

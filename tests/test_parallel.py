@@ -225,57 +225,83 @@ def test_ring_fewer_keys_than_devices():
 
 
 def test_plan_ring_packing_matches_naive_oracle():
-    """Pin the vectorized COMPACTED planner cell by cell: for every
-    (device, slab), the occupied rows must cover exactly the device's keys
-    that have pairs in that slab -- each holding that cell's pairs in their
-    original order, sentinel-padded on the pair axis -- and nothing else
-    (the dense layout's all-keys-in-every-slab padding is the round-4
-    10.8x waste this planner removed)."""
+    """Pin the vectorized RANK-COMPACTED planner cell by cell: rank list r
+    must hold, for every (device, slab), exactly the device's keys with
+    >= r+1 pairs in that slab -- each carrying that cell's r-th pair in the
+    original j-ascending order -- with unique acc rows per rank, sentinel
+    padding elsewhere, and nothing else (the dense (cell, p_max) pair axis
+    was the round-6 4.2x padded-MAC waste this layout removed).  Pairs
+    beyond RANK_UNROLL_MAX must land in the dense TAIL block, in order."""
     from spgemm_tpu.ops.symbolic import JoinResult
-    from spgemm_tpu.parallel.ring import plan_ring
+    from spgemm_tpu.parallel.ring import RANK_UNROLL_MAX, plan_ring
 
     rng = np.random.default_rng(363)
     n_keys, nnzb_b, n_dev = 37, 53, 8
     fanouts = rng.integers(0, 7, size=n_keys)
-    fanouts[fanouts.argmax()] += 5  # one fat key to force p_max
+    fat = int(fanouts.argmax())
+    fanouts[fat] = RANK_UNROLL_MAX + 4  # deep key: must spill into the tail
     pair_ptr = np.concatenate(([0], np.cumsum(fanouts))).astype(np.int64)
     total = int(pair_ptr[-1])
     side = 7
     keys = np.stack(np.divmod(np.arange(n_keys, dtype=np.int64), side), axis=1)
     pair_a = rng.integers(0, nnzb_b, size=total).astype(np.int32)
     pair_b = rng.integers(0, nnzb_b, size=total).astype(np.int32)
+    # concentrate the fat key's pairs in slab 0's B range so ONE cell is
+    # deeper than the rank-unroll cap
+    pair_b[pair_ptr[fat]: pair_ptr[fat + 1]] = \
+        rng.integers(0, nnzb_b // n_dev, size=fanouts[fat]).astype(np.int32)
     join = JoinResult(keys=keys, pair_ptr=pair_ptr,
                       pair_a=pair_a, pair_b=pair_b)
 
-    key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max = \
+    key_chunks, slab_bounds, ranks, tail, s_max, k_max = \
         plan_ring(join, nnzb_b, n_dev)
     assert k_max == max(len(c) for c in key_chunks)
+    assert len(ranks) <= RANK_UNROLL_MAX and tail is not None
     slab_of_pair = np.searchsorted(slab_bounds, pair_b, side="right") - 1
+    max_fanout_per_cell = 0
     for d, chunk in enumerate(key_chunks):
         for s in range(n_dev):
-            # cells present in this (device, slab): map acc row -> cell slot
-            occupied = {int(r): slot for slot, r in enumerate(row_idx[d, s])
-                        if r != k_max}
-            assert len(occupied) == np.sum(row_idx[d, s] != k_max), \
-                "duplicate acc row within one (device, slab) step"
             for row, ki in enumerate(chunk):
                 lo, hi = pair_ptr[ki], pair_ptr[ki + 1]
                 sel = slab_of_pair[lo:hi] == s
-                want_a = pair_a[lo:hi][sel]
+                want_a = pair_a[lo:hi][sel]  # original j-ascending order
                 want_b = pair_b[lo:hi][sel] - slab_bounds[s]
-                if not len(want_a):
-                    assert row not in occupied, "empty cell occupies a row"
-                    continue
-                slot = occupied.pop(row)
-                got_a = pa_all[d, s, slot]
-                got_b = pb_all[d, s, slot]
-                assert np.array_equal(got_a[: len(want_a)], want_a)
-                assert np.array_equal(got_b[: len(want_b)], want_b)
-                assert np.all(got_a[len(want_a):] == -1)
-                assert np.all(got_b[len(want_b):] == s_max)
-            assert not occupied, "planner emitted cells for foreign keys"
-    # padding sentinels on unoccupied cell rows
-    assert np.all(pa_all[row_idx == k_max] == -1)
+                max_fanout_per_cell = max(max_fanout_per_cell, len(want_a))
+                for r in range(len(ranks)):
+                    row_idx, pa_r, pb_r = ranks[r]
+                    slots = np.flatnonzero(row_idx[d, s] == row)
+                    if r < len(want_a):  # cell owes its r-th pair to rank r
+                        assert len(slots) == 1, \
+                            "acc row must appear exactly once per rank"
+                        assert pa_r[d, s, slots[0]] == want_a[r]
+                        assert pb_r[d, s, slots[0]] == want_b[r]
+                    else:
+                        assert len(slots) == 0, \
+                            "rank list holds a cell with no rank-r pair"
+                # pairs past the unroll cap: the cell's tail slot holds
+                # them contiguously, in order, sentinel-padded
+                row_t, pa_t, pb_t = tail
+                slots = np.flatnonzero(row_t[d, s] == row)
+                spill_a = want_a[RANK_UNROLL_MAX:]
+                spill_b = want_b[RANK_UNROLL_MAX:]
+                if len(spill_a):
+                    assert len(slots) == 1, "deep cell missing a tail slot"
+                    got_a, got_b = pa_t[d, s, slots[0]], pb_t[d, s, slots[0]]
+                    assert np.array_equal(got_a[: len(spill_a)], spill_a)
+                    assert np.array_equal(got_b[: len(spill_b)], spill_b)
+                    assert np.all(got_a[len(spill_a):] == -1)
+                    assert np.all(got_b[len(spill_b):] == s_max)
+                else:
+                    assert len(slots) == 0, "shallow cell occupies the tail"
+    # the schedule depth is exactly the deepest cell
+    assert max_fanout_per_cell > RANK_UNROLL_MAX
+    assert tail[1].shape[-1] == max_fanout_per_cell - RANK_UNROLL_MAX
+    # padding sentinels on all dummy rows, in every rank and the tail
+    for row_idx, pa_r, pb_r in ranks:
+        assert np.all(pa_r[row_idx == k_max] == -1)
+        assert np.all(pb_r[row_idx == k_max] == s_max)
+    row_t, pa_t, pb_t = tail
+    assert np.all(pa_t[row_t == k_max] == -1)
 
 
 def test_chain_product_on_devices_matches_partitioned():

@@ -128,22 +128,38 @@ def test_plan_ring_covers_join_exactly(ab, n_dev):
     join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:
         return
-    key_chunks, slab_bounds, row_idx, pa_all, pb_all, s_max, k_max = \
+    key_chunks, slab_bounds, ranks, tail, s_max, k_max = \
         plan_ring(join, b.nnzb, n_dev)
     seen = []
-    for d, chunk in enumerate(key_chunks):
-        for s in range(n_dev):
-            for slot, row in enumerate(row_idx[d, s]):
-                if row == k_max:  # padding cell: must hold only sentinels
-                    assert np.all(pa_all[d, s, slot] == -1)
-                    continue
-                ki = chunk[row]  # compacted cell -> this device's key
-                for pa_v, pb_v in zip(pa_all[d, s, slot], pb_all[d, s, slot]):
-                    if pa_v < 0:
+    for row_idx, pa_all, pb_all in ranks:
+        for d, chunk in enumerate(key_chunks):
+            for s in range(n_dev):
+                for slot, row in enumerate(row_idx[d, s]):
+                    if row == k_max:  # padding cell: only sentinels
+                        assert pa_all[d, s, slot] == -1
                         continue
+                    ki = chunk[row]  # compacted cell -> this device's key
+                    pa_v, pb_v = pa_all[d, s, slot], pb_all[d, s, slot]
+                    assert pa_v >= 0, "occupied row holds a sentinel pair"
                     gb = pb_v + slab_bounds[s]
                     assert slab_bounds[s] <= gb < slab_bounds[s + 1]
                     seen.append((int(ki), int(pa_v), int(gb)))
+    if tail is not None:  # deep cells' spilled pairs count too
+        row_idx, pa_all, pb_all = tail
+        for d, chunk in enumerate(key_chunks):
+            for s in range(n_dev):
+                for slot, row in enumerate(row_idx[d, s]):
+                    if row == k_max:
+                        assert np.all(pa_all[d, s, slot] == -1)
+                        continue
+                    ki = chunk[row]
+                    for pa_v, pb_v in zip(pa_all[d, s, slot],
+                                          pb_all[d, s, slot]):
+                        if pa_v < 0:
+                            continue
+                        gb = pb_v + slab_bounds[s]
+                        assert slab_bounds[s] <= gb < slab_bounds[s + 1]
+                        seen.append((int(ki), int(pa_v), int(gb)))
     want = []
     for ki in range(join.num_keys):
         lo, hi = join.pair_ptr[ki], join.pair_ptr[ki + 1]

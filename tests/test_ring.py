@@ -1,0 +1,119 @@
+"""Ring overlap knob (SPGEMM_TPU_RING_OVERLAP) on the 8-virtual-device mesh.
+
+The double-buffered step body (hop for slab t+1 issued before the fold over
+slab t) must be BIT-IDENTICAL to the legacy fold-then-hop body: the knob only
+moves the ppermute issue point, never the fold order.  These tests pin that
+contract -- the regression guard for the round-7 comm/compute overlap layer
+(tests/test_parallel.py covers ring-vs-oracle correctness; this file covers
+the A/B knob itself plus its observability side channel).
+"""
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.parallel.ring import overlap_enabled, spgemm_ring
+from spgemm_tpu.utils.gen import powerlaw_block_sparse, random_block_sparse
+from spgemm_tpu.utils.timers import ENGINE
+
+
+def _ring(monkeypatch, overlap: str, a, b):
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", overlap)
+    return spgemm_ring(a, b)
+
+
+@pytest.mark.parametrize("dist", ["small", "full", "adversarial"])
+def test_overlap_bit_identical(monkeypatch, dist):
+    """overlap=0 and overlap=1 agree bit-for-bit on bounded, full-range, and
+    adversarial values (the b32 and full-width field MACs both ride under
+    the knob)."""
+    rng = np.random.default_rng(700)
+    k = 4
+    a = random_block_sparse(9, 9, k, 0.4, rng, dist)
+    b = random_block_sparse(9, 9, k, 0.4, rng, dist)
+    got0 = _ring(monkeypatch, "0", a, b)
+    got1 = _ring(monkeypatch, "1", a, b)
+    assert np.array_equal(got0.coords, got1.coords)
+    assert np.array_equal(got0.tiles, got1.tiles)
+
+
+def test_overlap_bit_identical_powerlaw(monkeypatch):
+    """The webbase-like power-law structure (skewed fanout -> deep rank
+    lists) through both bodies on the full 8-device mesh."""
+    rng = np.random.default_rng(701)
+    a = powerlaw_block_sparse(48, 8, 3.0, rng, "small")
+    b = powerlaw_block_sparse(48, 8, 3.0, rng, "small")
+    got0 = _ring(monkeypatch, "0", a, b)
+    got1 = _ring(monkeypatch, "1", a, b)
+    assert got0 == got1
+
+
+@pytest.mark.parametrize("overlap", ["0", "1"])
+def test_deep_cell_tail_matches_oracle(monkeypatch, overlap):
+    """A (1 x J) row times (J x 1) column concentrates J/n_dev pairs in one
+    (key, slab) cell -- past RANK_UNROLL_MAX, so the dense tail block must
+    carry the spill.  J=80 on the 8-device mesh = 10 pairs/cell (tail depth
+    2); values bounded, so ring == the reference oracle exactly."""
+    from spgemm_tpu.parallel.ring import RANK_UNROLL_MAX
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+    from spgemm_tpu.utils.semantics import spgemm_oracle
+
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", overlap)
+    rng = np.random.default_rng(704)
+    k, J = 2, 80
+    assert J // 8 > RANK_UNROLL_MAX - 8 + 1  # stays deep if the cap moves up
+    a = BlockSparseMatrix(
+        rows=k, cols=J * k, k=k,
+        coords=np.stack([np.zeros(J, np.int64),
+                         np.arange(J, dtype=np.int64)], axis=1),
+        tiles=rng.integers(0, 1 << 20, size=(J, k, k), dtype=np.uint64))
+    b = BlockSparseMatrix(
+        rows=J * k, cols=k, k=k,
+        coords=np.stack([np.arange(J, dtype=np.int64),
+                         np.zeros(J, np.int64)], axis=1),
+        tiles=rng.integers(0, 1 << 20, size=(J, k, k), dtype=np.uint64))
+    got = spgemm_ring(a, b)
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, k, spgemm_oracle(a.to_dict(), b.to_dict(), k))
+    assert got == want
+
+
+def test_overlap_default_on(monkeypatch):
+    monkeypatch.delenv("SPGEMM_TPU_RING_OVERLAP", raising=False)
+    assert overlap_enabled() is True
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", "0")
+    assert overlap_enabled() is False
+
+
+def test_overlap_knob_validated(monkeypatch):
+    """An invalid knob value must raise immediately, naming the knob --
+    never silently run some default (the round-5 'documented knob that
+    crashes later' trap)."""
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", "yes")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_RING_OVERLAP"):
+        overlap_enabled()
+    rng = np.random.default_rng(702)
+    a = random_block_sparse(4, 4, 2, 0.5, rng, "small")
+    b = random_block_sparse(4, 4, 2, 0.5, rng, "small")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_RING_OVERLAP"):
+        spgemm_ring(a, b)
+
+
+def test_ring_phases_recorded(monkeypatch):
+    """Observability contract: a ring multiply must land ring_plan /
+    ring_hop / ring_fold spans and the ring_steps counter in the ENGINE
+    registry (bench.py's detail.phases_s and the CLI --profile report read
+    exactly these)."""
+    monkeypatch.delenv("SPGEMM_TPU_RING_OVERLAP", raising=False)
+    rng = np.random.default_rng(703)
+    a = random_block_sparse(6, 6, 2, 0.5, rng, "small")
+    b = random_block_sparse(6, 6, 2, 0.5, rng, "small")
+    ENGINE.reset()
+    try:
+        spgemm_ring(a, b)
+        snap = ENGINE.snapshot()
+        counters = ENGINE.counter_snapshot()
+    finally:
+        ENGINE.reset()
+    for phase in ("ring_plan", "ring_hop", "ring_fold"):
+        assert phase in snap and snap[phase] >= 0, snap
+    assert counters.get("ring_steps", 0) >= 1, counters
