@@ -22,7 +22,7 @@ MEMFLAG = $(MEMFLAG_$(MEM))
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test bench bench-large warm clean
+.PHONY: all native run test lint bench bench-large warm clean
 
 all: native
 
@@ -48,6 +48,11 @@ endif
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# spgemm-lint: AST invariant checker (FLD fold order, KNB knob registry,
+# BKD import-time backend touch, DOC doc drift); exit 1 on any finding.
+lint:
+	$(PY) -m spgemm_tpu.analysis --json
 
 bench:
 	$(PY) bench.py

@@ -49,6 +49,10 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from spgemm_tpu.utils import knobs  # noqa: E402 -- jax-free registry
+
 
 def _chain_config(args, rng):
     from spgemm_tpu.utils.gen import banded_block_sparse
@@ -193,7 +197,7 @@ def _outer() -> int:
     import signal
     import subprocess
 
-    budget = float(os.environ.get("SPGEMM_TPU_BENCH_TIMEOUT", "2700"))
+    budget = knobs.get("SPGEMM_TPU_BENCH_TIMEOUT")
     env = {**os.environ, "SPGEMM_TPU_BENCH_INNER": "1"}
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__),
                              *sys.argv[1:]], env=env)
@@ -230,7 +234,7 @@ def _outer() -> int:
 
 
 def main() -> int:
-    if not os.environ.get("SPGEMM_TPU_BENCH_INNER"):
+    if not knobs.get("SPGEMM_TPU_BENCH_INNER"):
         return _outer()
     p = argparse.ArgumentParser()
     p.add_argument("--chain", type=int, default=10, help="chain length N")

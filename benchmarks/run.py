@@ -56,7 +56,14 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from spgemm_tpu.utils import knobs  # noqa: E402 -- jax-free registry
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _evidence_dir() -> str:
+    return (knobs.get("SPGEMM_TPU_EVIDENCE_DIR")
+            or os.path.join(REPO, "benchmarks", "evidence"))
 
 
 def _digest_barrier(x):
@@ -366,7 +373,7 @@ def config_webbase_1mrow():
     import jax
 
     if (jax.devices()[0].platform != "tpu"
-            and not os.environ.get("SPGEMM_TPU_FORCE_1MROW")):
+            and not knobs.get("SPGEMM_TPU_FORCE_1MROW")):
         return {"config": "webbase-1Mrow", "skipped":
                 "needs TPU (1M-row scale impractical at CPU kernel rates)"}
     from spgemm_tpu.ops.spgemm import resolve_backend
@@ -459,8 +466,7 @@ def _extra_rows():
     in the evidence dir, one suite-schema JSON row per line).  Isolating
     unproven big-scale configs there means their hang/failure can never
     cost the fail-gated core capture; the table still shows their rows."""
-    ev_dir = os.environ.get("SPGEMM_TPU_EVIDENCE_DIR",
-                            os.path.join(REPO, "benchmarks", "evidence"))
+    ev_dir = _evidence_dir()
     path = os.path.join(ev_dir, "extras.jsonl")
     by_config: dict = {}
     if os.path.exists(path):
@@ -585,8 +591,7 @@ def _sweep_section():
     (written by tpu_evidence.sh, which runs the sweep BEFORE the suite so
     this table is from the same capture; SPGEMM_TPU_EVIDENCE_DIR overrides
     the directory for custom-outdir runs)."""
-    ev_dir = os.environ.get("SPGEMM_TPU_EVIDENCE_DIR",
-                            os.path.join(REPO, "benchmarks", "evidence"))
+    ev_dir = _evidence_dir()
     rows = []
     # sweep_k64.txt: the best-effort beyond-reference tile-size sweep --
     # same row schema (each row carries its k), one shared table

@@ -1,0 +1,38 @@
+"""spgemm-lint: AST invariant checker for the repo's machine-enforced contracts.
+
+The reference semantics (SURVEY.md section 2.9) make the wrap-then-mod u64
+arithmetic non-associative, so fold order is a correctness invariant; the
+dispatch layers (round batching, ring overlap) additionally require every
+engine knob to be jit-static discipline-clean, and the flaky-TPU environment
+requires that no module touches a backend at import time (a dead TPU hangs,
+never raises).  Reviewer memory does not scale to those contracts -- this
+package checks them structurally:
+
+  FLD  ordered-fold rule: unordered reductions (jnp.sum / lax.psum /
+       segment_sum / functools.reduce / array .sum()) are findings inside
+       the numeric modules unless escaped with
+       `# spgemm-lint: fld-proof(<reason>)` (the proof-gated MXU / no_mod
+       routes).
+  KNB  knob rule: every SPGEMM_TPU_* environment read must go through the
+       central registry (spgemm_tpu/utils/knobs.py); raw os.environ /
+       os.getenv reads are findings.
+  BKD  backend rule: no module-import-time jax.devices()/backend-touching
+       calls outside utils/backend_probe.py.
+  DOC  drift rule: the CLAUDE.md knob table and the CLI help must cover
+       exactly the registry's knobs (generated-vs-committed diff is a
+       finding).
+
+Run `python -m spgemm_tpu.analysis [--json]` (or `make lint`); the repo
+self-lints in tier-1 (tests/test_lint.py).
+"""
+
+from spgemm_tpu.analysis.core import (Finding, is_numeric_module, lint_file,
+                                      lint_paths, lint_repo, repo_root)
+from spgemm_tpu.analysis.docrules import (KNOB_TABLE_BEGIN, KNOB_TABLE_END,
+                                          check_claude_md, check_cli_help)
+
+__all__ = [
+    "Finding", "lint_file", "lint_paths", "lint_repo", "repo_root",
+    "is_numeric_module", "check_claude_md", "check_cli_help",
+    "KNOB_TABLE_BEGIN", "KNOB_TABLE_END",
+]

@@ -1,0 +1,105 @@
+"""CLI: `python -m spgemm_tpu.analysis [paths...] [--json]` (or `make lint`).
+
+Default run (no paths): self-lint the whole spgemm_tpu package plus the
+repo doc-drift checks (CLAUDE.md knob table, CLI help coverage).  Explicit
+paths lint just those files/dirs; the doc checks then run only when
+--claude-md is passed (fixture testing drives this).
+
+Exit status: 0 = clean, 1 = findings (CI-gateable).  --json emits one
+machine-readable report object on stdout:
+  {"findings": [{"file", "line", "rule", "message"}, ...],
+   "counts": {"FLD": n, "KNB": n, "BKD": n, "DOC": n}, "clean": bool}
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+from spgemm_tpu.analysis import core, docrules
+
+
+def _write_knob_table(path: str) -> int:
+    """Regenerate the marked CLAUDE.md block in place."""
+    block = docrules.render_knob_block()
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        print(f"cannot read {path}", file=sys.stderr)
+        return 1
+    begin = text.find(docrules.KNOB_TABLE_BEGIN)
+    end = text.find(docrules.KNOB_TABLE_END)
+    if begin < 0 or end < 0 or end < begin:
+        print(f"{path}: knob-table markers missing; paste this block where "
+              "the knob table belongs:\n\n" + block, file=sys.stderr)
+        return 1
+    new = (text[:begin] + block
+           + text[end + len(docrules.KNOB_TABLE_END):])
+    if new != text:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(new)
+        print(f"updated knob table in {path}")
+    else:
+        print(f"knob table in {path} already current")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu.analysis",
+        description="spgemm-lint: AST invariant checker (FLD fold order, "
+                    "KNB knob registry, BKD import-time backend touch, "
+                    "DOC doc drift)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the spgemm_tpu "
+                        "package, bench.py, benchmarks/, the graft entry, "
+                        "+ repo doc checks)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the machine-readable findings report")
+    p.add_argument("--claude-md", default=None, metavar="PATH",
+                   help="CLAUDE.md to diff the knob table against "
+                        "(default: the repo's, on a default run)")
+    p.add_argument("--no-doc", action="store_true",
+                   help="skip the DOC drift checks")
+    p.add_argument("--write-knob-table", action="store_true",
+                   help="regenerate the CLAUDE.md knob-table block from "
+                        "the registry and exit")
+    args = p.parse_args(argv)
+
+    root = core.repo_root()
+    default_claude = os.path.join(root, "CLAUDE.md")
+    if args.write_knob_table:
+        return _write_knob_table(args.claude_md or default_claude)
+
+    if args.paths:
+        paths = args.paths
+        claude_md = args.claude_md  # None = no doc checks on custom runs
+    else:
+        paths = core.default_paths()
+        claude_md = args.claude_md or default_claude
+    # the DOC half (knob table + CLI help) runs only when a CLAUDE.md is in
+    # play: default runs always, explicit-path runs only with --claude-md
+    findings = core.lint_paths(paths, claude_md=claude_md,
+                               doc=not args.no_doc and claude_md is not None)
+
+    if args.as_json:
+        counts = collections.Counter(f.rule for f in findings)
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "counts": {rule: counts.get(rule, 0)
+                       for rule in ("FLD", "KNB", "BKD", "DOC", "PARSE")},
+            "clean": not findings,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f"{f.file}:{f.line}: [{f.rule}] {f.message}")
+        print(f"spgemm-lint: {len(findings)} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,195 @@
+"""The AST rule families: FLD (ordered fold), KNB (knob registry), BKD
+(import-time backend touch).  DOC lives in docrules.py (it diffs generated
+docs, not syntax trees).
+
+All three share the dotted-name helper: rules match on the *spelled*
+call -- `jnp.sum`, `jax.lax.psum`, `x.sum()` -- which is what a reviewer
+reads and what a future PR would actually type.  Aliased imports
+(`from jax.numpy import sum as s`) can evade an AST linter; the rule set
+trades that corner for zero-dependency speed, and the tier-1 self-lint
+keeps the package idiom uniform enough that the spelled form is the form.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from spgemm_tpu.analysis.core import Finding
+
+# ---------------------------------------------------------------- FLD ----
+# Unordered-reduction call names.  `.sum()` as a METHOD on anything is
+# flagged too: on the numeric path even a host-side numpy sum over values
+# is a fold whose order must be argued, and the escape hatch
+# (`# spgemm-lint: fld-proof(<reason>)`) is exactly that argument.
+# Builtin bare `sum(...)` is a left fold (ordered) and stays legal.
+FLD_TERMINALS = {"psum", "psum_scatter", "segment_sum", "tree_reduce"}
+FLD_REDUCE_NAMESPACES = {"functools", "ft"}
+
+# ---------------------------------------------------------------- KNB ----
+KNOB_PREFIX = "SPGEMM_TPU_"
+ENVIRON_GETTERS = {"os.environ.get", "environ.get", "os.getenv", "getenv",
+                   "os.environ.pop", "environ.pop",
+                   "os.environ.setdefault", "environ.setdefault"}
+ENVIRON_MAPS = {"os.environ", "environ"}
+
+# ---------------------------------------------------------------- BKD ----
+# Calls that initialize or query a backend.  On this environment a dead
+# TPU HANGS inside backend init (utils/backend_probe docstring), so any of
+# these at module-import time can wedge a bare `import spgemm_tpu.x`.
+BACKEND_CALLS = {
+    "jax.devices", "jax.local_devices", "jax.device_count",
+    "jax.local_device_count", "jax.process_count", "jax.process_index",
+    "jax.default_backend", "jax.device_put",
+    "xla_bridge.get_backend", "xla_bridge.backends",
+}
+# Any CALL into the array namespace materializes a concrete array, which
+# initializes the default backend just as surely as jax.devices() --
+# `_ZERO = jnp.zeros(...)` at module scope is the most common spelling of
+# the hazard.  (Attribute access like `jnp.uint32` as a dtype is fine;
+# only calls are flagged.)
+BACKEND_NAMESPACES = ("jnp.", "jax.numpy.")
+
+
+def dotted_name(node: ast.expr) -> str | None:
+    """'jax.lax.psum' for Attribute/Name chains; None for anything else
+    (subscripts, calls, literals)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _str_const(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def check_fld(tree: ast.AST, file: str, escapes: set[int]) -> list[Finding]:
+    """FLD: unordered reductions on the numeric path.
+
+    A call is escaped when its own line (or the line directly above it,
+    for wrapped expressions) carries `# spgemm-lint: fld-proof(<reason>)`.
+    """
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        head, _, last = name.rpartition(".")
+        root = head.split(".", 1)[0] if head else ""
+        bad = None
+        if last in FLD_TERMINALS:
+            bad = (f"unordered reduction `{name}` on the numeric path: the "
+                   "wrap-then-mod fold is non-associative (SURVEY.md 2.9)")
+        elif last == "sum" and head:  # any `<expr>.sum(...)` method/ns call
+            bad = (f"`{name}` is an unordered reduction: the reference "
+                   "fold order is load-bearing on the numeric path "
+                   "(SURVEY.md 2.9); use the ordered MAC/fold helpers "
+                   "(ops/u64.py) or escape with a fld-proof(<reason>)")
+        elif last == "reduce" and (root in FLD_REDUCE_NAMESPACES
+                                   or not head):
+            bad = (f"`{name}` folds in container-iteration order, not the "
+                   "reference's j-ascending pair order; spell the fold "
+                   "explicitly or escape with fld-proof(<reason>)")
+        if bad is None:
+            continue
+        if node.lineno in escapes or node.lineno - 1 in escapes:
+            continue
+        findings.append(Finding(file, node.lineno, "FLD", bad))
+    return findings
+
+
+def check_knb(tree: ast.AST, file: str) -> list[Finding]:
+    """KNB: raw SPGEMM_TPU_* environment READS outside the registry.
+
+    Writes (`os.environ[k] = v`, Store/Del contexts) stay legal: that is
+    how A/B harnesses and tests drive knob values for code that then
+    reads them through the registry."""
+    findings = []
+    msg = ("raw environment read of {key!r}: SPGEMM_TPU_* knobs must go "
+           "through spgemm_tpu.utils.knobs (register the knob and call "
+           "knobs.get)")
+    for node in ast.walk(tree):
+        key = None
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in ENVIRON_GETTERS and node.args:
+                key = _str_const(node.args[0])
+        elif isinstance(node, ast.Subscript):
+            if (isinstance(node.ctx, ast.Load)
+                    and dotted_name(node.value) in ENVIRON_MAPS):
+                key = _str_const(node.slice)
+        if key is not None and key.startswith(KNOB_PREFIX):
+            findings.append(Finding(file, node.lineno, "KNB",
+                                    msg.format(key=key)))
+    return findings
+
+
+class _ImportTimeVisitor:
+    """Collects backend-touching calls that execute at module import.
+
+    Function/lambda BODIES are deferred (not import time), but their
+    decorators and default-argument expressions evaluate at definition
+    time -- at module scope that IS import time, so those are visited in
+    the enclosing scope.  Class bodies execute at import and are walked.
+    `if __name__ == "__main__"` blocks are skipped: they never run on a
+    bare import, and a script driver touching the backend (after probing)
+    is the CLI's job, not an import hazard.
+    """
+
+    def __init__(self, file: str):
+        self.file = file
+        self.findings: list[Finding] = []
+
+    @staticmethod
+    def _is_main_guard(node: ast.AST) -> bool:
+        if not isinstance(node, ast.If):
+            return False
+        t = node.test
+        return (isinstance(t, ast.Compare)
+                and isinstance(t.left, ast.Name) and t.left.id == "__name__"
+                and len(t.comparators) == 1
+                and _str_const(t.comparators[0]) == "__main__")
+
+    def visit(self, node: ast.AST) -> None:
+        if self._is_main_guard(node):
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                self.visit(dec)
+            for default in (node.args.defaults + node.args.kw_defaults):
+                if default is not None:
+                    self.visit(default)
+            return  # body runs only when called
+        if isinstance(node, ast.Lambda):
+            for default in (node.args.defaults + node.args.kw_defaults):
+                if default is not None:
+                    self.visit(default)
+            return
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is not None and (name in BACKEND_CALLS
+                                     or name.startswith(BACKEND_NAMESPACES)):
+                self.findings.append(Finding(
+                    self.file, node.lineno, "BKD",
+                    f"`{name}()` at module import time initializes a "
+                    "backend: a dead TPU hangs inside backend init (never "
+                    "raises), so backends may only be touched lazily, "
+                    "after utils/backend_probe has probed or pinned a "
+                    "platform"))
+        for child in ast.iter_child_nodes(node):
+            self.visit(child)
+
+
+def check_bkd(tree: ast.AST, file: str) -> list[Finding]:
+    """BKD: module-import-time backend-touching calls."""
+    visitor = _ImportTimeVisitor(file)
+    visitor.visit(tree)
+    return visitor.findings

@@ -17,6 +17,11 @@ same files, same output, no MPI launcher --
 The reference's hard-coded globals become flags with the same defaults
 (SURVEY.md section 5.6).  Multi-chip sharding is picked up automatically from
 the visible mesh (see parallel/), replacing the mpirun -np P contract.
+
+`python -m spgemm_tpu.cli knobs [--json]` lists the central knob registry
+(spgemm_tpu/utils/knobs.py) with each knob's current value, default, and
+source (env vs default) -- whole-engine A/B setups are inspectable without
+grepping the environment.
 """
 
 from __future__ import annotations
@@ -26,11 +31,19 @@ import logging
 import sys
 import time
 
+from spgemm_tpu.utils import knobs as knobs_registry
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="spgemm_tpu",
-        description="TPU-native block-sparse matrix chain product (reference-compatible)",
+        description="TPU-native block-sparse matrix chain product "
+                    "(reference-compatible).  Also: `spgemm_tpu knobs` "
+                    "lists the engine env-knob registry with live values.",
+        # the epilog is GENERATED from the knob registry, so --help can
+        # never drift from the code (the spgemm-lint DOC rule checks it)
+        epilog=knobs_registry.cli_epilog(),
+        formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     p.add_argument("folder", help="input directory containing `size` and `matrix1..N`")
     p.add_argument("--device", default=None, metavar="PLATFORM",
@@ -105,7 +118,54 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def run_knobs(argv: list[str]) -> int:
+    """`spgemm_tpu knobs [--json]`: the registry's live state -- one line
+    per knob (name, current value, source, default) so an exported A/B
+    session is auditable at a glance."""
+    p = argparse.ArgumentParser(prog="spgemm_tpu knobs",
+                                description="list engine env knobs: "
+                                "current value, default, and source")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable: one JSON object per knob")
+    args = p.parse_args(argv)
+    rows = knobs_registry.snapshot()
+    if args.as_json:
+        import json  # noqa: PLC0415
+
+        print(json.dumps(rows, indent=2))
+        return 0
+    name_w = max(len(r["name"]) for r in rows)
+    val_w = max(len(r["value"]) for r in rows)
+    try:
+        for r in rows:
+            static = " [jit-static]" if r["jit_static"] else ""
+            print(f"{r['name']:<{name_w}}  {r['value']:>{val_w}}  "
+                  f"({r['source']}, default {r['default']}){static}")
+            if r.get("error"):
+                print(f"{'':<{name_w}}  !! {r['error']}")
+            print(f"{'':<{name_w}}  {r['doc']}  [{r['module']}]")
+    except BrokenPipeError:
+        # `spgemm_tpu knobs | head` closing the pipe is not an error for a
+        # listing; swap in devnull so the interpreter's exit flush of
+        # stdout cannot raise again
+        import os  # noqa: PLC0415
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
 def run(argv: list[str] | None = None) -> int:
+    import os  # noqa: PLC0415 -- only for the subcommand/folder disambiguation
+
+    if argv is None:
+        argv = sys.argv[1:]
+    # `knobs` is a subcommand UNLESS an INPUT directory of that name exists
+    # (the reference contract requires a `size` file) -- a pre-existing
+    # `./knobs` matrix folder keeps its old meaning, while an unrelated
+    # knobs/ scratch dir does not swallow the subcommand
+    if (argv and argv[0] == "knobs"
+            and not os.path.exists(os.path.join("knobs", "size"))):
+        return run_knobs(argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if (args.stream or args.out_of_core) and args.shard in ("keys", "inner", "ring"):

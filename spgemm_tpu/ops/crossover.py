@@ -30,6 +30,8 @@ import logging
 import os
 import time
 
+from spgemm_tpu.utils import knobs
+
 log = logging.getLogger("spgemm_tpu.crossover")
 
 # In-memory cache keyed by resolved cache-file path: if
@@ -40,8 +42,8 @@ _CACHE: dict[str, dict] = {}
 
 def gate_policy() -> str:
     """'auto' or 'proof' (see module docstring)."""
-    env = os.environ.get("SPGEMM_TPU_HYBRID_GATE")
-    if env in ("auto", "proof"):
+    env = knobs.get("SPGEMM_TPU_HYBRID_GATE")
+    if env is not None:
         return env
     import jax  # noqa: PLC0415
 
@@ -49,7 +51,7 @@ def gate_policy() -> str:
 
 
 def _cache_path() -> str:
-    root = (os.environ.get("SPGEMM_TPU_CROSSOVER_CACHE")
+    root = (knobs.get("SPGEMM_TPU_CROSSOVER_CACHE")
             or os.path.expanduser("~/.cache/jax_bench"))
     os.makedirs(root, exist_ok=True)
     return os.path.join(root, "hybrid_crossover.json")

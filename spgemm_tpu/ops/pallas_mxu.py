@@ -54,8 +54,6 @@ from spgemm_tpu.utils import jaxcompat
 from spgemm_tpu.ops.mxu_spgemm import N_LIMBS
 from spgemm_tpu.ops.symbolic import accept_round_stack
 
-_M32_U32 = jnp.uint32(0xFFFFFFFF)
-
 
 def _limb_planes_bf16(hi, lo, n_limbs: int = N_LIMBS):
     """n_limbs bf16 planes of 7 bits each -- mxu_spgemm.limbs7, bf16 cast."""

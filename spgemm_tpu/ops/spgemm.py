@@ -20,7 +20,6 @@ provides the Pallas TPU kernel for the same contract (selected via backend=).
 from __future__ import annotations
 
 import logging
-import os
 from functools import partial
 
 import jax
@@ -28,6 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from spgemm_tpu.ops import u64
+from spgemm_tpu.utils import knobs
 from spgemm_tpu.ops.symbolic import (accept_round_stack, assembly_permutation,
                                      plan_rounds, symbolic_join)
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
@@ -42,11 +42,7 @@ def round_batch_enabled() -> bool:
     one-launch-per-round loop with per-round output slicing.  Both produce
     identical bits; the knob exists so the dispatch/assembly overhead win
     is measurable in one flag flip (bench.py detail.phases_s/dispatches)."""
-    env = os.environ.get("SPGEMM_TPU_ROUND_BATCH", "1")
-    if env not in ("0", "1"):
-        raise ValueError(
-            f"SPGEMM_TPU_ROUND_BATCH must be '0' or '1', got {env!r}")
-    return env == "1"
+    return knobs.get("SPGEMM_TPU_ROUND_BATCH")
 
 
 def _batch_entries(k: int) -> int:
@@ -199,14 +195,11 @@ def _select_numeric(backend: str, a, b):
         # per static value, so this costs nothing.  Validate at ENTRY: the
         # unsupported combinations die on TPU hardware with a bare
         # JaxRuntimeError deep inside Mosaic (round-5 VERDICT "What's weak"
-        # #2), so the engine rejects them here with the knob named.
-        algo = os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast")
-        try:
-            pair_block = int(os.environ.get("SPGEMM_TPU_VPU_PB", "1"))
-        except ValueError as e:
-            raise ValueError(
-                f"SPGEMM_TPU_VPU_PB must be an integer >= 1, got "
-                f"{os.environ['SPGEMM_TPU_VPU_PB']!r}") from e
+        # #2), so the engine rejects them here with the knob named (the
+        # registry validates value syntax, validate_vpu_config the
+        # platform-legality of the combination).
+        algo = knobs.get("SPGEMM_TPU_VPU_ALGO")
+        pair_block = knobs.get("SPGEMM_TPU_VPU_PB")
         platform = jax.devices()[0].platform
         validate_vpu_config(algo, pair_block, platform=platform,
                             interpret=platform == "cpu")
@@ -235,8 +228,7 @@ def _select_numeric(backend: str, a, b):
             numeric = partial(numeric_round_mxu_pallas,
                               a_limbs=limbs_for_bound(a.val_bound),
                               b_limbs=limbs_for_bound(b.val_bound),
-                              pair_width=int(os.environ.get(
-                                  "SPGEMM_TPU_MXU_R", "8")))
+                              pair_width=knobs.get("SPGEMM_TPU_MXU_R"))
             return numeric, 64 * 1024, 8192
         from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu  # noqa: PLC0415
 
@@ -290,19 +282,19 @@ def _hybrid_setup(a, b, k):
         import jax  # noqa: PLC0415
 
         dev = jax.devices()[0]
-        algo = os.environ.get("SPGEMM_TPU_VPU_ALGO", "colbcast")
-        pb_env = os.environ.get("SPGEMM_TPU_VPU_PB", "1")
+        algo = knobs.get("SPGEMM_TPU_VPU_ALGO")
+        pb = knobs.get("SPGEMM_TPU_VPU_PB")
         if dev.platform == "tpu":
             from spgemm_tpu.ops.pallas_mxu import limbs_for_bound  # noqa: PLC0415
 
             limbs = f"l{limbs_for_bound(a.val_bound)}x{limbs_for_bound(b.val_bound)}"
         else:
             limbs = "xla"
-        mxu_r = os.environ.get("SPGEMM_TPU_MXU_R", "8")
+        mxu_r = knobs.get("SPGEMM_TPU_MXU_R")
         # v2: the VPU side of the measurement is the proven-round (nomod)
         # kernel -- older entries timed the mod kernel and must not be reused
         key_prefix = (f"v2:{dev.platform}:{dev.device_kind}:"
-                      f"{exact_name}-{algo}-pb{pb_env}:{limbs}-R{mxu_r}:k{k}")
+                      f"{exact_name}-{algo}-pb{pb}:{limbs}-R{mxu_r}:k{k}")
 
     def choose_numeric(rnd):
         """-> (numeric_fn, used_mxu, proof_ok).  proof_ok reports whether
@@ -619,7 +611,7 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     # round writes a disjoint key_index slice of out_tiles, and the fold
     # order lives inside the kernels (test_outofcore pins depths 1/4
     # bit-identical).
-    depth = max(1, int(os.environ.get("SPGEMM_TPU_OOC_DEPTH", "2")))
+    depth = knobs.get("SPGEMM_TPU_OOC_DEPTH")
     mxu_rounds = 0
     if depth == 1:
         for rnd in rounds:

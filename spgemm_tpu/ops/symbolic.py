@@ -246,6 +246,18 @@ def _smem_key_cap(P: int, max_entries: int) -> int:
     pad8(short) * max(long, 128) entries; solve for the key-chunk size."""
     pad8_p = -(-P // 8) * 8
     if P <= 512:
+        # (P, K): K rides the lanes, and Mosaic pads it to >= 128 no matter
+        # how few keys ship -- below pad8(P) * 128 entries NO chunk size
+        # meets the budget, so shrinking K would just overshoot silently
+        # (the defect class the batch-mode pow2 clamp closes).  Unreachable
+        # at the in-tree 64K budget (pad8(P) * 128 <= 65536 for P <= 512);
+        # refuse loudly for external callers instead of under-budgeting.
+        if max_entries < pad8_p * 128:
+            raise ValueError(
+                f"max_entries={max_entries} cannot fit fanout class P={P}: "
+                f"the (P, K) index arrays lane-pad K to >= 128, so the "
+                f"minimum SMEM footprint is pad8(P) * 128 = {pad8_p * 128} "
+                "entries")
         return max_entries // pad8_p              # (P, K): P sublanes
     # (K, P): P rides the lanes and is padded to a 128 multiple by Mosaic --
     # budget against the padded footprint, not raw P, or the shipped arrays
@@ -322,9 +334,22 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
                 caps.append(round_size)
             if batch_entries is not None:
                 caps.append(max(1, batch_entries // P))
+            smem_cap = None
             if max_entries is not None:
-                caps.append(_smem_key_cap(P, max_entries))
+                smem_cap = _smem_key_cap(P, max_entries)
+                caps.append(smem_cap)
             chunk_cap = max(1, _ladder_floor(min(caps)))
+            # SMEM-derived caps must clamp to the pow2 floor (ROADMAP
+            # round-7 flag): at P <= 512 the kernel ships (P, K) with the
+            # key axis in LANES, and Mosaic lane-pads K to the next 128
+            # multiple -- a 3/4-ladder chunk like 192 would silently ship
+            # a 256-wide array, overshooting the max_entries budget the
+            # cap was solved from by up to 33%.  Pow2 rungs >= 128 are
+            # their own lane padding, and ladder rungs >= 384 are already
+            # 128-multiples, so only the small non-multiple rungs clamp.
+            if (smem_cap is not None and P <= 512
+                    and -(-chunk_cap // 128) * 128 > smem_cap):
+                chunk_cap = max(1, _floor_pow2(min(caps)))
         elif max_entries is None:
             chunk_cap = round_size
         else:

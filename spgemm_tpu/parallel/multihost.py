@@ -55,6 +55,7 @@ import numpy as np
 
 from spgemm_tpu.chain import chain_product
 from spgemm_tpu.parallel.chainpart import partition_chain
+from spgemm_tpu.utils import knobs
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 
 log = logging.getLogger("spgemm_tpu.multihost")
@@ -73,9 +74,9 @@ def init_from_env() -> None:
     from spgemm_tpu.utils import jaxcompat
 
     kwargs = {}
-    hb = os.environ.get("SPGEMM_TPU_DCN_HEARTBEAT_S")
-    if hb:
-        kwargs["heartbeat_timeout_seconds"] = int(hb)
+    hb = knobs.get("SPGEMM_TPU_DCN_HEARTBEAT_S")
+    if hb is not None:
+        kwargs["heartbeat_timeout_seconds"] = hb
     jaxcompat.distributed_initialize(
         coordinator_address=coord,
         num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
@@ -84,28 +85,13 @@ def init_from_env() -> None:
     )
 
 
-DEFAULT_DCN_CHUNK_MB = 64.0
-
-
 def _dcn_chunk_mb() -> float:
     """SPGEMM_TPU_DCN_CHUNK_MB: per-rank chunk budget (MiB, float) for the
     partial-product exchange; 0 selects the legacy padded all-gather
     (guard-railed -- its peak is logged loudly because it is unbounded in
-    max_nnzb)."""
-    raw = os.environ.get("SPGEMM_TPU_DCN_CHUNK_MB", "").strip()
-    if not raw:
-        return DEFAULT_DCN_CHUNK_MB
-    try:
-        mb = float(raw)
-    except ValueError:
-        raise ValueError(
-            f"SPGEMM_TPU_DCN_CHUNK_MB must be a number (MiB), got {raw!r}"
-        ) from None
-    if mb < 0:
-        raise ValueError(
-            f"SPGEMM_TPU_DCN_CHUNK_MB must be >= 0 (0 = legacy padded "
-            f"exchange), got {raw!r}")
-    return mb
+    max_nnzb).  The registry validates number-ness and >= 0, naming the
+    knob on failure."""
+    return knobs.get("SPGEMM_TPU_DCN_CHUNK_MB")
 
 
 def _allgather_partials(partial: BlockSparseMatrix | None, k: int):

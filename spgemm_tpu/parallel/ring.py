@@ -31,7 +31,6 @@ device-to-device over ICI, nothing touches the host.
 
 from __future__ import annotations
 
-import os
 import time
 from functools import partial
 
@@ -44,7 +43,7 @@ from spgemm_tpu.ops import u64
 from spgemm_tpu.ops.symbolic import JoinResult, symbolic_join
 from spgemm_tpu.parallel.innershard import fold_pairs_field
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
-from spgemm_tpu.utils import jaxcompat
+from spgemm_tpu.utils import jaxcompat, knobs
 from spgemm_tpu.utils.timers import ENGINE
 
 
@@ -53,11 +52,7 @@ def overlap_enabled() -> bool:
     the hop for slab t+1 is in flight while slab t folds.  Bit-identical
     either way (the fold order never changes); 0 keeps the legacy serialized
     fold-then-hop body for A/B measurement."""
-    raw = os.environ.get("SPGEMM_TPU_RING_OVERLAP", "1").strip()
-    if raw not in ("0", "1"):
-        raise ValueError(
-            f"SPGEMM_TPU_RING_OVERLAP must be 0 or 1, got {raw!r}")
-    return raw == "1"
+    return knobs.get("SPGEMM_TPU_RING_OVERLAP")
 
 
 # rank lists are UNROLLED in the fold's step body (one fold+scatter per
@@ -270,7 +265,7 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     # SPGEMM_TPU_RING_HOP_PROBE=0 skips the probe entirely (saves its one
     # compiled shape + two hops per process per slab shape -- e.g. a
     # one-shot CLI run that never reads the phase registry)
-    probe_on = os.environ.get("SPGEMM_TPU_RING_HOP_PROBE", "1") != "0"
+    probe_on = knobs.get("SPGEMM_TPU_RING_HOP_PROBE")
     probe_key = (mesh, n_dev, small, bsl.shape, bsh.shape)
     hop_s = _HOP_PROBE_CACHE.get(probe_key) if probe_on else None
     if probe_on and hop_s is None:

@@ -13,6 +13,8 @@ import os
 import subprocess
 import sys
 
+from spgemm_tpu.utils import knobs
+
 
 def probe_default_backend(timeout_s: float | None = None) -> str:
     """Probe outcome: 'ok' (real accelerator computed), 'cpu' (healthy but
@@ -20,7 +22,7 @@ def probe_default_backend(timeout_s: float | None = None) -> str:
     'error' (init crashed).  SPGEMM_TPU_PROBE_TIMEOUT overrides the default
     150 s."""
     if timeout_s is None:
-        timeout_s = float(os.environ.get("SPGEMM_TPU_PROBE_TIMEOUT", "150"))
+        timeout_s = knobs.get("SPGEMM_TPU_PROBE_TIMEOUT")
     code = ("import jax, jax.numpy as jnp; "
             "x = jnp.ones((64, 64), jnp.bfloat16); "
             "(x @ x).block_until_ready(); "

@@ -1,0 +1,300 @@
+"""Central registry of every `SPGEMM_TPU_*` engine knob.
+
+This module is the ONLY place in the package allowed to touch
+`os.environ` for a `SPGEMM_TPU_*` name -- the KNB rule of the repo linter
+(`python -m spgemm_tpu.analysis`) flags raw reads anywhere else.  The
+registry single-sources, per knob: type, default, allowed values, whether
+the value is a jit-static (one compiled executable per value -- the
+round-batched dispatch and ring-overlap layers depend on knob values
+never varying inside a traced region), the consuming module, and a doc
+string.  From it are generated:
+
+  * the typed, validated accessor `get()` used by every consuming module
+    (an invalid value raises `ValueError` naming the knob -- never a
+    silent default, never a bare crash deep inside a kernel);
+  * the CLAUDE.md knob table (`knob_table_md`; the linter's DOC rule
+    diffs the generated text against the committed block);
+  * the CLI help epilog (`cli_epilog`) and the `spgemm_tpu.cli knobs`
+    subcommand listing (`snapshot`).
+
+Reads are lazy -- the environment is consulted at each `get()` call, not
+at import -- so tests and A/B harnesses may monkeypatch values
+mid-process exactly as before the registry existed.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+_UNSET = "(unset)"
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One registered env knob.
+
+    kind: 'enum' | 'int' | 'float' | 'bool01' | 'flag' | 'path' | 'str'
+      - bool01: value must be the string 0 or 1; get() returns bool
+      - flag:   truthy iff set to a non-empty string; get() returns bool
+    default: the DEFAULT in string form, or None ("unset" -- get() then
+      returns None for enum/int/float/path, False for flag; the consuming
+      module owns the unset fallback, e.g. a platform-dependent policy).
+    minimum: inclusive lower bound for int/float kinds.
+    jit_static: the value is baked into compiled executables (one jit
+      cache entry per value); flipping it mid-process recompiles, never
+      retraces stale code.
+    module: the consuming module (repo-relative), for docs and the CLI.
+    """
+
+    name: str
+    kind: str
+    doc: str
+    module: str
+    default: str | None = None
+    choices: tuple[str, ...] | None = None
+    minimum: float | None = None
+    jit_static: bool = False
+
+
+_KNOBS = (
+    Knob("SPGEMM_TPU_VPU_ALGO", "enum",
+         "Exact VPU kernel layout; vecj is interpret-mode-only (miscompiles "
+         "on TPU hardware, rejected there with the knob named).",
+         "ops/spgemm.py", default="colbcast", choices=("colbcast", "vecj"),
+         jit_static=True),
+    Knob("SPGEMM_TPU_VPU_PB", "int",
+         "VPU pair-axis blocking; >1 is interpret-mode-only (rejected on "
+         "TPU hardware).",
+         "ops/spgemm.py", default="1", minimum=1, jit_static=True),
+    Knob("SPGEMM_TPU_MXU_R", "int",
+         "MXU limb-kernel pair width R (whole-engine A/B, like the VPU "
+         "knobs).",
+         "ops/spgemm.py", default="8", minimum=1, jit_static=True),
+    Knob("SPGEMM_TPU_ROUND_BATCH", "bool01",
+         "Round-batched dispatch: 1 = one mega-launch per fanout class x "
+         "kernel choice + fused single-gather assembly, 0 = legacy "
+         "one-launch-per-round loop; bit-identical either way.",
+         "ops/spgemm.py", default="1"),
+    Knob("SPGEMM_TPU_OOC_DEPTH", "int",
+         "Out-of-core pipeline depth (host-side cadence, not a jit "
+         "static): 1 = synchronous minimal HBM, >=2 = 3-stage pipeline "
+         "with staging and landing workers.",
+         "ops/spgemm.py", default="2", minimum=1),
+    Knob("SPGEMM_TPU_HYBRID_GATE", "enum",
+         "Hybrid speed-gate policy: auto = measured per-shape crossover, "
+         "proof = route on the exactness proof alone (unset: auto on TPU, "
+         "proof elsewhere).",
+         "ops/crossover.py", choices=("auto", "proof")),
+    Knob("SPGEMM_TPU_CROSSOVER_CACHE", "path",
+         "Crossover-measurement cache directory (unset: "
+         "~/.cache/jax_bench).",
+         "ops/crossover.py"),
+    Knob("SPGEMM_TPU_RING_OVERLAP", "bool01",
+         "Double-buffered ring rotation: 1 = the ppermute for slab t+1 is "
+         "issued before the fold over slab t, 0 = legacy serialized "
+         "fold-then-hop; bit-identical (the fold order never changes).",
+         "parallel/ring.py", default="1", jit_static=True),
+    Knob("SPGEMM_TPU_RING_HOP_PROBE", "bool01",
+         "One-hop wire probe before the ring fold; 0 skips the probe and "
+         "its compiled shape when the phase registry is not consumed.",
+         "parallel/ring.py", default="1"),
+    Knob("SPGEMM_TPU_DCN_CHUNK_MB", "float",
+         "Multihost partial-exchange chunk budget (MiB per rank): bounds "
+         "the transient DCN buffer at O(P x chunk); 0 = legacy padded "
+         "all-gather behind a loud warning.",
+         "parallel/multihost.py", default="64", minimum=0),
+    Knob("SPGEMM_TPU_DCN_HEARTBEAT_S", "int",
+         "Multihost partner-loss detection window, seconds (unset: jax's "
+         "default, 100 s).",
+         "parallel/multihost.py", minimum=1),
+    Knob("SPGEMM_TPU_PROBE_TIMEOUT", "float",
+         "Backend liveness probe subprocess timeout, seconds (a dead TPU "
+         "HANGS, never raises -- the probe is the only safe touch).",
+         "utils/backend_probe.py", default="150", minimum=0),
+    Knob("SPGEMM_TPU_NO_NATIVE", "flag",
+         "Force the pure-Python I/O + symbolic-join paths (never build or "
+         "load libsmmio).",
+         "utils/native.py"),
+    Knob("SPGEMM_TPU_FORCE_1MROW", "flag",
+         "Run the webbase-1Mrow suite config off-TPU (normally TPU-gated: "
+         "impractical at CPU kernel rates).",
+         "benchmarks/run.py"),
+    Knob("SPGEMM_TPU_BENCH_TIMEOUT", "float",
+         "bench.py self-wrap kill budget, seconds: the outer supervisor "
+         "SIGKILLs a hung inner bench and emits the failure JSON itself.",
+         "bench.py", default="2700", minimum=0),
+    Knob("SPGEMM_TPU_BENCH_INNER", "flag",
+         "INTERNAL: set by bench.py's outer supervisor on the inner child "
+         "it spawns; not an operator knob.",
+         "bench.py"),
+    Knob("SPGEMM_TPU_EVIDENCE_DIR", "path",
+         "TPU evidence capture directory (unset: benchmarks/evidence); "
+         "read by benchmarks/run.py and tpu_evidence.sh.",
+         "benchmarks/run.py"),
+    Knob("SPGEMM_TPU_EVIDENCE_STEPS", "str",
+         "Comma-separated tpu_evidence.sh step list (shell-side knob; a "
+         "full default list does not arm the strict gates).",
+         "benchmarks/tpu_evidence.sh"),
+)
+
+REGISTRY: dict[str, Knob] = {k.name: k for k in _KNOBS}
+
+
+def _parse(kb: Knob, raw: str):
+    """Validate + convert one raw string for knob kb.  Every failure names
+    the knob (tests pin this: an invalid value must raise immediately,
+    never silently run some default)."""
+    if kb.kind == "bool01":
+        if raw not in ("0", "1"):
+            raise ValueError(f"{kb.name} must be 0 or 1, got {raw!r}")
+        return raw == "1"
+    if kb.kind == "enum":
+        if raw not in kb.choices:
+            raise ValueError(f"{kb.name} must be one of "
+                             f"{'|'.join(kb.choices)}, got {raw!r}")
+        return raw
+    if kb.kind == "int":
+        try:
+            val = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"{kb.name} must be an integer"
+                + (f" >= {kb.minimum:g}" if kb.minimum is not None else "")
+                + f", got {raw!r}") from None
+        if kb.minimum is not None and val < kb.minimum:
+            raise ValueError(
+                f"{kb.name} must be an integer >= {kb.minimum:g}, "
+                f"got {raw!r}")
+        return val
+    if kb.kind == "float":
+        try:
+            val = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"{kb.name} must be a number"
+                + (f" >= {kb.minimum:g}" if kb.minimum is not None else "")
+                + f", got {raw!r}") from None
+        if kb.minimum is not None and val < kb.minimum:
+            raise ValueError(
+                f"{kb.name} must be a number >= {kb.minimum:g}, "
+                f"got {raw!r}")
+        return val
+    if kb.kind in ("path", "str"):
+        return raw
+    raise AssertionError(f"unknown knob kind {kb.kind!r}")  # registry bug
+
+
+def get(name: str):
+    """Typed, validated value of a registered knob.
+
+    Unset (or set to whitespace) falls back to the registered default;
+    with no default, returns None (False for flag knobs).  Invalid values
+    raise ValueError naming the knob.  Unregistered names raise KeyError
+    -- registering is the price of reading."""
+    kb = REGISTRY[name]
+    raw = os.environ.get(name)
+    if kb.kind == "flag":
+        return bool(raw)  # set-and-non-empty; flags have no default form
+    if raw is not None:
+        raw = raw.strip()
+    if not raw:
+        raw = kb.default
+        if raw is None:
+            return None
+    return _parse(kb, raw)
+
+
+def source(name: str) -> str:
+    """'env' if the process environment supplies a (non-empty) value for
+    this registered knob, else 'default'."""
+    kb = REGISTRY[name]
+    raw = os.environ.get(name)
+    if kb.kind == "flag":
+        return "env" if raw else "default"
+    return "env" if raw is not None and raw.strip() else "default"
+
+
+def _display(val) -> str:
+    if val is None:
+        return _UNSET
+    if isinstance(val, bool):
+        return "1" if val else "0"
+    if isinstance(val, float):
+        return f"{val:g}"
+    return str(val)
+
+
+def snapshot() -> list[dict]:
+    """Current state of every knob (for `spgemm_tpu.cli knobs`): name,
+    typed current value, default, source, and registry metadata.  An
+    INVALID env value must not abort the listing -- auditing a
+    misconfigured A/B session is this function's whole point -- so it is
+    reported per-row (`error` key, value shows the raw string) while
+    `get()` at the consuming call site stays strict."""
+    rows = []
+    for kb in _KNOBS:
+        try:
+            value = _display(get(kb.name))
+            error = None
+        except ValueError as e:
+            value = f"INVALID {os.environ.get(kb.name, '')!r}"
+            error = str(e)
+        rows.append({
+            "name": kb.name,
+            "value": value,
+            "default": _display(
+                False if kb.kind == "flag" and kb.default is None
+                else kb.default),
+            "source": source(kb.name),
+            "kind": kb.kind,
+            "jit_static": kb.jit_static,
+            "module": kb.module,
+            "doc": kb.doc,
+            **({"error": error} if error else {}),
+        })
+    return rows
+
+
+def _values_col(kb: Knob) -> str:
+    if kb.choices:
+        return "|".join(kb.choices)
+    if kb.kind == "bool01":
+        return "0|1"
+    if kb.kind == "flag":
+        return "set/unset"
+    if kb.minimum is not None:
+        return f"{kb.kind} >= {kb.minimum:g}"
+    return kb.kind
+
+
+def knob_table_md() -> str:
+    """The generated CLAUDE.md knob table.  The linter's DOC rule diffs
+    this text against the committed block between the
+    `<!-- knob-table:begin -->` / `<!-- knob-table:end -->` markers."""
+    lines = [
+        "| knob | values | default | jit-static | read in | what it does |",
+        "|---|---|---|---|---|---|",
+    ]
+    def md(cell: str) -> str:  # literal pipes would split the table cell
+        return cell.replace("|", "\\|")
+
+    for kb in _KNOBS:
+        default = _UNSET if kb.default is None else f"`{kb.default}`"
+        lines.append(
+            f"| `{kb.name}` | {md(_values_col(kb))} | {default} "
+            f"| {'yes' if kb.jit_static else 'no'} | `{kb.module}` "
+            f"| {md(kb.doc)} |")
+    return "\n".join(lines)
+
+
+def cli_epilog() -> str:
+    """argparse epilog for the CLI: the registry's knob list, so `--help`
+    can never drift from the code (the DOC rule checks coverage)."""
+    lines = ["environment knobs (see `spgemm_tpu.cli knobs` for live "
+             "values; central registry: spgemm_tpu/utils/knobs.py):"]
+    for kb in _KNOBS:
+        default = "unset" if kb.default is None else kb.default
+        lines.append(f"  {kb.name}={_values_col(kb)} (default {default}): "
+                     f"{kb.doc}")
+    return "\n".join(lines)

@@ -18,6 +18,8 @@ import threading
 
 import numpy as np
 
+from spgemm_tpu.utils import knobs
+
 _DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_DIR, "smmio.cpp")
 _SYM_SRC = os.path.join(_DIR, "symbolic.cpp")
@@ -43,7 +45,7 @@ def _build() -> bool:
 def get_lib():
     """The loaded library, or None if unavailable/disabled."""
     global _lib, _tried
-    if os.environ.get("SPGEMM_TPU_NO_NATIVE"):
+    if knobs.get("SPGEMM_TPU_NO_NATIVE"):
         return None
     with _lock:
         if _tried:

@@ -1,0 +1,26 @@
+"""spgemm-lint BKD fixture: seeded module-import-time backend touches
+(a dead TPU hangs inside backend init -- only utils/backend_probe may
+touch a backend, and only lazily).  Never imported."""
+
+import jax
+import jax.numpy as jnp
+
+PLATFORM = jax.devices()[0].platform  # seeded BKD: runs at import
+
+_ZERO = jnp.zeros((8, 8), jnp.uint32)  # seeded BKD: materializing an array
+                                       # at import initializes the backend
+
+
+def bad_default(devs=jax.local_devices()):  # seeded BKD: default evaluates
+    return devs                             # at import time
+
+
+def legal_lazy_probe():
+    return jax.devices()[0].platform  # inside a function body: legal
+
+
+DTYPE = jnp.uint32  # attribute access, no call: legal
+
+
+if __name__ == "__main__":
+    print(jax.devices())  # script driver block, never runs on import: legal

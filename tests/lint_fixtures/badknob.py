@@ -1,0 +1,29 @@
+"""spgemm-lint KNB fixture: seeded raw SPGEMM_TPU_* environment reads
+(must go through spgemm_tpu/utils/knobs.py).  Never imported."""
+
+import os
+from os import environ
+
+
+def bad_environ_get():
+    return os.environ.get("SPGEMM_TPU_SEEDED_A", "1")  # seeded KNB
+
+
+def bad_getenv():
+    return os.getenv("SPGEMM_TPU_SEEDED_B")  # seeded KNB
+
+
+def bad_subscript():
+    return environ["SPGEMM_TPU_SEEDED_C"]  # seeded KNB
+
+
+def legal_non_knob_reads():
+    # non-SPGEMM_TPU names are not knobs: raw access stays legal
+    return os.environ.get("JAX_PLATFORMS", ""), os.getenv("HOME")
+
+
+def legal_knob_write():
+    # WRITES stay legal: A/B harnesses and tests drive knob values this
+    # way for code that then reads them through the registry
+    os.environ["SPGEMM_TPU_SEEDED_A"] = "0"
+    del environ["SPGEMM_TPU_SEEDED_C"]

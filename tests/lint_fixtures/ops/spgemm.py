@@ -1,0 +1,41 @@
+"""spgemm-lint FLD fixture: seeded unordered reductions.
+
+The `ops/spgemm.py` path suffix puts this file in the linter's numeric-
+module scope -- fixtures exercise exactly the production path-based
+scoping.  NEVER imported (tests parse it via lint_file); the code only
+needs to be syntactically valid.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def bad_jnp_sum(tiles):
+    return jnp.sum(tiles, axis=0)  # seeded FLD: unordered reduction
+
+
+def bad_psum(partial_tile):
+    return jax.lax.psum(partial_tile, "ring")  # seeded FLD
+
+
+def bad_segment_sum(flat, segs, n):
+    return jax.ops.segment_sum(flat, segs, num_segments=n)  # seeded FLD
+
+
+def bad_functools_reduce(tiles):
+    return functools.reduce(lambda a, b: a + b, set(tiles))  # seeded FLD
+
+
+def bad_method_sum(acc):
+    return acc.sum(axis=-1)  # seeded FLD: method spelling
+
+
+def escaped_proven_sum(tiles):
+    # spgemm-lint: fld-proof(fixture: safe_exact_bound holds, sum == fold)
+    return jnp.sum(tiles, axis=0)  # escaped: must NOT be a finding
+
+
+def legal_builtin_sum(values):
+    return sum(list(values))  # builtin left fold is ordered: legal

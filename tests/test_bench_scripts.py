@@ -100,6 +100,22 @@ def test_bench_outer_budget_kills_and_emits_json():
     assert "budget" in row["detail"]["error"]
 
 
+def test_bench_json_contract_survives_probe_failure():
+    """Driver-contract guard: when the backend PROBE fails (here the probe
+    subprocess times out instantly -- the observed dead-TPU hang mode),
+    bench.py must still exit 0 and end stdout with one valid JSON line,
+    honestly tagged with the fallback reason and the clamped CPU workload."""
+    rc = _run(["bench.py", "--chain", "2", "--block-dim", "8",
+               "--bandwidth", "1", "--k", "4", "--iters", "1"],
+              SPGEMM_TPU_PROBE_TIMEOUT="0.01")
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    last = rc.stdout.strip().splitlines()[-1]
+    row = json.loads(last)  # the LAST stdout line is the metric contract
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(row)
+    assert row["value"] > 0
+    assert "probe" in row["detail"]["fallback"]["reason"]
+
+
 def test_suite_skip_flag():
     """--skip yields a placeholder row, runs nothing, exits 0."""
     rc = _run([os.path.join("benchmarks", "run.py"),

@@ -1,0 +1,164 @@
+"""Central knob registry (spgemm_tpu/utils/knobs.py): typed validated
+accessors, live snapshot, the `spgemm_tpu.cli knobs` subcommand, and the
+generated-docs helpers the DOC lint rule consumes."""
+
+import json
+
+import pytest
+
+from spgemm_tpu.cli import run
+from spgemm_tpu.utils import knobs
+
+
+def test_defaults_when_unset(monkeypatch):
+    for name in knobs.REGISTRY:
+        monkeypatch.delenv(name, raising=False)
+    assert knobs.get("SPGEMM_TPU_VPU_ALGO") == "colbcast"
+    assert knobs.get("SPGEMM_TPU_VPU_PB") == 1
+    assert knobs.get("SPGEMM_TPU_ROUND_BATCH") is True
+    assert knobs.get("SPGEMM_TPU_DCN_CHUNK_MB") == 64.0
+    assert knobs.get("SPGEMM_TPU_HYBRID_GATE") is None       # platform-dep
+    assert knobs.get("SPGEMM_TPU_DCN_HEARTBEAT_S") is None   # jax default
+    assert knobs.get("SPGEMM_TPU_NO_NATIVE") is False        # flag
+    assert knobs.source("SPGEMM_TPU_VPU_ALGO") == "default"
+
+
+def test_env_values_parse_typed(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_VPU_PB", "4")
+    monkeypatch.setenv("SPGEMM_TPU_DCN_CHUNK_MB", "0.5")
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", "0")
+    monkeypatch.setenv("SPGEMM_TPU_NO_NATIVE", "1")
+    assert knobs.get("SPGEMM_TPU_VPU_PB") == 4
+    assert knobs.get("SPGEMM_TPU_DCN_CHUNK_MB") == 0.5
+    assert knobs.get("SPGEMM_TPU_RING_OVERLAP") is False
+    assert knobs.get("SPGEMM_TPU_NO_NATIVE") is True
+    assert knobs.source("SPGEMM_TPU_VPU_PB") == "env"
+
+
+def test_whitespace_and_empty_fall_back_to_default(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_DCN_CHUNK_MB", "  ")
+    assert knobs.get("SPGEMM_TPU_DCN_CHUNK_MB") == 64.0
+    assert knobs.source("SPGEMM_TPU_DCN_CHUNK_MB") == "default"
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", " 0 ")  # stripped
+    assert knobs.get("SPGEMM_TPU_RING_OVERLAP") is False
+
+
+@pytest.mark.parametrize("name,bad", [
+    ("SPGEMM_TPU_ROUND_BATCH", "yes"),
+    ("SPGEMM_TPU_RING_OVERLAP", "2"),
+    ("SPGEMM_TPU_VPU_ALGO", "bogus"),
+    ("SPGEMM_TPU_VPU_PB", "zero"),
+    ("SPGEMM_TPU_VPU_PB", "0"),
+    ("SPGEMM_TPU_OOC_DEPTH", "0"),
+    ("SPGEMM_TPU_DCN_CHUNK_MB", "-1"),
+    ("SPGEMM_TPU_DCN_CHUNK_MB", "lots"),
+    ("SPGEMM_TPU_HYBRID_GATE", "maybe"),
+])
+def test_invalid_values_raise_naming_the_knob(monkeypatch, name, bad):
+    """The round-5 contract ('a documented knob that crashes later' trap):
+    invalid values raise immediately and the message names the knob."""
+    monkeypatch.setenv(name, bad)
+    with pytest.raises(ValueError, match=name):
+        knobs.get(name)
+
+
+def test_unregistered_name_is_a_keyerror():
+    with pytest.raises(KeyError):
+        knobs.get("SPGEMM_TPU_NOT_A_KNOB")
+
+
+def test_snapshot_covers_registry(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_MXU_R", "16")
+    rows = {r["name"]: r for r in knobs.snapshot()}
+    assert set(rows) == set(knobs.REGISTRY)
+    assert rows["SPGEMM_TPU_MXU_R"]["value"] == "16"
+    assert rows["SPGEMM_TPU_MXU_R"]["source"] == "env"
+    assert rows["SPGEMM_TPU_MXU_R"]["default"] == "8"
+    assert rows["SPGEMM_TPU_VPU_ALGO"]["jit_static"] is True
+
+
+def test_cli_knobs_subcommand(capsys, monkeypatch):
+    """`spgemm_tpu.cli knobs`: every knob listed with value + source."""
+    monkeypatch.setenv("SPGEMM_TPU_OOC_DEPTH", "3")
+    assert run(["knobs"]) == 0
+    out = capsys.readouterr().out
+    for name in knobs.REGISTRY:
+        assert name in out
+    assert "(env, default 2)" in out  # the overridden OOC depth row
+
+
+def test_cli_knobs_subcommand_json(capsys, monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", "0")
+    assert run(["knobs", "--json"]) == 0
+    rows = {r["name"]: r for r in json.loads(capsys.readouterr().out)}
+    assert set(rows) == set(knobs.REGISTRY)
+    row = rows["SPGEMM_TPU_RING_OVERLAP"]
+    assert row["value"] == "0" and row["source"] == "env"
+
+
+def test_snapshot_survives_invalid_values(monkeypatch):
+    """Auditing a MISCONFIGURED session is the listing's whole point: an
+    invalid env value becomes a per-row error, never an aborted listing
+    (get() at the consuming call site stays strict)."""
+    monkeypatch.setenv("SPGEMM_TPU_VPU_PB", "bad")
+    rows = {r["name"]: r for r in knobs.snapshot()}
+    assert set(rows) == set(knobs.REGISTRY)  # every knob still listed
+    row = rows["SPGEMM_TPU_VPU_PB"]
+    assert row["value"].startswith("INVALID")
+    assert "SPGEMM_TPU_VPU_PB" in row["error"]
+    assert "error" not in rows["SPGEMM_TPU_MXU_R"]
+
+
+def test_cli_knobs_survives_invalid_values(capsys, monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", "maybe")
+    assert run(["knobs"]) == 0
+    out = capsys.readouterr().out
+    assert "INVALID" in out and "SPGEMM_TPU_RING_OVERLAP must be" in out
+    assert "SPGEMM_TPU_FORCE_1MROW" in out  # later rows still printed
+
+
+def test_cli_knobs_folder_keeps_old_meaning(tmp_path, monkeypatch, capsys):
+    """A pre-existing input directory named `knobs` must still run the
+    chain product -- the subcommand only fires when no such dir exists."""
+    import numpy as np
+
+    from spgemm_tpu.utils import io_text
+    from spgemm_tpu.utils.gen import random_chain
+
+    rng = np.random.default_rng(7)
+    mats = random_chain(2, 4, 2, 0.5, rng, "small")
+    io_text.write_chain_dir(str(tmp_path / "knobs"), mats, 2)
+    monkeypatch.chdir(tmp_path)
+    assert run(["knobs"]) == 0
+    assert "time taken " in capsys.readouterr().out  # the chain ran
+    assert (tmp_path / "matrix").exists()
+
+
+def test_cli_knobs_scratch_dir_does_not_swallow_subcommand(
+        tmp_path, monkeypatch, capsys):
+    """Only an INPUT dir (with the reference `size` file) disambiguates to
+    the matrix driver; an unrelated knobs/ scratch dir must not."""
+    (tmp_path / "knobs").mkdir()  # no `size` file inside
+    monkeypatch.chdir(tmp_path)
+    assert run(["knobs"]) == 0
+    assert "SPGEMM_TPU_VPU_ALGO" in capsys.readouterr().out
+
+
+def test_knob_table_lists_every_knob():
+    table = knobs.knob_table_md()
+    for name in knobs.REGISTRY:
+        assert f"`{name}`" in table
+
+
+def test_consumers_read_through_registry(monkeypatch):
+    """Spot-check the migrated call sites: the registry value actually
+    drives the engine predicates (not a stale copy of the old parsing)."""
+    from spgemm_tpu.ops.spgemm import round_batch_enabled
+    from spgemm_tpu.parallel.ring import overlap_enabled
+
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "0")
+    assert round_batch_enabled() is False
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "1")
+    assert round_batch_enabled() is True
+    monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", "0")
+    assert overlap_enabled() is False

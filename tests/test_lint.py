@@ -1,0 +1,173 @@
+"""spgemm-lint: the repo self-lints clean (tier-1 gate), and each seeded
+fixture violation (FLD/KNB/BKD/DOC) is caught with the correct rule ID --
+both in-process and through the `python -m spgemm_tpu.analysis --json`
+report that CI consumes."""
+
+import json
+import os
+
+from conftest import run_repo_script as _run
+from spgemm_tpu.analysis import (check_claude_md, core, docrules, lint_file,
+                                 lint_repo)
+
+REPO = core.repo_root()
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+FIXTURE_CLAUDE = os.path.join(FIXTURES, "CLAUDE.md")
+
+
+# ------------------------------------------------------- self-lint gate --
+def test_repo_self_lints_clean():
+    """The tier-1 contract: zero findings on the migrated repo -- package
+    AST rules AND the doc drift checks (CLAUDE.md knob table, CLI help)."""
+    findings = lint_repo()
+    assert findings == [], "\n".join(
+        f"{f.file}:{f.line}: [{f.rule}] {f.message}" for f in findings)
+
+
+def test_default_scope_covers_driver_scripts():
+    """bench.py / benchmarks / the graft entry read engine knobs too --
+    the default walk must keep them under the KNB/BKD contract."""
+    names = {os.path.basename(p) for p in core.default_paths()}
+    assert {"spgemm_tpu", "bench.py", "benchmarks",
+            "__graft_entry__.py"} <= names
+
+
+# ------------------------------------------------------------- FLD rule --
+def test_fld_fixture_each_violation_caught():
+    findings = lint_file(os.path.join(FIXTURES, "ops", "spgemm.py"))
+    fld = [f for f in findings if f.rule == "FLD"]
+    # jnp.sum, lax.psum, segment_sum, functools.reduce, method .sum()
+    assert len(fld) == 5
+    assert [f for f in findings if f.rule != "FLD"] == []
+    assert all(f.file.endswith("ops/spgemm.py") and f.line > 0 for f in fld)
+
+
+def test_fld_escape_hatch_suppresses_with_reason():
+    src = open(os.path.join(FIXTURES, "ops", "spgemm.py")).read()
+    escaped_line = next(i for i, ln in enumerate(src.splitlines(), 1)
+                        if "escaped: must NOT" in ln)
+    findings = lint_file(os.path.join(FIXTURES, "ops", "spgemm.py"))
+    assert escaped_line not in [f.line for f in findings]
+
+
+def test_fld_escape_requires_reason(tmp_path):
+    """A bare fld-proof() is not an escape: the reason is the citation."""
+    p = tmp_path / "ops" / "u64.py"  # numeric-path suffix
+    p.parent.mkdir()
+    p.write_text("import jax.numpy as jnp\n"
+                 "def f(x):\n"
+                 "    # spgemm-lint: fld-proof()\n"
+                 "    return jnp.sum(x)\n")
+    assert [f.rule for f in lint_file(str(p))] == ["FLD"]
+
+
+def test_fld_scope_is_path_based(tmp_path):
+    """The same reductions in a non-numeric module are not findings."""
+    p = tmp_path / "hostutil.py"
+    p.write_text("import jax.numpy as jnp\n"
+                 "def f(x):\n"
+                 "    return jnp.sum(x)\n")
+    assert lint_file(str(p)) == []
+    assert [f.rule for f in lint_file(str(p), numeric=True)] == ["FLD"]
+
+
+# ------------------------------------------------------------- KNB rule --
+def test_knb_fixture_each_violation_caught():
+    """The three READ spellings are findings; the write/del in the same
+    fixture (how harnesses and tests drive knob values) must NOT be."""
+    findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
+    assert [f.rule for f in findings] == ["KNB"] * 3
+    msgs = " ".join(f.message for f in findings)
+    for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
+                   "SPGEMM_TPU_SEEDED_C"):
+        assert seeded in msgs  # the finding names the offending knob
+
+
+def test_knb_registry_module_is_exempt():
+    """knobs.py itself reads the environment -- the one blessed reader."""
+    findings = lint_file(os.path.join(REPO, "spgemm_tpu", "utils",
+                                      "knobs.py"))
+    assert [f for f in findings if f.rule == "KNB"] == []
+
+
+# ------------------------------------------------------------- BKD rule --
+def test_bkd_fixture_each_violation_caught():
+    findings = lint_file(os.path.join(FIXTURES, "badbackend.py"))
+    # jax.devices() at module scope, jnp.zeros() at module scope (array
+    # materialization initializes the backend), jax.local_devices() in a
+    # default-argument expression
+    assert [f.rule for f in findings] == ["BKD"] * 3
+    flagged = [f.line for f in findings]
+    src = open(os.path.join(FIXTURES, "badbackend.py")).read()
+    lazy_line = next(i for i, ln in enumerate(src.splitlines(), 1)
+                     if "legal" in ln and "jax.devices" in ln)
+    main_line = next(i for i, ln in enumerate(src.splitlines(), 1)
+                     if "script driver" in ln)
+    assert lazy_line not in flagged and main_line not in flagged
+
+
+def test_bkd_probe_module_is_exempt():
+    findings = lint_file(os.path.join(REPO, "spgemm_tpu", "utils",
+                                      "backend_probe.py"))
+    assert [f for f in findings if f.rule == "BKD"] == []
+
+
+# ------------------------------------------------------------- DOC rule --
+def test_doc_fixture_drift_caught():
+    findings = check_claude_md(FIXTURE_CLAUDE)
+    assert [f.rule for f in findings] == ["DOC"]
+    assert "drifted" in findings[0].message
+
+
+def test_doc_current_table_passes_and_tamper_fails(tmp_path):
+    good = tmp_path / "CLAUDE.md"
+    good.write_text("# doc\n\n" + docrules.render_knob_block() + "\n")
+    assert check_claude_md(str(good)) == []
+    tampered = good.read_text().replace("SPGEMM_TPU_VPU_ALGO", "SPGEMM_TPU_GONE")
+    good.write_text(tampered)
+    assert [f.rule for f in check_claude_md(str(good))] == ["DOC"]
+    good.write_text("# no markers at all\n")
+    findings = check_claude_md(str(good))
+    assert [f.rule for f in findings] == ["DOC"]
+    assert "markers missing" in findings[0].message
+
+
+def test_doc_cli_help_covers_every_knob():
+    assert docrules.check_cli_help() == []
+
+
+# ----------------------------------------------------------- PARSE rule --
+def test_syntax_error_gets_its_own_rule_id(tmp_path):
+    """A broken file means NO rule ran on it: its finding must not be
+    attributed to a rule family in the JSON counts."""
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = lint_file(str(p))
+    assert [f.rule for f in findings] == ["PARSE"]
+    assert "does not parse" in findings[0].message
+
+
+# ------------------------------------------------- JSON report contract --
+def test_json_report_fixture_run():
+    """The machine-readable report: every rule family present with the
+    correct rule ID, (file, line, rule, message) per finding, exit 1."""
+    rc = _run(["-m", "spgemm_tpu.analysis", "--json", FIXTURES,
+               "--claude-md", FIXTURE_CLAUDE])
+    assert rc.returncode == 1, rc.stderr[-2000:]
+    report = json.loads(rc.stdout)
+    assert report["clean"] is False
+    assert report["counts"] == {"FLD": 5, "KNB": 3, "BKD": 3, "DOC": 1,
+                                "PARSE": 0}
+    for f in report["findings"]:
+        assert set(f) == {"file", "line", "rule", "message"}
+        assert f["rule"] in ("FLD", "KNB", "BKD", "DOC")
+        assert isinstance(f["line"], int) and f["line"] >= 1
+
+
+def test_json_report_clean_repo_run():
+    """`make lint` contract: the default run exits 0 with a clean report
+    (and never needs a backend -- the linter is jax-free by design)."""
+    rc = _run(["-m", "spgemm_tpu.analysis", "--json"])
+    assert rc.returncode == 0, rc.stdout + rc.stderr[-2000:]
+    report = json.loads(rc.stdout)
+    assert report["clean"] is True and report["findings"] == []

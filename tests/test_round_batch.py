@@ -75,6 +75,47 @@ def test_plan_rounds_batch_respects_entry_budget_and_round_size():
     assert all(r.pa.shape[0] <= 8 for r in capped)
 
 
+def test_smem_derived_chunk_cap_clamps_to_pow2():
+    """ROADMAP round-7 flag: at P <= 512 the Pallas kernels ship (P, K)
+    index arrays with the key axis in LANES, and Mosaic lane-pads K to
+    the next 128 multiple.  An SMEM-derived batch chunk cap landing on
+    the 3/4 ladder (K=192 here, from a 200-key budget) therefore shipped
+    a 256-wide array -- a silent 33% overshoot of the max_entries budget
+    it was solved from.  Batch mode must clamp SMEM-derived caps to the
+    pow2 floor so the lane-padded footprint stays within budget."""
+    from spgemm_tpu.ops.symbolic import JoinResult
+
+    P, n_keys, max_entries = 8, 200, 1600  # _smem_key_cap -> 1600/8 = 200
+    join = JoinResult(
+        keys=np.stack([np.zeros(n_keys, np.int64),
+                       np.arange(n_keys, dtype=np.int64)], axis=1),
+        pair_ptr=np.arange(n_keys + 1, dtype=np.int64) * P,
+        pair_a=np.zeros(n_keys * P, np.int32),
+        pair_b=np.zeros(n_keys * P, np.int32),
+    )
+    rounds = plan_rounds(join, a_sentinel=4, b_sentinel=4, round_size=None,
+                         max_entries=max_entries, batch=True)
+    covered = np.concatenate([r.key_index for r in rounds])
+    assert sorted(covered.tolist()) == list(range(n_keys))
+    for r in rounds:
+        K_pad, P_r = r.pa.shape
+        lane_padded_k = -(-K_pad // 128) * 128
+        pad8_p = -(-P_r // 8) * 8
+        assert pad8_p * lane_padded_k <= max_entries, (
+            f"round ships a {pad8_p} x {lane_padded_k}-entry index array "
+            f"after Mosaic padding -- past the {max_entries} SMEM budget")
+    # the finer 3/4 ladder must survive where the cap is NOT SMEM-derived
+    # (gather-entry budgets bound materialization, nothing lane-pads them)
+    gather = plan_rounds(join, a_sentinel=4, b_sentinel=4, round_size=None,
+                         batch=True, batch_entries=192 * P)
+    assert max(r.pa.shape[0] for r in gather) == 192
+    # below pad8(P) * 128 entries NO key-chunk width fits (Mosaic lane-pads
+    # K to >= 128): the planner must refuse loudly, never under-budget
+    with pytest.raises(ValueError, match="lane-pad"):
+        plan_rounds(join, a_sentinel=4, b_sentinel=4, round_size=None,
+                    max_entries=800, batch=True)
+
+
 def test_plan_rounds_split_fanout_partitions_classes():
     """split_fanout must partition a class's keys at the proof threshold:
     rounds on each side carry max_fanout <=/> the split."""
