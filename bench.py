@@ -358,6 +358,12 @@ def _run(args) -> int:
     # launch counters (chain total): the round-batching regression guard --
     # detail.dispatches must scale with shape classes, not rounds
     dispatches = counter_tables[times.index(best)].get("dispatches", 0)
+    # plan-cache counters are summed ACROSS iterations (ENGINE resets per
+    # iter): with iters >= 2 the repeat iterations must hit -- a row with
+    # misses == iters * multiplies and zero hits is the cache-regression
+    # signature, and the sum cannot flake on which iteration timed best
+    plan_hits = sum(t.get("plan_cache_hits", 0) for t in counter_tables)
+    plan_misses = sum(t.get("plan_cache_misses", 0) for t in counter_tables)
 
     # kernel-rate detail: a genuinely mid-chain SpGEMM (two level-1 partial
     # products, i.e. doubled bandwidth and real fill-in), same kernel
@@ -446,6 +452,13 @@ def _run(args) -> int:
             "phases_s": phases,
             "dispatches": dispatches,
             "round_batch": int(round_batch_enabled()),
+            # planner-pipeline observability: plan/plan_wait live in
+            # phases_s; the cache counters (summed over all iterations) +
+            # knob here make the whole-engine A/B (SPGEMM_TPU_PLAN_AHEAD=
+            # 0|2, repeated-structure runs) readable off any captured row
+            "plan_ahead": knobs.get("SPGEMM_TPU_PLAN_AHEAD"),
+            "plan_cache_hits": plan_hits,
+            "plan_cache_misses": plan_misses,
             **({"fallback": {
                 "reason": f"{args.cpu_fallback}; CPU with clamped workload",
                 "standing_evidence": "see the newest BENCH_r*.json with a "

@@ -43,6 +43,52 @@ def synth_join(n_keys: int, mean_fanout: int, nnzb_b: int,
     return JoinResult(keys=keys, pair_ptr=pair_ptr, pair_a=pair_a, pair_b=pair_b)
 
 
+def _synth_structure(n_blocks: int, blocks_per_row: int, k: int, seed: int):
+    """A sorted block-COO structure stand-in for the plan-cache path: only
+    coords/nnzb/k/val_bound are read by ops/spgemm.plan, so no tile slab
+    is ever materialized (this bench stays pure host-side)."""
+    from types import SimpleNamespace
+
+    rng = np.random.default_rng(seed)
+    side = max(2, int(np.ceil(np.sqrt(n_blocks / max(blocks_per_row, 1)))))
+    rows = rng.integers(0, side, size=n_blocks)
+    cols = rng.integers(0, side, size=n_blocks)
+    coords = np.unique(np.stack([rows, cols], axis=1), axis=0)
+    return SimpleNamespace(coords=coords.astype(np.int64),
+                           nnzb=len(coords), k=k, val_bound=0)
+
+
+def _repeat_structure_detail(args) -> dict:
+    """--repeat-structure: time the structure-keyed plan cache's hit path
+    (ops/plancache) against the cold plan, on a synthetic pair sized by
+    --keys.  backend/platform are passed resolved ('xla'/'cpu') so the
+    planner never touches a jax backend -- the module contract holds."""
+    from spgemm_tpu.ops import plancache
+    from spgemm_tpu.ops.spgemm import plan as plan_spgemm
+    from spgemm_tpu.utils import knobs
+
+    if not knobs.get("SPGEMM_TPU_PLAN_CACHE"):
+        raise SystemExit("--repeat-structure measures the plan-cache hit "
+                         "path; it cannot run with SPGEMM_TPU_PLAN_CACHE=0")
+    a = _synth_structure(args.keys, args.fanout, 8, seed=5)
+    b = _synth_structure(args.keys, args.fanout, 8, seed=6)
+    plancache.clear()
+    t0 = time.perf_counter()
+    cold = plan_spgemm(a, b, backend="xla", platform="cpu")
+    miss_s = time.perf_counter() - t0
+    hit_s = float("inf")
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        hot = plan_spgemm(a, b, backend="xla", platform="cpu")
+        hit_s = min(hit_s, time.perf_counter() - t0)
+        assert hot is cold, "structure fingerprint failed to hit"
+    stats = plancache.stats()
+    assert stats["hits"] >= args.repeats, stats
+    return {"plan_cache_hit_wall_s": round(hit_s, 6),
+            "plan_cache_miss_wall_s": round(miss_s, 4),
+            "plan_cache": stats}
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--keys", type=int, default=100_000)
@@ -50,7 +96,14 @@ def main() -> int:
     p.add_argument("--fanout", type=int, default=8)
     p.add_argument("--nnzb-b", type=int, default=100_000)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--repeat-structure", action="store_true",
+                   help="also measure the structure-keyed plan-cache hit "
+                        "path (ops/plancache): emits plan_cache_hit_wall_s "
+                        "next to the plan_ring_wall fields")
     args = p.parse_args()
+    if args.repeats < 1:
+        p.error("--repeats must be >= 1 (best-of timing needs a sample; "
+                "0 would serialize as non-JSON Infinity)")
 
     join = synth_join(args.keys, args.fanout, args.nnzb_b)
 
@@ -65,12 +118,15 @@ def main() -> int:
     ring_s = best_of(lambda: plan_ring(join, args.nnzb_b, args.devices))
     rounds_s = best_of(lambda: plan_rounds(
         join, a_sentinel=args.nnzb_b, b_sentinel=args.nnzb_b))
+    detail = {"keys": args.keys, "devices": args.devices,
+              "pairs": int(join.pair_ptr[-1]), "target_s": 1.0,
+              "plan_rounds_wall_s": round(rounds_s, 4)}
+    if args.repeat_structure:
+        detail.update(_repeat_structure_detail(args))
     print(json.dumps({
         "metric": "plan_ring_wall", "value": round(ring_s, 4), "unit": "s",
         "vs_baseline": None,
-        "detail": {"keys": args.keys, "devices": args.devices,
-                   "pairs": int(join.pair_ptr[-1]), "target_s": 1.0,
-                   "plan_rounds_wall_s": round(rounds_s, 4)},
+        "detail": detail,
     }))
     return 0
 

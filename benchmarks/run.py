@@ -84,6 +84,7 @@ def _spgemm_config(name, a, b, backend, parity=True, sampled_parity=0):
     from spgemm_tpu.ops.device import DeviceBlockMatrix
     from spgemm_tpu.ops.spgemm import spgemm_device
     from spgemm_tpu.ops.symbolic import symbolic_join
+    from spgemm_tpu.utils.timers import ENGINE
 
     da, db = DeviceBlockMatrix.from_host(a), DeviceBlockMatrix.from_host(b)
     da.block_until_ready()
@@ -92,10 +93,15 @@ def _spgemm_config(name, a, b, backend, parity=True, sampled_parity=0):
     flops = 2.0 * int(join.pair_ptr[-1]) * a.k ** 3
 
     spgemm_device(da, db, backend=backend).block_until_ready()  # warm
+    # the timed run repeats the warm run's structure, so with the plan
+    # cache on it IS the serving-path cache-hit row: phases_s.plan near
+    # zero, plan_cache_hits > 0 (the counters make that auditable per row)
+    ENGINE.reset()
     t0 = time.perf_counter()
     c = spgemm_device(da, db, backend=backend)
     c.block_until_ready()
     wall = time.perf_counter() - t0
+    counters = ENGINE.counter_snapshot()
 
     result = {
         "config": name, "backend": backend,
@@ -104,6 +110,9 @@ def _spgemm_config(name, a, b, backend, parity=True, sampled_parity=0):
         "tile_pairs": int(join.pair_ptr[-1]),
         "wall_s": round(wall, 4),
         "effective_gflops": round(flops / wall / 1e9, 2),
+        "phases_s": ENGINE.snapshot(),
+        "plan_cache_hits": counters.get("plan_cache_hits", 0),
+        "plan_cache_misses": counters.get("plan_cache_misses", 0),
     }
     if parity:
         from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
@@ -521,16 +530,16 @@ def write_table(rows, path=None):
              "round's `benchmarks/ROUND*_NOTES.md` records the capture "
              "context.",
              "",
-             "| config | backend | platform | wall s | eff. GFLOP/s | vs rowshard | parity |",
-             "|---|---|---|---|---|---|---|"]
+             "| config | backend | platform | wall s | eff. GFLOP/s | plan s (wait) | vs rowshard | parity |",
+             "|---|---|---|---|---|---|---|---|"]
     for r in rows:
         if "error" in r:
             err = r["error"][:60].replace("|", "\\|")
-            lines.append(f"| {r['config']} | — | — | — | — | — | ERROR: {err} |")
+            lines.append(f"| {r['config']} | — | — | — | — | — | — | ERROR: {err} |")
             continue
         if "skipped" in r:
             note = r["skipped"][:60].replace("|", "\\|")
-            lines.append(f"| {r['config']} | — | — | — | — | — | skipped: {note} |")
+            lines.append(f"| {r['config']} | — | — | — | — | — | — | skipped: {note} |")
             continue
         par = ""
         if "value_parity" in r:
@@ -555,8 +564,18 @@ def write_table(rows, path=None):
                 and r.get("platform") == rowshard_row.get("platform")):
             ratio = (f"{r['wall_s'] / rowshard_row['wall_s']:.2f}x "
                      "(target <=2.0x)")
+        # planner observability column: the timed run's host planning cost
+        # and how long dispatch blocked on it -- a cache-hit row (repeated
+        # structure) shows plan near zero with hits > 0
+        plan_col = ""
+        ph = r.get("phases_s") or {}
+        if "plan" in ph:
+            plan_col = f"{ph['plan']:.4g} ({ph.get('plan_wait', 0.0):.4g})"
+            if r.get("plan_cache_hits"):
+                plan_col += f", {r['plan_cache_hits']} cache hit(s)"
         lines.append(f"| {r['config']} | {r['backend']} | {r['platform']} | "
-                     f"{r['wall_s']} | {gf or ''} | {ratio} | {par} |")
+                     f"{r['wall_s']} | {gf or ''} | {plan_col} | {ratio} | "
+                     f"{par} |")
     sweep = _sweep_section()
     if not sweep:
         # no sweep capture on disk (the evidence dir's sweep.txt is

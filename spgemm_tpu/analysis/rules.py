@@ -49,6 +49,13 @@ BACKEND_CALLS = {
 # only calls are flagged.)
 BACKEND_NAMESPACES = ("jnp.", "jax.numpy.")
 
+# Functions marked with this decorator (utils/backend_probe.host_only) run
+# on host planner/worker threads -- the chain plan-ahead planner, OOC
+# staging helpers -- where a backend touch does not just hang: it hangs a
+# thread the main loop is blocked on, with no exception to fail over on.
+# Their WHOLE body is scanned for backend calls, not just import time.
+HOST_ONLY_DECORATOR = "host_only"
+
 
 def dotted_name(node: ast.expr) -> str | None:
     """'jax.lax.psum' for Attribute/Name chains; None for anything else
@@ -158,6 +165,35 @@ class _ImportTimeVisitor:
                 and len(t.comparators) == 1
                 and _str_const(t.comparators[0]) == "__main__")
 
+    @staticmethod
+    def _is_host_only(node: ast.AST) -> bool:
+        for dec in getattr(node, "decorator_list", ()):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = dotted_name(target)
+            if name is not None and (name == HOST_ONLY_DECORATOR
+                                     or name.endswith("." + HOST_ONLY_DECORATOR)):
+                return True
+        return False
+
+    def _scan_host_only(self, fn: ast.AST) -> None:
+        """Flag every backend-touching call anywhere in a @host_only body
+        (nested defs and lambdas included: they run on the same thread)."""
+        for stmt in fn.body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is not None and (name in BACKEND_CALLS
+                                         or name.startswith(BACKEND_NAMESPACES)):
+                    self.findings.append(Finding(
+                        self.file, node.lineno, "BKD",
+                        f"`{name}()` inside @host_only `{fn.name}`: "
+                        "planner/worker-thread helpers must never touch a "
+                        "backend (plans are pure numpy -- a backend hang "
+                        "on a worker thread wedges the pipeline with no "
+                        "exception to fail over on); resolve platform/"
+                        "backend on the main thread and pass them in"))
+
     def visit(self, node: ast.AST) -> None:
         if self._is_main_guard(node):
             return
@@ -167,7 +203,9 @@ class _ImportTimeVisitor:
             for default in (node.args.defaults + node.args.kw_defaults):
                 if default is not None:
                     self.visit(default)
-            return  # body runs only when called
+            if self._is_host_only(node):
+                self._scan_host_only(node)
+            return  # body runs only when called (host_only scanned above)
         if isinstance(node, ast.Lambda):
             for default in (node.args.defaults + node.args.kw_defaults):
                 if default is not None:

@@ -14,14 +14,106 @@ host-side control flow costs nothing by comparison (SURVEY.md C11).
 from __future__ import annotations
 
 import logging
+import queue as queue_mod
+import threading
 
+from spgemm_tpu.utils import knobs
+from spgemm_tpu.utils.backend_probe import host_only
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.timers import ENGINE
 
 log = logging.getLogger("spgemm_tpu.chain")
 
 
 def _to_host(m):
     return m.to_host() if hasattr(m, "to_host") else m
+
+
+class _PlanAheadWorker:
+    """Bounded host planner worker for one reduction pass.
+
+    All pairs of a pass are independent, so while the device executes pair
+    i the worker plans pairs i+1..i+ahead (SPGEMM_TPU_PLAN_AHEAD, default
+    2) -- the OOC pipeline's worker discipline applied to the planner.
+    Plans are consumed strictly in pair order; the semaphore bounds the
+    unconsumed-plan backlog (each plan holds padded index arrays on host
+    RAM).  The worker must never touch a backend (the BKD contract --
+    utils/backend_probe.host_only): the caller resolves backend/platform
+    on the main thread and the worker plans pure numpy from there.
+    """
+
+    def __init__(self, pairs, planner, ahead: int):
+        self._outq: queue_mod.Queue = queue_mod.Queue()
+        self._stop = threading.Event()
+        self._sem = threading.Semaphore(ahead)
+        self._thread = threading.Thread(
+            target=self._work, args=(list(pairs), planner),
+            name="chain-planner", daemon=True)
+        self._thread.start()
+
+    @host_only
+    def _work(self, pairs, planner):
+        try:
+            for i, (a, b) in enumerate(pairs):
+                while not self._sem.acquire(timeout=0.2):
+                    if self._stop.is_set():
+                        return
+                if self._stop.is_set():
+                    return
+                self._outq.put((i, planner(a, b), None))
+                pairs[i] = None  # drop the operand refs as soon as planned
+        except Exception as e:  # noqa: BLE001 -- re-raised on the consumer
+            self._outq.put((None, None, e))
+
+    def get(self):
+        """Next pair's plan, in order; re-raises a worker failure.  The
+        blocking span is the pipeline's honest 'planner was late' cost --
+        the caller times it as plan_wait."""
+        with ENGINE.phase("plan_wait"):
+            i, plan, err = self._outq.get()
+        self._sem.release()
+        if err is not None:
+            raise err
+        return i, plan
+
+    def close(self):
+        """Shut the worker down and wait for it (also on a mid-pass
+        failure: a planner left running would pin the pass's operands,
+        compete with a failover retry for CPU, and bleed its plan phase /
+        cache counters into ENGINE mid-retry).  The worker notices the
+        stop flag within 0.2 s unless inside planner() -- the bounded
+        join covers one in-flight plan (host numpy, ms-scale); the
+        daemon flag keeps a pathological plan from pinning exit."""
+        self._stop.set()
+        self._thread.join(timeout=30.0)
+
+
+def _plan_ahead_depth() -> int:
+    """SPGEMM_TPU_PLAN_AHEAD (default 2): 0 = legacy inline planning."""
+    return knobs.get("SPGEMM_TPU_PLAN_AHEAD")
+
+
+def _make_planner(multiply, kwargs):
+    """A (a, b) -> SpgemmPlan closure for the plan-ahead worker, or None
+    when the pipeline does not apply: planning only exists for the
+    plan/execute-split engine (ops.spgemm.spgemm_device), and the
+    backend/platform must resolve on the MAIN thread (the one allowed to
+    touch -- and hang on -- a backend) before any worker starts."""
+    from spgemm_tpu.ops import spgemm as spgemm_mod  # noqa: PLC0415
+
+    if multiply is not spgemm_mod.spgemm_device:
+        return None
+    import jax  # noqa: PLC0415
+
+    platform = jax.devices()[0].platform
+    backend = spgemm_mod.resolve_backend(kwargs.get("backend"))
+    round_size = kwargs.get("round_size")
+
+    def planner(a, b):
+        return spgemm_mod.plan(a, b, round_size=round_size,
+                               backend=backend, platform=platform)
+
+    return planner
 
 
 def oracle_multiply(a: BlockSparseMatrix, b: BlockSparseMatrix,
@@ -74,20 +166,42 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
     # shared with the checkpoint writer and the final return).
     need_host = failover or bool(checkpoint_dir)
     arr_host = [_to_host(m) for m in arr] if failover else None
+    # plan-ahead pipeline (read the knob once up front so an invalid value
+    # raises before any multiply): a bounded host planner worker plans pair
+    # i+1..i+ahead while the device executes pair i.  0 = legacy inline
+    # planning -- bit-identical either way (planning is deterministic and
+    # dispatch order is unchanged), so the knob is a whole-engine A/B.
+    ahead = _plan_ahead_depth()
     while len(arr) > 1:
         try:
             nxt = []
             odd_carry = arr[-1] if len(arr) % 2 == 1 else None
-            for i in range(0, len(arr) - 1, 2):
-                # the reference's :301 progress line -- printed
-                # unconditionally to stdout, as sparse_matrix_mult.cu does
-                print(f"multiplying {i} {i + 1}", flush=True)
-                nxt.append(multiply(arr[i], arr[i + 1], **kwargs))
-                # drop consumed partials so their HBM frees as soon as the
-                # dependent computations drain (pass >= 1 operands are
-                # device-resident and otherwise pinned for the whole pass;
-                # failover restarts from arr_host, never from these)
-                arr[i] = arr[i + 1] = None
+            pairs = [(arr[i], arr[i + 1]) for i in range(0, len(arr) - 1, 2)]
+            planner = _make_planner(multiply, kwargs) \
+                if ahead > 0 and len(pairs) > 1 else None
+            worker = _PlanAheadWorker(pairs, planner, ahead) \
+                if planner is not None else None
+            try:
+                for p, (ma, mb) in enumerate(pairs):
+                    i = 2 * p
+                    # the reference's :301 progress line -- printed
+                    # unconditionally to stdout, as sparse_matrix_mult.cu does
+                    print(f"multiplying {i} {i + 1}", flush=True)
+                    if worker is not None:
+                        got, pln = worker.get()
+                        assert got == p  # the worker plans strictly in order
+                        nxt.append(multiply(ma, mb, plan=pln, **kwargs))
+                    else:
+                        nxt.append(multiply(ma, mb, **kwargs))
+                    # drop consumed partials so their HBM frees as soon as
+                    # the dependent computations drain (pass >= 1 operands
+                    # are device-resident and otherwise pinned for the whole
+                    # pass; failover restarts from arr_host, never these)
+                    arr[i] = arr[i + 1] = None
+                    pairs[p] = None
+            finally:
+                if worker is not None:
+                    worker.close()
             if odd_carry is not None:
                 nxt.append(odd_carry)  # odd element carried (:315-321)
             nxt_host = [_to_host(m) for m in nxt] if need_host else None

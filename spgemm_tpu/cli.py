@@ -126,13 +126,26 @@ def run_knobs(argv: list[str]) -> int:
                                 description="list engine env knobs: "
                                 "current value, default, and source")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="machine-readable: one JSON object per knob")
+                   help="machine-readable: {knobs: [one object per knob], "
+                        "plan_cache: live hit/miss/capacity stats}")
     args = p.parse_args(argv)
     rows = knobs_registry.snapshot()
+    # live plan-cache state next to the knob rows (jax-free import): the
+    # whole-engine A/B pair SPGEMM_TPU_PLAN_AHEAD=0|2 and the cache knobs
+    # are inspectable together without a bench run
+    from spgemm_tpu.ops import plancache  # noqa: PLC0415
+
+    try:
+        cache = plancache.stats()
+    except ValueError as e:
+        # an INVALID cache-knob value must not abort the listing (the
+        # per-knob rows above already carry the error); report it in place
+        cache = {"hits": 0, "misses": 0, "entries": 0,
+                 "capacity": "?", "enabled": "?", "error": str(e)}
     if args.as_json:
         import json  # noqa: PLC0415
 
-        print(json.dumps(rows, indent=2))
+        print(json.dumps({"knobs": rows, "plan_cache": cache}, indent=2))
         return 0
     name_w = max(len(r["name"]) for r in rows)
     val_w = max(len(r["value"]) for r in rows)
@@ -144,6 +157,13 @@ def run_knobs(argv: list[str]) -> int:
             if r.get("error"):
                 print(f"{'':<{name_w}}  !! {r['error']}")
             print(f"{'':<{name_w}}  {r['doc']}  [{r['module']}]")
+        enabled = cache["enabled"]
+        print(f"plan cache: hits={cache['hits']} misses={cache['misses']} "
+              f"entries={cache['entries']}/{cache['capacity']} "
+              f"enabled={enabled if enabled == '?' else int(enabled)}"
+              "  [ops/plancache.py]")
+        if cache.get("error"):
+            print(f"  !! {cache['error']}")
     except BrokenPipeError:
         # `spgemm_tpu knobs | head` closing the pipe is not an error for a
         # listing; swap in devnull so the interpreter's exit flush of

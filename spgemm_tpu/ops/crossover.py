@@ -40,14 +40,21 @@ log = logging.getLogger("spgemm_tpu.crossover")
 _CACHE: dict[str, dict] = {}
 
 
-def gate_policy() -> str:
-    """'auto' or 'proof' (see module docstring)."""
+def gate_policy(platform: str | None = None) -> str:
+    """'auto' or 'proof' (see module docstring).
+
+    platform None resolves from the live jax backend (a backend touch --
+    main thread only).  Host-only callers (the plan-side hybrid split in
+    ops/spgemm, planner worker threads) pass the platform they resolved up
+    front, keeping this a pure env+string function there."""
     env = knobs.get("SPGEMM_TPU_HYBRID_GATE")
     if env is not None:
         return env
-    import jax  # noqa: PLC0415
+    if platform is None:
+        import jax  # noqa: PLC0415
 
-    return "auto" if jax.devices()[0].platform == "tpu" else "proof"
+        platform = jax.devices()[0].platform
+    return "auto" if platform == "tpu" else "proof"
 
 
 def _cache_path() -> str:

@@ -1,0 +1,114 @@
+"""Structure-keyed LRU cache for SpgemmPlan (ops/spgemm.plan).
+
+The host-side symbolic planner (join + round bucketing + assembly
+permutation) is deterministic in the operands' *structure* plus the plan
+parameters -- identical sparsity patterns re-plan to identical rounds.
+KokkosKernels-style SpGEMM (Deveci et al.) treats symbolic-structure reuse
+across multiplies as a first-class optimization; here it is a content
+fingerprint over the block-coordinate arrays, so repeated inputs (the
+serving scenario, bench re-runs, failover retries) skip the planner
+entirely.
+
+jax-free by design: this module is imported by the CLI `knobs` listing and
+by planner WORKER threads (chain.py plan-ahead), neither of which may
+touch a backend (the BKD contract -- plans are pure numpy).
+
+Knobs (central registry, utils/knobs.py):
+  SPGEMM_TPU_PLAN_CACHE     0|1 (default 1) -- memoization on/off.
+  SPGEMM_TPU_PLAN_CACHE_CAP int >= 1 (default 32) -- LRU capacity; plans
+    hold the padded pa/pb index arrays (~pair count x 8 bytes), so the cap
+    bounds host RAM, not correctness.
+
+Live stats (`stats()`) are surfaced by `spgemm_tpu.cli knobs [--json]`
+next to the knob rows; the engine additionally mirrors hit/miss events
+into the ENGINE timer registry (`plan_cache_hits`/`plan_cache_misses`
+counters) so they flow into bench detail and suite rows per run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from spgemm_tpu.utils import knobs
+
+_LOCK = threading.Lock()
+_CACHE: "OrderedDict[str, object]" = OrderedDict()
+_STATS = {"hits": 0, "misses": 0}
+
+
+def enabled() -> bool:
+    """SPGEMM_TPU_PLAN_CACHE=0|1 (default 1)."""
+    return knobs.get("SPGEMM_TPU_PLAN_CACHE")
+
+
+def capacity() -> int:
+    """SPGEMM_TPU_PLAN_CACHE_CAP (default 32): LRU entry cap, re-read per
+    put so tests/harnesses may resize mid-process."""
+    return knobs.get("SPGEMM_TPU_PLAN_CACHE_CAP")
+
+
+def fingerprint(a_coords: np.ndarray, b_coords: np.ndarray,
+                meta: tuple) -> str:
+    """Content fingerprint of (operand structures, plan parameters).
+
+    Hashes the raw coordinate bytes (shape + dtype included -- two
+    different-shape arrays must never collide through tobytes()) plus the
+    repr of the caller's parameter tuple (k, sentinels, backend, platform,
+    round_size, batch flag, hybrid split threshold, jit-static knob
+    vector).  sha256 over a few MB of coords is ~ms -- orders of magnitude
+    under the join it saves."""
+    h = hashlib.sha256()
+    for arr in (a_coords, b_coords):
+        arr = np.ascontiguousarray(arr)
+        h.update(repr((arr.shape, str(arr.dtype))).encode())
+        h.update(arr.tobytes())
+        h.update(b"|")
+    h.update(repr(meta).encode())
+    return h.hexdigest()
+
+
+def lookup(key: str):
+    """Cached plan for key, or None; a hit moves the entry to MRU."""
+    with _LOCK:
+        plan = _CACHE.get(key)
+        if plan is None:
+            _STATS["misses"] += 1
+            return None
+        _CACHE.move_to_end(key)
+        _STATS["hits"] += 1
+        return plan
+
+
+def store(key: str, plan) -> None:
+    """Insert (or refresh) a plan; evicts LRU entries past the cap."""
+    cap = capacity()
+    with _LOCK:
+        _CACHE[key] = plan
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > cap:
+            _CACHE.popitem(last=False)
+
+
+def stats() -> dict:
+    """Live per-process cache state, for `spgemm_tpu.cli knobs` and bench
+    detail: hits/misses since process start (or the last clear), current
+    entry count, and the configured knob values."""
+    with _LOCK:
+        return {
+            "hits": _STATS["hits"],
+            "misses": _STATS["misses"],
+            "entries": len(_CACHE),
+            "capacity": capacity(),
+            "enabled": enabled(),
+        }
+
+
+def clear() -> None:
+    """Drop every entry and zero the stats (tests, A/B harnesses)."""
+    with _LOCK:
+        _CACHE.clear()
+        _STATS["hits"] = _STATS["misses"] = 0

@@ -20,16 +20,19 @@ provides the Pallas TPU kernel for the same contract (selected via backend=).
 from __future__ import annotations
 
 import logging
+import time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spgemm_tpu.ops import u64
+from spgemm_tpu.ops import plancache, u64
 from spgemm_tpu.utils import knobs
-from spgemm_tpu.ops.symbolic import (accept_round_stack, assembly_permutation,
-                                     plan_rounds, symbolic_join)
+from spgemm_tpu.ops.symbolic import (SpgemmPlan, accept_round_stack,
+                                     assembly_permutation, plan_rounds,
+                                     symbolic_join)
+from spgemm_tpu.utils.backend_probe import host_only
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 
 log = logging.getLogger("spgemm_tpu.spgemm")
@@ -167,9 +170,13 @@ def _proof_fanout_cap(a_bound: int, b_bound: int, k: int) -> int | None:
     return cap if cap < (1 << 63) else None
 
 
-def resolve_backend(backend: str | None) -> str:
+def resolve_backend(backend: str | None, platform: str | None = None) -> str:
     """None -> 'pallas' on TPU, 'xla' elsewhere (the Pallas kernel runs in
     interpret mode on CPU, which is correct but slow -- tests opt in).
+
+    platform None resolves from the live jax backend (a backend touch --
+    main thread only); host-only callers pass the platform they resolved
+    up front, same contract as crossover.gate_policy.
 
     Other values: 'mxu' = field-mode limb matmul on the systolic array
     (clean mod-(2^64-1) semantics, ops/pallas_mxu.py on TPU); 'hybrid' =
@@ -178,13 +185,45 @@ def resolve_backend(backend: str | None) -> str:
     the mixed result is always reference-bit-exact."""
     if backend is not None:
         return backend
-    return "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+    if platform is None:
+        platform = jax.devices()[0].platform
+    return "pallas" if platform == "tpu" else "xla"
+
+
+def _plan_budgets(backend: str, platform: str | None = None):
+    """(max_entries, default_round_size) for a resolved backend -- THE
+    single source of the per-backend round budgets, consumed by BOTH the
+    plan side (ops/spgemm.plan, which must never touch a jax backend on
+    planner worker threads) and the execute side (_select_numeric /
+    _hybrid_setup), so the two can never drift.  Pure function of
+    (backend, platform); platform matters only for mxu/hybrid (the Pallas
+    MXU kernel exists on TPU only)."""
+    if backend == "pallas":
+        # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
+        # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to
+        # 8), not by gather materialization: merge key chunks into fewer,
+        # bigger launches.  An explicit round_size still caps the key axis.
+        return 64 * 1024, 8192
+    if backend == "xla":
+        return None, 512
+    if backend == "mxu":
+        return (64 * 1024, 8192) if platform == "tpu" else (None, 512)
+    if backend == "hybrid":
+        exact = "pallas" if platform == "tpu" else "xla"
+        max_entries, default_rs = _plan_budgets(exact, platform)
+        mxu_entries, _ = _plan_budgets("mxu", platform)
+        # plan under the tighter budget so both kernels accept every round
+        if mxu_entries is not None and (max_entries is None
+                                        or mxu_entries < max_entries):
+            max_entries = mxu_entries
+        return max_entries, default_rs
+    raise ValueError(f"unknown backend {backend!r}")
 
 
 def _select_numeric(backend: str, a, b):
     """Resolve a concrete backend name to (numeric_fn, max_entries,
     default_round_size) for operands a, b (their val_bounds parameterize
-    the MXU limb grids)."""
+    the MXU limb grids); budgets come from _plan_budgets."""
     if backend == "pallas":
         from spgemm_tpu.ops.pallas_spgemm import (  # noqa: PLC0415
             numeric_round_pallas, validate_vpu_config)
@@ -205,18 +244,15 @@ def _select_numeric(backend: str, a, b):
                             interpret=platform == "cpu")
         numeric = partial(numeric_round_pallas, algo=algo,
                           pair_block=pair_block)
-        # Pallas rounds are bounded by SMEM-resident index arrays (SMEM is
-        # ~1 MB and holds pa+pb, shipped (P, K) with P sublane-padded to 8),
-        # not by gather materialization: merge key chunks into fewer, bigger
-        # launches.  An explicit round_size still caps the key axis.
-        return numeric, 64 * 1024, 8192
+        return (numeric, *_plan_budgets("pallas", platform))
     if backend == "xla":
-        return _numeric_round, None, 512
+        return (_numeric_round, *_plan_budgets("xla"))
     if backend == "mxu":
         # Pallas-grid MXU limb kernel on TPU (ops/pallas_mxu.py); the XLA
         # batched-matmul formulation elsewhere (it is the better CPU lowering
         # and the cross-check oracle for the kernel).
-        if jax.devices()[0].platform == "tpu":
+        platform = jax.devices()[0].platform
+        if platform == "tpu":
             from spgemm_tpu.ops.pallas_mxu import (  # noqa: PLC0415
                 limbs_for_bound, numeric_round_mxu_pallas)
 
@@ -229,10 +265,10 @@ def _select_numeric(backend: str, a, b):
                               a_limbs=limbs_for_bound(a.val_bound),
                               b_limbs=limbs_for_bound(b.val_bound),
                               pair_width=knobs.get("SPGEMM_TPU_MXU_R"))
-            return numeric, 64 * 1024, 8192
+            return (numeric, *_plan_budgets("mxu", platform))
         from spgemm_tpu.ops.mxu_spgemm import numeric_round_mxu  # noqa: PLC0415
 
-        return numeric_round_mxu, None, 512
+        return (numeric_round_mxu, *_plan_budgets("mxu", platform))
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -261,26 +297,24 @@ def _hybrid_setup(a, b, k):
     from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
     from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
 
-    exact_name = resolve_backend(None)
-    numeric_exact, max_entries, default_rs = _select_numeric(exact_name, a, b)
-    numeric_mxu, mxu_entries, _ = _select_numeric("mxu", a, b)
+    platform = jax.devices()[0].platform
+    exact_name = resolve_backend(None, platform)
+    numeric_exact, _, _ = _select_numeric(exact_name, a, b)
+    numeric_mxu, _, _ = _select_numeric("mxu", a, b)
     # proven-round exact kernel: under the same proof that licenses the MXU
     # route, both mod_max collapses are identity and the VPU kernel drops
     # them (u64.mac_nomod, 28 vs 36 ops/MAC) -- a strict op-subset of the
     # exact kernel, so no separate speed measurement is needed
     numeric_exact_proven = (partial(numeric_exact, no_mod=True)
                             if exact_name == "pallas" else numeric_exact)
-    # plan under the tighter budget so both kernels accept every round
-    if mxu_entries is not None and (max_entries is None
-                                    or mxu_entries < max_entries):
-        max_entries = mxu_entries
+    # the shared budget table already applies the tighter-of-both rule so
+    # both kernels accept every round (and the plan side agrees)
+    max_entries, default_rs = _plan_budgets("hybrid", platform)
     bounds_ok = a.val_bound is not None and b.val_bound is not None
 
-    gate = crossover.gate_policy()
+    gate = crossover.gate_policy(platform)
     key_prefix = None
     if gate == "auto" and bounds_ok:
-        import jax  # noqa: PLC0415
-
         dev = jax.devices()[0]
         algo = knobs.get("SPGEMM_TPU_VPU_ALGO")
         pb = knobs.get("SPGEMM_TPU_VPU_PB")
@@ -335,67 +369,142 @@ def _hybrid_setup(a, b, k):
     return numeric_exact, max_entries, default_rs, choose_numeric
 
 
-def spgemm_device(a, b, *, round_size: int | None = None,
-                  backend: str | None = None):
-    """C = A x B with reference-exact semantics, tiles staying in HBM.
+def _val_bound(m) -> int | None:
+    """Inclusive element-value bound of an operand, matching what
+    DeviceBlockMatrix.from_host would compute: the tracked val_bound for a
+    device matrix, the exact slab maximum for a host matrix (so a plan
+    built from the host operand is identical to one built after upload)."""
+    vb = getattr(m, "val_bound", None)
+    if vb is not None:
+        return vb
+    tiles = getattr(m, "tiles", None)
+    if tiles is not None:
+        return int(tiles.max()) if len(tiles) else 0
+    return None
 
-    a, b: DeviceBlockMatrix (or host BlockSparseMatrix -- uploaded on entry).
-    Returns a DeviceBlockMatrix; no tile data crosses the device boundary,
-    which inverts the reference's pack/H2D/D2H round-trip per multiply
-    (sparse_matrix_mult.cu:189-269, 27% of its report's total time).
-    """
+
+def _static_knob_vector() -> tuple:
+    """Every jit-static knob's current value, for the plan-cache key: the
+    registry guarantees these never vary inside a traced region, so they
+    are exactly the knobs a cached plan may NOT straddle."""
+    return tuple((kb.name, str(knobs.get(kb.name)))
+                 for kb in knobs.REGISTRY.values() if kb.jit_static)
+
+
+def plan(a, b, *, round_size: int | None = None, backend: str | None = None,
+         platform: str | None = None) -> SpgemmPlan:
+    """Host-only planning half of spgemm_device: join + rounds + assembly
+    permutation (+ lazily, ring/rowshard schedules via the SpgemmPlan
+    hooks), memoized by operand-structure fingerprint (ops/plancache).
+
+    backend/platform None resolve from the live jax backend -- a MAIN
+    THREAD convenience.  Planner worker threads (chain.py plan-ahead) must
+    pass both resolved so the body stays pure numpy: a dead TPU hangs
+    inside backend init, and a hang on a worker thread wedges the pipeline
+    with no exception to fail over on (the BKD contract, machine-checked
+    for @host_only helpers by spgemm-lint)."""
+    if platform is None:
+        platform = jax.devices()[0].platform
+    backend = resolve_backend(backend, platform)
+    return _plan_host(a, b, round_size=round_size, backend=backend,
+                      platform=platform)
+
+
+@host_only
+def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
+    """The pure-numpy plan builder (see plan()).  Operands need only
+    coords/nnzb/k and a value bound (val_bound attr or host tiles)."""
+    from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
+
+    if a.k != b.k:
+        raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
+    k = a.k
+    t0 = time.perf_counter()
+    with timers.phase("plan"):
+        batch = round_batch_enabled()
+        split = None
+        if backend == "hybrid" and batch:
+            a_bound, b_bound = _val_bound(a), _val_bound(b)
+            if a_bound is not None and b_bound is not None:
+                split = _proof_fanout_cap(a_bound, b_bound, k)
+        key = None
+        if plancache.enabled():
+            key = plancache.fingerprint(
+                a.coords, b.coords,
+                meta=(k, a.nnzb, b.nnzb, backend, platform, round_size,
+                      batch, split, _static_knob_vector()))
+            hit = plancache.lookup(key)
+            if hit is not None:
+                timers.incr("plan_cache_hits")
+                return hit
+            timers.incr("plan_cache_misses")
+        with timers.phase("symbolic_join"):
+            join = symbolic_join(a.coords, b.coords)
+        max_entries, default_rs = _plan_budgets(backend, platform)
+        with timers.phase("plan_rounds"):
+            if batch:
+                # round-batched dispatch: one mega-round per fanout class
+                # (partitioned at the hybrid proof threshold so kernel
+                # routing stays key-exact), bounded by the gather/SMEM
+                # budgets.  An explicit round_size still caps the key axis.
+                rounds = plan_rounds(join, a_sentinel=a.nnzb,
+                                     b_sentinel=b.nnzb,
+                                     round_size=round_size,
+                                     max_entries=max_entries, batch=True,
+                                     batch_entries=_batch_entries(k),
+                                     split_fanout=split)
+            else:
+                rs = default_rs if round_size is None else round_size
+                rounds = plan_rounds(join, a_sentinel=a.nnzb,
+                                     b_sentinel=b.nnzb, round_size=rs,
+                                     max_entries=max_entries)
+            # the assembly gather's inverse permutation is precomputed on
+            # host here, off the dispatch/assembly spans
+            take = assembly_permutation(rounds, join.num_keys) if batch \
+                else None
+        p = SpgemmPlan(backend=backend, platform=platform, k=k,
+                       a_nnzb=a.nnzb, b_nnzb=b.nnzb, join=join,
+                       rounds=rounds, take=take, batch=batch,
+                       round_size=round_size, split_fanout=split,
+                       fingerprint=key,
+                       plan_s=time.perf_counter() - t0,
+                       _a_coords=np.asarray(a.coords),
+                       _b_coords=np.asarray(b.coords))
+        if key is not None:
+            plancache.store(key, p)
+        return p
+
+
+def execute(plan: SpgemmPlan, a, b):
+    """Device-only execution half of spgemm_device: kernel selection,
+    numeric dispatch, on-device assembly.  Everything host-decidable lives
+    in the SpgemmPlan; this function owns every backend touch (crossover
+    measurement included), so it must run on the main thread."""
     from spgemm_tpu.ops.device import DeviceBlockMatrix, ensure_device  # noqa: PLC0415
 
     from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
 
     a = ensure_device(a)
     b = ensure_device(b)
-    if a.k != b.k:
-        raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
-    k = a.k
-    with timers.phase("symbolic_join"):
-        join = symbolic_join(a.coords, b.coords)
+    plan.check_operands(a, b)
+    k = plan.k
+    join, rounds, batch = plan.join, plan.rounds, plan.batch
     if join.num_keys == 0:
         return DeviceBlockMatrix.empty(a.rows, b.cols, k)
 
-    backend = resolve_backend(backend)
+    backend = plan.backend
     out_bound = (1 << 64) - 2  # any backend's outputs are mod-collapsed
     choose_numeric = None  # per-round dispatcher (hybrid only)
     if backend == "hybrid":
-        numeric, max_entries, default_rs, choose_numeric = _hybrid_setup(a, b, k)
+        numeric, _, _, choose_numeric = _hybrid_setup(a, b, k)
     else:
-        numeric, max_entries, default_rs = _select_numeric(backend, a, b)
-
-    batch = round_batch_enabled()
-    with timers.phase("plan_rounds"):
-        if batch:
-            # round-batched dispatch: one mega-round per fanout class
-            # (partitioned at the hybrid proof threshold so kernel routing
-            # stays key-exact), bounded by the gather/SMEM budgets.  An
-            # explicit round_size still caps the key axis.
-            split = None
-            if (choose_numeric is not None and a.val_bound is not None
-                    and b.val_bound is not None):
-                split = _proof_fanout_cap(a.val_bound, b.val_bound, k)
-            rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                                 round_size=round_size,
-                                 max_entries=max_entries, batch=True,
-                                 batch_entries=_batch_entries(k),
-                                 split_fanout=split)
-        else:
-            round_size = default_rs if round_size is None else round_size
-            rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                                 round_size=round_size,
-                                 max_entries=max_entries)
-        # the assembly gather's inverse permutation is precomputed on host
-        # here, off the dispatch/assembly spans
-        take_np = assembly_permutation(rounds, join.num_keys) if batch else None
+        numeric, _, _ = _select_numeric(backend, a, b)
 
     # All rounds dispatch asynchronously; outputs are assembled into one
     # key-ordered slab on device, never touching host.  Timed phases are
     # host-side spans (dispatch, not device completion -- the device tail is
     # the caller's block_until_ready); the reference's Table-2 analog phases
-    # are symbolic_join / plan_rounds / numeric_dispatch / assembly.
+    # are plan (symbolic_join + plan_rounds) / numeric_dispatch / assembly.
     mxu_rounds = proof_rounds = 0
     with timers.phase("numeric_dispatch"):
         outs_h, outs_l, order = [], [], []
@@ -427,7 +536,7 @@ def spgemm_device(a, b, *, round_size: int | None = None,
             # legacy path's per-round slice + unjitted concat chain enqueued
             # 2-3 executables PER ROUND -- enough to stall the host on the
             # backend's in-flight dispatch throttle at chain scales)
-            out_hi, out_lo = _assemble(outs_h, outs_l, jnp.asarray(take_np))
+            out_hi, out_lo = _assemble(outs_h, outs_l, jnp.asarray(plan.take))
         else:
             # inv[key] = position of that key in the concatenated round
             # outputs; the extra last entry maps the sentinel slot to the
@@ -466,6 +575,38 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     return DeviceBlockMatrix(rows=a.rows, cols=b.cols, k=k,
                              coords=join.keys, hi=out_hi, lo=out_lo,
                              val_bound=min(out_bound, (1 << 64) - 2))
+
+
+_plan = plan  # module-level alias: spgemm_device's `plan` kwarg shadows it
+
+
+def spgemm_device(a, b, *, round_size: int | None = None,
+                  backend: str | None = None,
+                  plan: SpgemmPlan | None = None):
+    """C = A x B with reference-exact semantics, tiles staying in HBM.
+
+    a, b: DeviceBlockMatrix (or host BlockSparseMatrix -- uploaded on entry).
+    Returns a DeviceBlockMatrix; no tile data crosses the device boundary,
+    which inverts the reference's pack/H2D/D2H round-trip per multiply
+    (sparse_matrix_mult.cu:189-269, 27% of its report's total time).
+
+    plan: a prebuilt SpgemmPlan (chain.py's plan-ahead worker, or a caller
+    reusing a plan across same-structure multiplies).  None plans inline --
+    the legacy serial path, bit-identical since planning is deterministic
+    and dispatch order is unchanged.  `plan_wait` times how long dispatch
+    actually blocked on planning: the full plan cost here, near-zero when
+    a prebuilt plan (or a plan-cache hit) arrives ready.
+    """
+    from spgemm_tpu.ops.device import ensure_device  # noqa: PLC0415
+
+    from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
+
+    a = ensure_device(a)
+    b = ensure_device(b)
+    if plan is None:
+        with timers.phase("plan_wait"):
+            plan = _plan(a, b, round_size=round_size, backend=backend)
+    return execute(plan, a, b)
 
 
 def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,

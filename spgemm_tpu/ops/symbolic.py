@@ -21,7 +21,7 @@ the order the reference's sorted-map traversal produces.
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -235,6 +235,89 @@ def assembly_permutation(rounds: list["Round"], num_keys: int) -> np.ndarray:
         inv[r.key_index] = off + np.arange(len(r.key_index))
         off += r.pa.shape[0]
     return inv
+
+
+@dataclass
+class SpgemmPlan:
+    """Everything the host decides about one C = A x B before any device
+    work: the structure join, the round plan, the assembly permutation,
+    and memoized schedule hooks for the sharded strategies.
+
+    Built by ops/spgemm.plan() (host-only -- pure numpy, safe on planner
+    worker threads when backend/platform are passed in resolved) and
+    consumed by ops/spgemm.execute() (device-only).  A plan is valid for
+    any operand pair with the same structure (coords/nnzb/k); sentinels
+    are baked into the pa/pb index arrays, so check_operands() rejects a
+    mismatched pair before a silent out-of-bounds gather can happen.
+
+    fingerprint: the structure-cache key this plan was stored under
+    (ops/plancache), or None when caching was off.
+    plan_s: host wall spent building the plan.  A cache hit returns the
+        memoized object unchanged, so this stays the COLD build wall --
+        per-call hit cost lives in the `plan` phase / plan_cache counters.
+    """
+
+    backend: str           # resolved concrete backend the budgets assumed
+    platform: str          # platform the budgets were derived for
+    k: int
+    a_nnzb: int            # A's sentinel index, baked into every pa
+    b_nnzb: int
+    join: JoinResult
+    rounds: list           # list[Round]
+    take: np.ndarray | None  # batch-mode assembly permutation (else None)
+    batch: bool            # round-batched plan (SPGEMM_TPU_ROUND_BATCH)
+    round_size: int | None
+    split_fanout: int | None = None  # hybrid proof partition threshold
+    fingerprint: str | None = None
+    plan_s: float = 0.0
+    # the exact block structures planned from (check_operands' real guard)
+    _a_coords: np.ndarray | None = None
+    _b_coords: np.ndarray | None = None
+    _ring: dict = field(default_factory=dict, repr=False)
+    _rowshard: dict = field(default_factory=dict, repr=False)
+
+    def check_operands(self, a, b) -> None:
+        """Refuse to drive a mismatched operand pair.  The cheap k/nnzb
+        gates catch gross misuse; the coords comparison is the real guard
+        -- the pa/pb gathers were built from the operands' block
+        structure, so a same-nnzb pair with different coords would gather
+        in-bounds and produce a silently WRONG product.  O(nnzb) int
+        compare, noise next to the dispatch it protects."""
+        if (a.k, b.k) != (self.k, self.k):
+            raise ValueError(
+                f"plan built for k={self.k}, operands have k={a.k}/{b.k}")
+        if (a.nnzb, b.nnzb) != (self.a_nnzb, self.b_nnzb):
+            raise ValueError(
+                f"plan built for nnzb=({self.a_nnzb}, {self.b_nnzb}), "
+                f"operands have ({a.nnzb}, {b.nnzb})")
+        if self._a_coords is None or self._b_coords is None:
+            return  # hand-built plan without stored structure: k/nnzb only
+        if not (np.array_equal(a.coords, self._a_coords)
+                and np.array_equal(b.coords, self._b_coords)):
+            raise ValueError(
+                "plan built for a different block structure: operand "
+                "coords do not match the coords this plan was planned "
+                "from (same nnzb, different sparsity pattern)")
+
+    def ring_schedule(self, nnzb_b: int, n_dev: int):
+        """Memoized parallel/ring.plan_ring over this plan's join -- the
+        ring strategy's prebuilt-schedule hook (pure numpy; a planner
+        worker thread may warm it ahead of the fold)."""
+        key = (nnzb_b, n_dev)
+        if key not in self._ring:
+            from spgemm_tpu.parallel.ring import plan_ring  # noqa: PLC0415
+            self._ring[key] = plan_ring(self.join, nnzb_b, n_dev)
+        return self._ring[key]
+
+    def rowshard_rounds(self, round_size: int | None = None):
+        """Memoized non-batch round plan for parallel/rowshard (one fixed
+        512-key round plan per explicit round_size)."""
+        rs = 512 if round_size is None else round_size
+        if rs not in self._rowshard:
+            self._rowshard[rs] = plan_rounds(
+                self.join, a_sentinel=self.a_nnzb, b_sentinel=self.b_nnzb,
+                round_size=rs)
+        return self._rowshard[rs]
 
 
 def _smem_key_cap(P: int, max_entries: int) -> int:

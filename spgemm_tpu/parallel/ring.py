@@ -201,8 +201,14 @@ def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
 
 
 def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
-                mesh: Mesh | None = None, **_ignored) -> BlockSparseMatrix:
-    """C = A x B with B rotating around the ring (field-mode arithmetic)."""
+                mesh: Mesh | None = None, plan=None,
+                **_ignored) -> BlockSparseMatrix:
+    """C = A x B with B rotating around the ring (field-mode arithmetic).
+
+    plan: an ops/symbolic.SpgemmPlan built from the same operand pair --
+    the join is reused and the ring schedule comes from the plan's memoized
+    `ring_schedule` hook (pure numpy, so a planner worker thread may have
+    prebuilt it while the device was busy)."""
     if a.k != b.k:
         raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
     k = a.k
@@ -212,7 +218,11 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         mesh = default_mesh(axis="ring")
     n_dev = mesh.devices.size
 
-    join = symbolic_join(a.coords, b.coords)
+    if plan is not None:
+        plan.check_operands(a, b)
+        join = plan.join
+    else:
+        join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:
         return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k)
 
@@ -226,7 +236,8 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
     with ENGINE.phase("ring_plan"):
         key_chunks, slab_bounds, ranks, tail, s_max, k_max = \
-            plan_ring(join, b.nnzb, n_dev)
+            plan.ring_schedule(b.nnzb, n_dev) if plan is not None \
+            else plan_ring(join, b.nnzb, n_dev)
     # A sentinel -> zero tile (rank lists and the deep-cell tail alike)
     ranks = [(rows, np.where(pa < 0, a.nnzb, pa), pb)
              for rows, pa, pb in ranks]

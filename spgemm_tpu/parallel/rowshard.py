@@ -46,8 +46,12 @@ def _numeric_round_sharded(a_hi, a_lo, b_hi, b_lo, pa, pb, *, mesh: Mesh):
 
 def spgemm_sharded(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
                    round_size: int | None = None, mesh: Mesh | None = None,
-                   **_ignored) -> BlockSparseMatrix:
-    """C = A x B, numeric phase sharded over the visible mesh. Bit-exact."""
+                   plan=None, **_ignored) -> BlockSparseMatrix:
+    """C = A x B, numeric phase sharded over the visible mesh. Bit-exact.
+
+    plan: an ops/symbolic.SpgemmPlan built from the same operand pair --
+    reuses its join and the memoized `rowshard_rounds` schedule hook (pure
+    numpy; prebuildable on a planner worker thread)."""
     if a.k != b.k:
         raise ValueError(f"tile size mismatch: {a.k} vs {b.k}")
     k = a.k
@@ -55,13 +59,18 @@ def spgemm_sharded(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         mesh = default_mesh()
     n_dev = mesh.devices.size
 
-    join = symbolic_join(a.coords, b.coords)
+    if plan is not None:
+        plan.check_operands(a, b)
+        join = plan.join
+    else:
+        join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:
         return BlockSparseMatrix(rows=a.rows, cols=b.cols, k=k)
 
     a_hi, a_lo = pack_tiles(a)
     b_hi, b_lo = pack_tiles(b)
-    rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+    rounds = plan.rowshard_rounds(round_size) if plan is not None \
+        else plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
                          round_size=512 if round_size is None else round_size)
 
     out = np.zeros((join.num_keys, k, k), dtype=np.uint64)

@@ -64,6 +64,19 @@ def failover_to_cpu(context: str, attempts: int = 2) -> bool:
     return True
 
 
+def host_only(fn):
+    """Marker for host-thread-only code: fn runs on planner/worker threads
+    (chain.py plan-ahead, the OOC staging worker's helpers) and must NEVER
+    touch a jax backend -- a dead TPU hangs inside backend init, and a hang
+    on a worker thread wedges the whole pipeline with no exception to fail
+    over on.  spgemm-lint's BKD rule scans the decorated function's WHOLE
+    body (not just import time) for backend-touching calls; callers that
+    need platform/backend identity must resolve it on the main thread and
+    pass it in as data.  Runtime no-op beyond the attribute tag."""
+    fn.__spgemm_host_only__ = True
+    return fn
+
+
 def pin(platform: str) -> None:
     """Pin the JAX platform in-process.  The env var alone is ineffective
     here: the TPU plugin's sitecustomize imports jax at interpreter start

@@ -81,6 +81,22 @@ _KNOBS = (
          "static): 1 = synchronous minimal HBM, >=2 = 3-stage pipeline "
          "with staging and landing workers.",
          "ops/spgemm.py", default="2", minimum=1),
+    Knob("SPGEMM_TPU_PLAN_AHEAD", "int",
+         "Chain plan-ahead depth: up to N upcoming pairs are planned by a "
+         "host worker thread while the device executes the current pair; "
+         "0 = legacy inline planning (bit-identical either way -- planning "
+         "is deterministic and dispatch order is unchanged).",
+         "chain.py", default="2", minimum=0),
+    Knob("SPGEMM_TPU_PLAN_CACHE", "bool01",
+         "Structure-keyed SpgemmPlan memoization: 1 = multiplies whose "
+         "operand-structure fingerprint matches reuse the cached plan "
+         "(repeated inputs skip the symbolic planner), 0 = plan every "
+         "multiply from scratch.",
+         "ops/plancache.py", default="1"),
+    Knob("SPGEMM_TPU_PLAN_CACHE_CAP", "int",
+         "Plan-cache LRU capacity (plans retained per process; a plan "
+         "holds its padded pa/pb index arrays, ~8 bytes per tile pair).",
+         "ops/plancache.py", default="32", minimum=1),
     Knob("SPGEMM_TPU_HYBRID_GATE", "enum",
          "Hybrid speed-gate policy: auto = measured per-shape crossover, "
          "proof = route on the exactness proof alone (unset: auto on TPU, "

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 
 log = logging.getLogger("spgemm_tpu.timers")
@@ -20,12 +21,21 @@ class PhaseTimers:
     """Accumulates wall-clock per named phase (re-entrant by name), plus
     named event counters (dispatch/launch counts -- the round-batching
     regression guard: wall time alone cannot distinguish one mega-launch
-    from fifty small ones on an async backend)."""
+    from fifty small ones on an async backend).
+
+    Thread discipline: accumulation is lock-guarded.  The OOC pipeline's
+    workers each own distinct phase/counter names, but the chain planner
+    worker shares names with the main thread across mode switches (`plan`
+    and the plan-cache counters run on the worker under plan-ahead and on
+    the main thread under SPGEMM_TPU_PLAN_AHEAD=0, and a failover retry
+    can interleave the two within one process) -- a read-modify-write on
+    a shared name must never lose an update."""
 
     def __init__(self):
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
         self.counters: dict[str, int] = {}
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -34,43 +44,49 @@ class PhaseTimers:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            with self._lock:
+                self.totals[name] = self.totals.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
 
     def record(self, name: str, seconds: float):
         """Accumulate an externally measured duration under a phase name --
         for spans whose endpoints the caller must place itself (e.g. the ring
         layer's one-hop wire probe, timed around its own completion barrier
         rather than a `with` block)."""
-        self.totals[name] = self.totals.get(name, 0.0) + seconds
-        self.counts[name] = self.counts.get(name, 0) + 1
+        with self._lock:
+            self.totals[name] = self.totals.get(name, 0.0) + seconds
+            self.counts[name] = self.counts.get(name, 0) + 1
 
     def incr(self, name: str, n: int = 1):
-        """Bump a named event counter (e.g. 'dispatches' per numeric launch).
-
-        Each counter name is written from a single thread (the OOC pipeline
-        threads each own their phase/counter names), so the GIL-atomic dict
-        update needs no lock."""
-        self.counters[name] = self.counters.get(name, 0) + n
+        """Bump a named event counter (e.g. 'dispatches' per numeric
+        launch); safe from any thread."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
 
     def log_report(self):
-        for name in self.totals:
-            log.info("phase %s: %.4fs (x%d)", name, self.totals[name], self.counts[name])
-        for name in self.counters:
-            log.info("counter %s: %d", name, self.counters[name])
+        with self._lock:
+            totals, counts = dict(self.totals), dict(self.counts)
+            counters = dict(self.counters)
+        for name, total in totals.items():
+            log.info("phase %s: %.4fs (x%d)", name, total, counts.get(name, 0))
+        for name, n in counters.items():
+            log.info("counter %s: %d", name, n)
 
     def reset(self):
-        self.totals.clear()
-        self.counts.clear()
-        self.counters.clear()
+        with self._lock:
+            self.totals.clear()
+            self.counts.clear()
+            self.counters.clear()
 
     def snapshot(self) -> dict[str, float]:
         """Rounded totals, for embedding in structured bench/CLI output."""
-        return {name: round(t, 4) for name, t in self.totals.items()}
+        with self._lock:
+            return {name: round(t, 4) for name, t in self.totals.items()}
 
     def counter_snapshot(self) -> dict[str, int]:
         """Event counters, for embedding next to snapshot() in bench output."""
-        return dict(self.counters)
+        with self._lock:
+            return dict(self.counters)
 
 
 # Global registry for the SpGEMM engine's internal phases (symbolic join /

@@ -17,6 +17,15 @@ def bad_subscript():
     return environ["SPGEMM_TPU_SEEDED_C"]  # seeded KNB
 
 
+def bad_planner_knob_reads():
+    # the planner-pipeline knobs are registry knobs like any other: raw
+    # reads of them are KNB findings (registered in utils/knobs.py, read
+    # via knobs.get in chain.py / ops/plancache.py)
+    ahead = os.environ.get("SPGEMM_TPU_PLAN_AHEAD", "2")  # seeded KNB
+    cap = os.getenv("SPGEMM_TPU_PLAN_CACHE_CAP")  # seeded KNB
+    return ahead, cap
+
+
 def legal_non_knob_reads():
     # non-SPGEMM_TPU names are not knobs: raw access stays legal
     return os.environ.get("JAX_PLATFORMS", ""), os.getenv("HOME")

@@ -1,0 +1,34 @@
+"""spgemm-lint BKD fixture: backend touches inside a @host_only helper.
+
+Planner/worker-thread code (chain.py plan-ahead, OOC staging helpers) is
+marked with utils/backend_probe.host_only and must never touch a backend:
+a dead TPU hangs inside backend init, and a hang on a worker thread wedges
+the whole pipeline with no exception to fail over on.  The BKD rule scans
+the WHOLE decorated body, not just import time.  Never imported.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from spgemm_tpu.utils.backend_probe import host_only
+
+
+@host_only
+def bad_planner_helper(join):
+    platform = jax.devices()[0].platform  # seeded BKD: backend touch on a
+    #                                       planner thread
+    pa = jnp.asarray(join)  # seeded BKD: array materialization initializes
+    #                         the backend just as surely
+    return platform, pa
+
+
+@host_only
+def good_planner_helper(coords, backend, platform):
+    # resolved identity passed in as data, pure-host work only: legal
+    return (len(coords), backend, platform)
+
+
+def legal_unmarked_lazy(join):
+    # unmarked function body: BKD stays an import-time rule here (the CLI
+    # and engine touch backends lazily from the main thread by design)
+    return jax.devices()[0].platform  # legal lazy touch

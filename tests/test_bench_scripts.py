@@ -34,6 +34,59 @@ def test_bench_prints_one_json_line():
     assert row["detail"]["round_batch"] == 1
 
 
+def test_bench_plan_phases_and_cache_counters_in_detail():
+    """Bench JSON contract growth (planner pipeline): the plan/plan_wait
+    phases and the plan-cache counters must ride in detail, and the
+    last-stdout-line JSON contract must hold under the legacy serial path
+    (SPGEMM_TPU_PLAN_AHEAD=0) too."""
+    rc = _run(["bench.py", "--chain", "3", "--block-dim", "12",
+               "--bandwidth", "1", "--k", "8", "--iters", "2",
+               "--device", "cpu"], SPGEMM_TPU_PLAN_AHEAD="0")
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    last = rc.stdout.strip().splitlines()[-1]
+    row = json.loads(last)  # the LAST stdout line is the metric contract
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(row)
+    detail = row["detail"]
+    assert detail["plan_ahead"] == 0
+    phases = detail["phases_s"]
+    # serial path: dispatch blocked for the whole (inline) plan span
+    assert "plan" in phases and "plan_wait" in phases
+    assert phases["plan_wait"] >= 0 and phases["plan"] >= 0
+    # iters=2 re-runs the identical chain: the second iteration's plans
+    # all come from the structure-keyed cache, and the best-iteration
+    # counters must show it
+    assert detail["plan_cache_misses"] + detail["plan_cache_hits"] > 0
+    assert detail["plan_cache_hits"] > 0
+
+
+def test_bench_plan_ahead_pipeline_row():
+    """The default plan-ahead path emits the same contract with the
+    worker-planned spans (plan accumulated off the dispatch thread)."""
+    rc = _run(["bench.py", "--chain", "4", "--block-dim", "12",
+               "--bandwidth", "1", "--k", "8", "--iters", "1",
+               "--device", "cpu"], SPGEMM_TPU_PLAN_AHEAD="2")
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads(rc.stdout.strip().splitlines()[-1])
+    detail = row["detail"]
+    assert detail["plan_ahead"] == 2
+    assert "plan" in detail["phases_s"] and "plan_wait" in detail["phases_s"]
+
+
+def test_planner_bench_repeat_structure_contract():
+    """benchmarks/planner_bench.py --repeat-structure: one JSON line with
+    the plan-cache hit measurement alongside the plan_ring_wall fields."""
+    rc = _run([os.path.join("benchmarks", "planner_bench.py"),
+               "--keys", "2000", "--repeat-structure"])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads(rc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "plan_ring_wall"
+    detail = row["detail"]
+    assert "plan_rounds_wall_s" in detail  # the pre-existing fields stay
+    assert detail["plan_cache_hit_wall_s"] > 0
+    assert detail["plan_cache_miss_wall_s"] >= detail["plan_cache_hit_wall_s"]
+    assert detail["plan_cache"]["hits"] >= 1
+
+
 def test_bench_single_chain_no_crash():
     rc = _run(["bench.py", "--chain", "1", "--block-dim", "8",
                "--bandwidth", "1", "--k", "8", "--iters", "1",

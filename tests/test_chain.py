@@ -43,6 +43,75 @@ def _expected(mats, k):
     return BlockSparseMatrix.from_dict(mats[0].rows, mats[-1].cols, k, want)
 
 
+# ------------------------------------------------ helper2 pairing-tree pin
+
+
+def _helper2_tree(labels):
+    """The reference helper2() reduction tree over opaque labels: adjacent
+    pairs left to right, odd element carried (sparse_matrix_mult.cu:
+    287-327).  The host oracle for the STRUCTURE of the reduction -- the
+    arithmetic is non-associative, so this exact tree is load-bearing."""
+    arr = list(labels)
+    while len(arr) > 1:
+        nxt = [(arr[i], arr[i + 1]) for i in range(0, len(arr) - 1, 2)]
+        if len(arr) % 2 == 1:
+            nxt.append(arr[-1])
+        arr = nxt
+    return arr[0]
+
+
+class _Labeled:
+    """Opaque chain element: multiplication is tree construction."""
+
+    def __init__(self, label):
+        self.label = label
+
+
+@pytest.mark.parametrize("n", range(2, 10))
+def test_chain_pairing_tree_pinned(n):
+    """Regression pin for the plan/execute refactor: chain_product's
+    pairing tree (incl. the odd-carry branch) must equal helper2's for
+    N=2..9, and the multiplies must issue in left-to-right order.  A
+    custom multiply takes the worker-less branch by design
+    (chain._make_planner plans only for spgemm_device); the plan-ahead
+    path's tree is value-pinned by test_chain_values_vs_oracle_n2_to_9
+    below and dispatch-pinned by tests/test_plan.py."""
+    issued = []
+
+    def structural_multiply(a, b, **_kw):
+        issued.append((a.label, b.label))
+        return _Labeled((a.label, b.label))
+
+    got = chain_product([_Labeled(i) for i in range(n)],
+                        multiply=structural_multiply)
+    assert got.label == _helper2_tree(range(n))
+    # dispatch order: left-to-right within each halving pass
+    replay = []
+    arr = [i for i in range(n)]
+    while len(arr) > 1:
+        nxt = [(arr[i], arr[i + 1]) for i in range(0, len(arr) - 1, 2)]
+        replay += nxt
+        if len(arr) % 2 == 1:
+            nxt.append(arr[-1])
+        arr = nxt
+    assert issued == replay
+
+
+@pytest.mark.parametrize("n", range(2, 10))
+def test_chain_values_vs_oracle_n2_to_9(n, monkeypatch):
+    """Value-level pin of the same trees on adversarial (fold-order-
+    sensitive) values, through the real engine with the plan-ahead
+    pipeline on: any silent tree change shows as a bit mismatch."""
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "2")
+    rng = np.random.default_rng(200 + n)
+    k = 2
+    mats = random_chain(n, 3, k, 0.6, rng, "adversarial")
+    got = chain_product(mats)
+    want = _expected(mats, k)
+    assert np.array_equal(got.coords, want.coords)
+    assert np.array_equal(got.tiles, want.tiles)
+
+
 class _DyingMultiply:
     """Succeeds for `ok` calls, then raises (simulates device/tunnel death)."""
 

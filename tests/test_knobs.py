@@ -90,10 +90,35 @@ def test_cli_knobs_subcommand(capsys, monkeypatch):
 def test_cli_knobs_subcommand_json(capsys, monkeypatch):
     monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", "0")
     assert run(["knobs", "--json"]) == 0
-    rows = {r["name"]: r for r in json.loads(capsys.readouterr().out)}
+    report = json.loads(capsys.readouterr().out)
+    rows = {r["name"]: r for r in report["knobs"]}
     assert set(rows) == set(knobs.REGISTRY)
     row = rows["SPGEMM_TPU_RING_OVERLAP"]
     assert row["value"] == "0" and row["source"] == "env"
+    # plan-cache live stats ride next to the knob rows, so the whole-engine
+    # A/B (SPGEMM_TPU_PLAN_AHEAD=0|2) is inspectable without a bench run
+    cache = report["plan_cache"]
+    assert {"hits", "misses", "entries", "capacity", "enabled"} <= set(cache)
+    assert cache["capacity"] == 32  # the registry default
+
+
+def test_cli_knobs_json_reports_cache_activity(capsys):
+    """The stats are LIVE: in-process cache traffic shows up in the same
+    listing a harness would read."""
+    from spgemm_tpu.ops import plancache
+
+    plancache.clear()
+    key = plancache.fingerprint(
+        __import__("numpy").zeros((2, 2), "int64"),
+        __import__("numpy").ones((2, 2), "int64"), meta=("t",))
+    assert plancache.lookup(key) is None  # one miss
+    plancache.store(key, object())
+    assert plancache.lookup(key) is not None  # one hit
+    assert run(["knobs", "--json"]) == 0
+    cache = json.loads(capsys.readouterr().out)["plan_cache"]
+    assert cache["hits"] == 1 and cache["misses"] == 1
+    assert cache["entries"] == 1
+    plancache.clear()
 
 
 def test_snapshot_survives_invalid_values(monkeypatch):

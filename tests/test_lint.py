@@ -73,13 +73,15 @@ def test_fld_scope_is_path_based(tmp_path):
 
 # ------------------------------------------------------------- KNB rule --
 def test_knb_fixture_each_violation_caught():
-    """The three READ spellings are findings; the write/del in the same
-    fixture (how harnesses and tests drive knob values) must NOT be."""
+    """Every READ spelling is a finding (the three classic ones plus the
+    seeded planner-knob reads); the write/del in the same fixture (how
+    harnesses and tests drive knob values) must NOT be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 3
+    assert [f.rule for f in findings] == ["KNB"] * 5
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
-                   "SPGEMM_TPU_SEEDED_C"):
+                   "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
+                   "SPGEMM_TPU_PLAN_CACHE_CAP"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -110,6 +112,50 @@ def test_bkd_probe_module_is_exempt():
     findings = lint_file(os.path.join(REPO, "spgemm_tpu", "utils",
                                       "backend_probe.py"))
     assert [f for f in findings if f.rule == "BKD"] == []
+
+
+def test_bkd_host_only_body_is_scanned():
+    """@host_only (utils/backend_probe) marks planner/worker-thread code:
+    its WHOLE body is in BKD scope -- a backend touch there hangs a thread
+    the pipeline is blocked on -- while unmarked function bodies keep the
+    import-time-only rule."""
+    findings = lint_file(os.path.join(FIXTURES, "badplanner.py"))
+    assert [f.rule for f in findings] == ["BKD"] * 2
+    msgs = " ".join(f.message for f in findings)
+    assert "host_only" in msgs and "jax.devices" in msgs
+    src = open(os.path.join(FIXTURES, "badplanner.py")).read()
+    flagged = [f.line for f in findings]
+    legal = next(i for i, ln in enumerate(src.splitlines(), 1)
+                 if "legal" in ln and "jax.devices" in ln)
+    assert legal not in flagged  # unmarked lazy touch stays legal
+
+
+def test_bkd_host_only_dotted_decorator(tmp_path):
+    """The dotted spelling `@backend_probe.host_only` is recognized too,
+    and a passing helper (pure numpy) yields no finding."""
+    p = tmp_path / "planhelp.py"
+    p.write_text("from spgemm_tpu.utils import backend_probe\n"
+                 "import numpy as np\n"
+                 "import jax\n"
+                 "@backend_probe.host_only\n"
+                 "def bad(x):\n"
+                 "    return jax.device_put(x)\n"
+                 "@backend_probe.host_only\n"
+                 "def good(x):\n"
+                 "    return np.asarray(x).sum()\n")
+    findings = lint_file(str(p))
+    assert [f.rule for f in findings] == ["BKD"]
+    assert "jax.device_put" in findings[0].message
+
+
+def test_host_only_marker_on_planner_entrypoints():
+    """The engine's planner bodies really carry the marker the rule keys
+    on (the runtime attribute host_only sets)."""
+    from spgemm_tpu.chain import _PlanAheadWorker
+    from spgemm_tpu.ops.spgemm import _plan_host
+
+    assert getattr(_plan_host, "__spgemm_host_only__", False)
+    assert getattr(_PlanAheadWorker._work, "__spgemm_host_only__", False)
 
 
 # ------------------------------------------------------------- DOC rule --
@@ -156,7 +202,9 @@ def test_json_report_fixture_run():
     assert rc.returncode == 1, rc.stderr[-2000:]
     report = json.loads(rc.stdout)
     assert report["clean"] is False
-    assert report["counts"] == {"FLD": 5, "KNB": 3, "BKD": 3, "DOC": 1,
+    # badknob: 3 classic + 2 planner-knob reads; badbackend: 3 import-time
+    # touches; badplanner: 2 @host_only-body touches
+    assert report["counts"] == {"FLD": 5, "KNB": 5, "BKD": 5, "DOC": 1,
                                 "PARSE": 0}
     for f in report["findings"]:
         assert set(f) == {"file", "line", "rule", "message"}

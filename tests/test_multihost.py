@@ -23,6 +23,18 @@ def _free_port():
     return port
 
 
+def _gloo_transport_race(outs) -> bool:
+    """The known CPU-gloo TCP race: under host load a rank can abort with
+    `gloo::EnforceNotMet ... op.preamble.length <= op.nbytes` inside an
+    all-gather (mismatched in-flight ops on one TCP pair), taking its
+    peers down with heartbeat/PartnerLost collateral.  An infra artifact
+    of the CPU transport, not an engine bug -- the spawn is retried ONCE
+    on exactly this signature (a systematic engine failure keeps failing
+    on the retry and still fails the test)."""
+    return any("gloo::EnforceNotMet" in out and "preamble" in out
+               for out in outs)
+
+
 @pytest.mark.parametrize("num_procs,n_mats", [
     (2, 5),   # the original 2-host split
     (4, 7),   # P=4, every rank active (4-way padded DCN all-gather)
@@ -30,28 +42,33 @@ def _free_port():
               # (reference: sparse_matrix_mult.cu:612-666 region) over DCN
 ])
 def test_multi_process_chain(tmp_path, num_procs, n_mats):
-    port = _free_port()
-    coord = f"127.0.0.1:{port}"
     worker = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
     env = {**os.environ}
     env.pop("JAX_PLATFORMS", None)  # worker pins cpu via jax.config
 
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, coord, str(num_procs), str(r),
-             str(tmp_path), str(n_mats)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
-        for r in range(num_procs)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=150)
-            outs.append(out.decode())
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.fail("multihost workers timed out")
+    for attempt in range(2):
+        port = _free_port()
+        coord = f"127.0.0.1:{port}"
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, coord, str(num_procs), str(r),
+                 str(tmp_path), str(n_mats)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env)
+            for r in range(num_procs)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=150)
+                outs.append(out.decode())
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("multihost workers timed out")
+        if (attempt == 0 and any(p.returncode != 0 for p in procs)
+                and _gloo_transport_race(outs)):
+            continue  # one retry for the CPU-gloo transport race only
+        break
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
 

@@ -205,7 +205,8 @@ def test_hybrid_proven_route_dispatches_nomod_pallas(tmp_path, monkeypatch,
     # exact backend resolves to the Pallas kernel (interpret mode on CPU);
     # an explicit backend name must still pass through untouched
     monkeypatch.setattr(spgemm_mod, "resolve_backend",
-                        lambda be: "pallas" if be is None else be)
+                        lambda be, platform=None:
+                        "pallas" if be is None else be)
     times = iter([0.1, 0.2] * 64)  # exact (nomod) measures faster -> VPU
     monkeypatch.setattr(crossover, "_time_call",
                         lambda fn, args, repeats=2: next(times))

@@ -1,0 +1,299 @@
+"""Planner pipeline (PR 4 tentpole): the plan/execute split of
+ops/spgemm.spgemm_device, the structure-keyed plan cache (ops/plancache),
+and chain.py's bounded plan-ahead worker.
+
+The standing contracts:
+  * plan() + execute() == the legacy inline path, bit-for-bit, on every
+    backend (planning is deterministic; dispatch order is unchanged);
+  * SPGEMM_TPU_PLAN_AHEAD=0 and >0 produce identical bits AND identical
+    dispatch counts on a chain;
+  * a cache hit returns the SAME plan object and skips the join entirely;
+  * planning is host-pure when backend/platform are passed resolved (the
+    BKD worker-thread contract).
+"""
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.ops import plancache
+from spgemm_tpu.ops.spgemm import execute, plan, spgemm, spgemm_device
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_block_sparse, random_chain
+from spgemm_tpu.utils.semantics import chain_oracle, spgemm_oracle
+from spgemm_tpu.utils.timers import ENGINE
+
+
+def _oracle(a, b):
+    return BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    plancache.clear()
+    yield
+    plancache.clear()
+
+
+# ------------------------------------------------------- plan/execute split
+
+
+@pytest.mark.parametrize("backend", ["xla", "hybrid"])
+def test_plan_execute_matches_inline_and_oracle(backend, monkeypatch):
+    """Explicit plan() + execute() == spgemm() == the oracle on
+    adversarial (fold-order-sensitive) values."""
+    rng = np.random.default_rng(101 + len(backend))
+    a = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    b = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    p = plan(a, b, backend=backend, platform="cpu")
+    got = execute(p, a, b).to_host()
+    inline = spgemm(a, b, backend=backend)
+    assert got == inline == _oracle(a, b)
+
+
+def test_plan_is_reusable_across_same_structure_operands():
+    """A plan is structure-keyed: the SAME plan drives operands with
+    different VALUES (the serving scenario) bit-exactly."""
+    rng = np.random.default_rng(103)
+    a1 = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    b1 = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    a2 = BlockSparseMatrix(rows=a1.rows, cols=a1.cols, k=a1.k,
+                           coords=a1.coords,
+                           tiles=a1.tiles[::-1].copy())  # same structure
+    p = plan(a1, b1, backend="xla", platform="cpu")
+    assert execute(p, a2, b1).to_host() == _oracle(a2, b1)
+
+
+def test_execute_rejects_mismatched_operands():
+    """Sentinels are baked into pa/pb: a structurally different operand
+    pair must be refused, never silently mis-gathered."""
+    rng = np.random.default_rng(104)
+    a = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    b = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    c = random_block_sparse(6, 6, 2, 0.9, rng, "full")
+    p = plan(a, b, backend="xla", platform="cpu")
+    assert c.nnzb != b.nnzb
+    with pytest.raises(ValueError, match="nnzb"):
+        execute(p, a, c)
+    k4 = random_block_sparse(6, 6, 4, 0.5, rng, "full")
+    with pytest.raises(ValueError, match="k="):
+        execute(p, k4, k4)
+    # the dangerous case (code-review repro): SAME nnzb, different coords
+    # -- the pa/pb gathers stay in-bounds and would silently produce a
+    # wrong product, so the coords guard must fire
+    shifted = b.coords.copy()
+    shifted[-1, 1] += 1  # still lex-sorted: last coord's col bumped
+    b_shifted = BlockSparseMatrix(rows=b.rows, cols=b.cols + b.k, k=b.k,
+                                  coords=shifted, tiles=b.tiles)
+    assert b_shifted.nnzb == b.nnzb
+    with pytest.raises(ValueError, match="coords"):
+        execute(p, a, b_shifted)
+
+
+def test_plan_host_purity_marker_and_duck_typing():
+    """Planner worker threads call _plan_host with resolved backend/
+    platform: the body carries the @host_only marker (BKD-scanned) and
+    needs only coords/nnzb/k/val_bound -- no device, no tiles."""
+    from types import SimpleNamespace
+
+    from spgemm_tpu.ops.spgemm import _plan_host
+
+    assert getattr(_plan_host, "__spgemm_host_only__", False)
+    coords = np.array([[0, 0], [0, 1], [1, 0]], np.int64)
+    m = SimpleNamespace(coords=coords, nnzb=3, k=2, val_bound=0)
+    p = plan(m, m, backend="xla", platform="cpu")
+    assert p.join.num_keys > 0 and p.backend == "xla"
+
+
+def test_empty_join_plans_and_executes():
+    rng = np.random.default_rng(105)
+    a = random_block_sparse(4, 4, 2, 0.4, rng, "full")
+    # B's rows never meet A's cols: disjoint block structure, empty join
+    b = BlockSparseMatrix(rows=a.rows, cols=a.cols, k=2,
+                          coords=np.zeros((0, 2), np.int64),
+                          tiles=np.zeros((0, 2, 2), np.uint64))
+    p = plan(a, b, backend="xla", platform="cpu")
+    assert p.join.num_keys == 0 and p.rounds == []
+    assert execute(p, a, b).nnzb == 0
+
+
+# ------------------------------------------------------------- plan cache
+
+
+def test_plan_cache_hits_same_structure(monkeypatch):
+    """Second plan of the same structure is the SAME object, with the
+    join/round phases skipped (hit counter, no second miss)."""
+    rng = np.random.default_rng(111)
+    a = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    b = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    ENGINE.reset()
+    p1 = plan(a, b, backend="xla", platform="cpu")
+    p2 = plan(a, b, backend="xla", platform="cpu")
+    assert p2 is p1
+    counters = ENGINE.counter_snapshot()
+    assert counters["plan_cache_misses"] == 1
+    assert counters["plan_cache_hits"] == 1
+    stats = plancache.stats()
+    assert stats["hits"] == 1 and stats["misses"] == 1
+
+
+def test_plan_cache_distinguishes_structure_and_knobs(monkeypatch):
+    """Different coords, a different jit-static knob vector, or a flipped
+    ROUND_BATCH must all be cache MISSES -- a stale plan under any of
+    those is a wrong plan."""
+    rng = np.random.default_rng(112)
+    a = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    b = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    c = random_block_sparse(8, 8, 2, 0.8, rng, "full")
+    p1 = plan(a, b, backend="xla", platform="cpu")
+    assert plan(a, c, backend="xla", platform="cpu") is not p1
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "0")
+    p_legacy = plan(a, b, backend="xla", platform="cpu")
+    assert p_legacy is not p1 and p_legacy.batch is False
+    monkeypatch.setenv("SPGEMM_TPU_ROUND_BATCH", "1")
+    monkeypatch.setenv("SPGEMM_TPU_MXU_R", "16")  # jit-static knob
+    assert plan(a, b, backend="xla", platform="cpu") is not p1
+    monkeypatch.delenv("SPGEMM_TPU_MXU_R")
+    assert plan(a, b, backend="xla", platform="cpu") is p1  # back to hit
+
+
+def test_plan_cache_lru_eviction(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_CACHE_CAP", "1")
+    rng = np.random.default_rng(113)
+    a = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    b = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    c = random_block_sparse(6, 6, 2, 0.9, rng, "full")
+    p1 = plan(a, b, backend="xla", platform="cpu")
+    plan(a, c, backend="xla", platform="cpu")  # evicts p1 at cap 1
+    assert plancache.stats()["entries"] == 1
+    assert plan(a, b, backend="xla", platform="cpu") is not p1  # re-planned
+    assert plancache.stats()["hits"] == 0
+
+
+def test_plan_cache_disabled_never_stores(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_CACHE", "0")
+    rng = np.random.default_rng(114)
+    a = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    p1 = plan(a, a, backend="xla", platform="cpu")
+    p2 = plan(a, a, backend="xla", platform="cpu")
+    assert p1 is not p2 and p1.fingerprint is None
+    assert plancache.stats()["entries"] == 0
+
+
+def test_spgemm_device_second_run_hits_cache():
+    """The end-to-end serving path: a repeated multiply re-uses the plan
+    (hits > 0) and stays bit-exact."""
+    rng = np.random.default_rng(115)
+    a = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    b = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    first = spgemm(a, b)
+    ENGINE.reset()
+    second = spgemm(a, b)
+    assert second == first == _oracle(a, b)
+    assert ENGINE.counter_snapshot()["plan_cache_hits"] >= 1
+    # the hit path's plan span is recorded (near-zero) and dispatch still
+    # had to wait on it -- both phases must exist for the bench contract
+    snap = ENGINE.snapshot()
+    assert "plan" in snap and "plan_wait" in snap
+
+
+# ------------------------------------------------- chain plan-ahead worker
+
+
+@pytest.mark.parametrize("n", [4, 5, 6])
+def test_chain_plan_ahead_bit_identical_and_same_dispatch(n, monkeypatch):
+    """The tentpole A/B: PLAN_AHEAD=2 vs 0 on an adversarial chain --
+    identical bits, identical dispatch counts (planning is deterministic
+    and dispatch order unchanged), and the pipeline actually overlapped
+    (plan_wait recorded alongside plan)."""
+    rng = np.random.default_rng(120 + n)
+    mats = random_chain(n, 4, 2, 0.6, rng, "adversarial")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "0")
+    plancache.clear()
+    ENGINE.reset()
+    serial = chain_product(mats)
+    serial_dispatches = ENGINE.counter_snapshot()["dispatches"]
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "2")
+    plancache.clear()
+    ENGINE.reset()
+    piped = chain_product(mats)
+    snap = ENGINE.snapshot()
+    assert ENGINE.counter_snapshot()["dispatches"] == serial_dispatches
+    assert "plan" in snap and "plan_wait" in snap
+    want = chain_oracle([m.to_dict() for m in mats], 2)
+    want_m = BlockSparseMatrix.from_dict(mats[0].rows, mats[-1].cols, 2, want)
+    assert piped == serial == want_m
+
+
+def test_chain_planner_failure_fails_over_to_oracle(monkeypatch):
+    """A planner-worker exception surfaces on the consumer like a device
+    loss: without failover it raises, with failover the pass restarts on
+    the host oracle."""
+    import spgemm_tpu.ops.spgemm as spgemm_mod
+
+    rng = np.random.default_rng(130)
+    mats = random_chain(5, 4, 2, 0.5, rng, "full")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "2")
+    calls = []
+    real = spgemm_mod.plan
+
+    def dying_plan(a, b, **kw):
+        calls.append(1)
+        if len(calls) > 1:
+            raise RuntimeError("injected planner death")
+        return real(a, b, **kw)
+
+    monkeypatch.setattr(spgemm_mod, "plan", dying_plan)
+    with pytest.raises(RuntimeError, match="injected planner death"):
+        chain_product(mats)
+    calls.clear()
+    got = chain_product(mats, failover=True)
+    want = chain_oracle([m.to_dict() for m in mats], 2)
+    want_m = BlockSparseMatrix.from_dict(mats[0].rows, mats[-1].cols, 2, want)
+    assert np.array_equal(got.coords, want_m.coords)
+    assert np.array_equal(got.tiles, want_m.tiles)
+
+
+def test_plan_ahead_knob_validation(monkeypatch):
+    rng = np.random.default_rng(131)
+    mats = random_chain(2, 3, 2, 0.5, rng, "full")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "-1")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_PLAN_AHEAD"):
+        chain_product(mats)
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "lots")
+    with pytest.raises(ValueError, match="SPGEMM_TPU_PLAN_AHEAD"):
+        chain_product(mats)
+
+
+# ------------------------------------- sharded strategies consume the plan
+
+
+def test_rowshard_consumes_prebuilt_plan():
+    rng = np.random.default_rng(140)
+    a = random_block_sparse(8, 8, 2, 0.5, rng, "adversarial")
+    b = random_block_sparse(8, 8, 2, 0.5, rng, "adversarial")
+    from spgemm_tpu.parallel.rowshard import spgemm_sharded
+
+    p = plan(a, b, backend="xla", platform="cpu")
+    got = spgemm_sharded(a, b, plan=p)
+    assert got == spgemm_sharded(a, b) == _oracle(a, b)
+    # the hook is memoized: a second consumer reuses the same schedule
+    assert p.rowshard_rounds(None) is p.rowshard_rounds(None)
+    with pytest.raises(ValueError, match="nnzb"):
+        c = random_block_sparse(8, 8, 2, 0.9, rng, "full")
+        spgemm_sharded(c, b, plan=p)
+
+
+def test_ring_consumes_prebuilt_plan():
+    rng = np.random.default_rng(141)
+    # bounded values: ring arithmetic is field mode, reference-exact here
+    a = random_block_sparse(8, 8, 2, 0.5, rng, "small")
+    b = random_block_sparse(8, 8, 2, 0.5, rng, "small")
+    from spgemm_tpu.parallel.ring import spgemm_ring
+
+    p = plan(a, b, backend="xla", platform="cpu")
+    got = spgemm_ring(a, b, plan=p)
+    assert got == spgemm_ring(a, b) == _oracle(a, b)
+    n_dev = len(__import__("jax").devices())
+    assert p.ring_schedule(b.nnzb, n_dev) is p.ring_schedule(b.nnzb, n_dev)
