@@ -22,7 +22,7 @@ MEMFLAG = $(MEMFLAG_$(MEM))
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test lint bench bench-large warm clean
+.PHONY: all native run test lint bench bench-large warm serve-smoke clean
 
 all: native
 
@@ -56,6 +56,13 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+# spgemmd end-to-end proof on CPU: daemon up on a temp socket, two submits
+# of the same input (second must hit the warm plan cache), results
+# bit-exact vs the oracle, clean shutdown; exits nonzero on any step.
+serve-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m spgemm_tpu.serve.smoke
 
 # the reference's Large scale (1M tiles) through the out-of-core pipeline
 bench-large:

@@ -133,7 +133,7 @@ def oracle_multiply(a: BlockSparseMatrix, b: BlockSparseMatrix,
 def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
                   checkpoint_dir: str | None = None, resume: bool = True,
                   keep_device: bool = False, failover: bool = False,
-                  **kwargs) -> BlockSparseMatrix:
+                  heartbeat=None, **kwargs) -> BlockSparseMatrix:
     """Reduce [M1, ..., MN] to M1 x M2 x ... x MN with helper2's pairing.
 
     multiply: binary op (defaults to ops.spgemm.spgemm_device, which keeps
@@ -146,6 +146,15 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
     multiply raises (device/tunnel death mid-chain), restart the current
     pass from the newest checkpoint -- or from the last completed pass's
     host copies -- on the host-only oracle, which needs no device at all.
+    heartbeat: optional zero-arg progress callback invoked after every
+    completed multiply -- the serving daemon's liveness signal (its
+    watchdog must tell a slow-but-progressing job from an executor wedged
+    inside a hung backend call, which never raises).  Must be cheap; must
+    not raise Exception, but MAY raise a BaseException-derived abort
+    signal (serve.queue.JobAbandoned) to stop an abandoned chain at a
+    multiply boundary -- BaseException so it deliberately pierces the
+    failover catch below, which must not mistake an abort for device
+    loss.  Never forwarded to multiply.
     """
     if multiply is None:
         from spgemm_tpu.ops.spgemm import spgemm_device as multiply  # noqa: PLC0415
@@ -193,6 +202,8 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
                         nxt.append(multiply(ma, mb, plan=pln, **kwargs))
                     else:
                         nxt.append(multiply(ma, mb, **kwargs))
+                    if heartbeat is not None:
+                        heartbeat()
                     # drop consumed partials so their HBM frees as soon as
                     # the dependent computations drain (pass >= 1 operands
                     # are device-resident and otherwise pinned for the whole

@@ -22,6 +22,11 @@ the visible mesh (see parallel/), replacing the mpirun -np P contract.
 (spgemm_tpu/utils/knobs.py) with each knob's current value, default, and
 source (env vs default) -- whole-engine A/B setups are inspectable without
 grepping the environment.
+
+`serve` / `submit` / `status` drive spgemmd (spgemm_tpu/serve/): a
+resident daemon owning the device whose warm jit/plan/crossover caches are
+reused across jobs, vs this run-once entrypoint paying them per
+invocation.
 """
 
 from __future__ import annotations
@@ -49,8 +54,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", default=None, metavar="PLATFORM",
                    help="force a JAX platform, e.g. tpu or cpu "
                         "(default: whatever JAX selects)")
+    # the device backends come from the serve-layer wire contract (ONE
+    # list shared with the daemon's submit validation and the submit
+    # CLI); the run-once path alone adds the host-only oracle
+    from spgemm_tpu.serve.protocol import CHAIN_BACKENDS  # noqa: PLC0415
     p.add_argument("--backend",
-                   choices=["xla", "pallas", "mxu", "hybrid", "oracle"],
+                   choices=[*CHAIN_BACKENDS, "oracle"],
                    default=None,
                    help="numeric-phase implementation (default: pallas on "
                         "TPU, xla elsewhere; mxu = field-mode limb matmul on "
@@ -174,18 +183,40 @@ def run_knobs(argv: list[str]) -> int:
     return 0
 
 
+def _subcommands() -> dict:
+    """Name -> handler for the non-folder subcommands.  Each handler
+    imports its own machinery only when invoked: `knobs` must never pay
+    for (or break on) the serve package, and a plain chain run loads
+    neither."""
+    def serve(argv: list[str]) -> int:
+        from spgemm_tpu.serve import daemon  # noqa: PLC0415
+        return daemon.main(argv)
+
+    def submit(argv: list[str]) -> int:
+        from spgemm_tpu.serve import client  # noqa: PLC0415
+        return client.main_submit(argv)
+
+    def status(argv: list[str]) -> int:
+        from spgemm_tpu.serve import client  # noqa: PLC0415
+        return client.main_status(argv)
+
+    return {"knobs": run_knobs, "serve": serve,
+            "submit": submit, "status": status}
+
+
 def run(argv: list[str] | None = None) -> int:
     import os  # noqa: PLC0415 -- only for the subcommand/folder disambiguation
 
     if argv is None:
         argv = sys.argv[1:]
-    # `knobs` is a subcommand UNLESS an INPUT directory of that name exists
-    # (the reference contract requires a `size` file) -- a pre-existing
-    # `./knobs` matrix folder keeps its old meaning, while an unrelated
-    # knobs/ scratch dir does not swallow the subcommand
-    if (argv and argv[0] == "knobs"
-            and not os.path.exists(os.path.join("knobs", "size"))):
-        return run_knobs(argv[1:])
+    # `knobs`/`serve`/`submit`/`status` are subcommands UNLESS an INPUT
+    # directory of that name exists (the reference contract requires a
+    # `size` file) -- a pre-existing `./knobs` matrix folder keeps its old
+    # meaning, while an unrelated scratch dir does not swallow the
+    # subcommand
+    if (argv and argv[0] in ("knobs", "serve", "submit", "status")
+            and not os.path.exists(os.path.join(argv[0], "size"))):
+        return _subcommands()[argv[0]](argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
     if (args.stream or args.out_of_core) and args.shard in ("keys", "inner", "ring"):

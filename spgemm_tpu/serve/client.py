@@ -1,0 +1,174 @@
+"""spgemmd client: library calls + the CLI `submit`/`status` handlers.
+
+jax-free by design -- a submitting process must never pay the cold JAX
+import the daemon exists to amortize (and must never touch a possibly-dead
+backend; the daemon owns the device, clients own only the socket).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+from spgemm_tpu.serve import protocol
+
+# one server-side wait is bounded (Daemon.MAX_WAIT_SLICE_S), so wait()
+# polls in slices: a connection is never pinned longer than a slice by an
+# abandoned waiter, and a Ctrl-C'd client frees its slot at the next
+# slice boundary instead of holding it until the job terminates
+WAIT_SLICE_S = 15.0
+
+
+class ServeError(Exception):
+    """A structured daemon-side error response; carries the wire code."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+        self.message = message
+
+
+def request(msg: dict, socket_path: str | None = None,
+            timeout: float | None = None) -> dict:
+    """One request, one response.  Raises ConnectionError flavors when no
+    daemon is listening; raises ServeError on an error response."""
+    path = socket_path or protocol.default_socket_path()
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
+        sock.settimeout(timeout)
+        sock.connect(path)
+        sock.sendall(protocol.encode(
+            {"v": protocol.PROTOCOL_VERSION, **msg}))
+        for line in protocol.read_lines(sock):
+            resp = json.loads(line)
+            if not resp.get("ok"):
+                err = resp.get("error") or {}
+                raise ServeError(err.get("code", "error"),
+                                 err.get("message", "unknown error"))
+            return resp
+    raise ConnectionError(f"daemon at {path} closed the connection "
+                          "without responding")
+
+
+def submit(folder: str, socket_path: str | None = None,
+           options: dict | None = None, timeout: float | None = None) -> dict:
+    # paths resolve CLIENT-side: the daemon's cwd is not the submitter's,
+    # so a relative folder/output/checkpoint_dir sent verbatim would be
+    # checked (and written!) against the wrong tree -- and journal replay
+    # after a restart from yet another cwd would break the same way
+    options = dict(options or {})
+    for key in ("output", "checkpoint_dir"):
+        if options.get(key):
+            options[key] = os.path.abspath(options[key])
+    return request({"op": "submit", "folder": os.path.abspath(folder),
+                    "options": options},
+                   socket_path, timeout=timeout)
+
+
+def status(job_id: str, socket_path: str | None = None) -> dict:
+    return request({"op": "status", "id": job_id}, socket_path)
+
+
+def wait(job_id: str, socket_path: str | None = None,
+         timeout: float | None = None) -> dict:
+    """Block until the job is terminal or timeout elapses (None = until
+    terminal), polling in WAIT_SLICE_S server-side waits."""
+    deadline = None if timeout is None else time.time() + timeout
+    while True:
+        slice_s = WAIT_SLICE_S if deadline is None else \
+            min(WAIT_SLICE_S, max(0.0, deadline - time.time()))
+        # the socket read must outlive the daemon-side wait, not race it
+        resp = request({"op": "wait", "id": job_id, "timeout": slice_s},
+                       socket_path, timeout=slice_s + 5.0)
+        if resp["job"]["state"] in ("done", "failed"):
+            return resp
+        if deadline is not None and time.time() >= deadline:
+            return resp  # caller sees the non-terminal snapshot
+
+
+def stats(socket_path: str | None = None) -> dict:
+    return request({"op": "stats"}, socket_path)
+
+
+def shutdown(socket_path: str | None = None) -> dict:
+    return request({"op": "shutdown"}, socket_path)
+
+
+# ------------------------------------------------------------- CLI glue --
+def main_submit(argv: list[str] | None = None) -> int:
+    """`spgemm_tpu submit <folder>`: enqueue a chain job on the daemon."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu submit",
+        description="submit a chain job to the running spgemmd daemon")
+    p.add_argument("folder",
+                   help="input directory containing `size` and matrix1..N")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
+                        "or <tmpdir>/spgemmd-<uid>.sock)")
+    p.add_argument("--output", default=None,
+                   help="result path (default: <folder>/matrix)")
+    p.add_argument("--backend", choices=list(protocol.CHAIN_BACKENDS),
+                   default=None)
+    p.add_argument("--round-size", type=int, default=None)
+    p.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                   help="per-pass chain snapshots; a daemon restart resumes "
+                        "this job from the newest complete pass")
+    p.add_argument("--timeout", type=float, default=None, metavar="S",
+                   help="per-job deadline override (default: "
+                        "SPGEMM_TPU_SERVE_JOB_TIMEOUT)")
+    p.add_argument("--failover", action="store_true",
+                   help="run the job with chain failover enabled")
+    p.add_argument("--wait", action="store_true",
+                   help="block until the job is terminal and print its "
+                        "final status")
+    args = p.parse_args(argv)
+    options = {k: v for k, v in (
+        ("output", args.output), ("backend", args.backend),
+        ("round_size", args.round_size),
+        ("checkpoint_dir", args.checkpoint_dir),
+        ("timeout_s", args.timeout),
+        ("failover", args.failover or None)) if v is not None}
+    try:
+        resp = submit(args.folder, args.socket, options)
+        if args.wait:
+            resp = wait(resp["id"], args.socket)
+    except (ServeError, OSError) as e:
+        print(f"submit failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(resp, indent=2))
+    if args.wait and resp.get("job", {}).get("state") != "done":
+        return 1
+    return 0
+
+
+def main_status(argv: list[str] | None = None) -> int:
+    """`spgemm_tpu status [job_id]`: job status, daemon stats, shutdown."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu status",
+        description="query the running spgemmd daemon: one job's status "
+                    "(with its per-job phases_s/plan-cache detail), or "
+                    "daemon-wide stats with no job id")
+    p.add_argument("job_id", nargs="?", default=None)
+    p.add_argument("--socket", default=None, metavar="PATH")
+    p.add_argument("--wait", action="store_true",
+                   help="block until the job is terminal")
+    p.add_argument("--shutdown", action="store_true",
+                   help="ask the daemon to shut down cleanly")
+    args = p.parse_args(argv)
+    try:
+        if args.shutdown:
+            resp = shutdown(args.socket)
+        elif args.job_id is None:
+            resp = stats(args.socket)
+        elif args.wait:
+            resp = wait(args.job_id, args.socket)
+        else:
+            resp = status(args.job_id, args.socket)
+    except (ServeError, OSError) as e:
+        print(f"status failed: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(resp, indent=2))
+    return 0

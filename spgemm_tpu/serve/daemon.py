@@ -1,0 +1,737 @@
+"""spgemmd: the resident single-device-owner daemon.
+
+One long-lived process owns the device and executes every submitted chain
+job on ONE executor thread, so everything expensive stays warm across
+jobs: the jit executable cache (XLA compiles once per shape class), the
+structure-keyed plan cache (ops/plancache -- a repeated input skips the
+symbolic planner entirely), and the crossover measurement cache
+(ops/crossover).  The run-once CLI pays all of those per invocation.
+
+Reliability model (the part the reference cannot have):
+
+  * The observed accelerator failure mode is a HANG, never an exception
+    (utils/backend_probe) -- so a wedged executor thread cannot be joined,
+    interrupted, or trusted again.  The watchdog detects it (a running job
+    past its deadline whose executor has not moved on within the
+    SPGEMM_TPU_SERVE_WEDGE_GRACE_S window -- sized to exceed one whole
+    multiply, since the heartbeat fires per COMPLETED multiply), reaps the
+    job with a structured error, ABANDONS the wedged thread (daemon flag
+    keeps it from pinning exit), probes the backend from a subprocess (the
+    only safe touch), and spawns a replacement executor pinned to the CPU
+    failover path (chain.oracle_multiply needs no backend at all).  The
+    daemon then reports `degraded` in stats but keeps serving.  A reaped
+    job whose executor is merely SLOW aborts its chain at the next multiply
+    boundary (JobAbandoned rides the heartbeat) -- the executor moves on
+    without computing a failed job to completion, and a wedged thread that
+    unwedges hours later aborts the same way instead of recording the rest
+    of its phases into the replacement executor's ENGINE registry.
+  * A submit beyond SPGEMM_TPU_SERVE_QUEUE_CAP is rejected with a
+    structured queue-full error (serve/queue.py), never queued unbounded.
+  * Every admitted job is journaled next to the socket
+    (<socket>.journal); a daemon restart re-queues jobs that never
+    reached a terminal state, and a job submitted with a checkpoint_dir
+    resumes its chain from the newest complete pass
+    (utils/checkpoint.latest_pass survives a truncated newest file).
+
+Per-job observability: each job runs under an ENGINE PhaseScope
+(utils/timers), so its status detail carries exactly its own phases_s and
+counters (plan/plan_wait/dispatch/assembly, plan_cache_hits/misses...) --
+the same fields bench.py emits, and job 2 never inherits job 1's totals.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import socket
+import sys
+import threading
+import time
+
+from spgemm_tpu.serve import protocol
+from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
+                                    QueueFull)
+from spgemm_tpu.utils import knobs
+
+log = logging.getLogger("spgemm_tpu.serve")
+
+# options a submit may carry; anything else is a bad-request (catching the
+# misspelled knob early beats silently ignoring it on a fleet)
+SUBMIT_OPTIONS = ("backend", "round_size", "checkpoint_dir", "output",
+                  "timeout_s", "failover")
+
+
+def run_chain_job(job: Job, degraded: bool = False) -> None:
+    """Default executor runner: read the job's folder, reduce the chain,
+    write the output file (reference text format).
+
+    degraded=True forces the host-only oracle multiply -- the CPU failover
+    path, which needs no accelerator and no XLA backend (a daemon whose
+    device wedged must still serve).  Imports stay inside the function:
+    the daemon module itself must be importable without touching jax (BKD
+    contract)."""
+    from spgemm_tpu import chain  # noqa: PLC0415
+    from spgemm_tpu.utils import io_text  # noqa: PLC0415
+
+    n, k = io_text.read_size(job.folder)
+    mats = io_text.read_chain(job.folder, 0, n - 1, k)
+    kwargs: dict = {}
+    if not degraded:
+        if job.options.get("backend") is not None:
+            kwargs["backend"] = job.options["backend"]
+        if job.options.get("round_size") is not None:
+            kwargs["round_size"] = int(job.options["round_size"])
+        if job.options.get("failover"):
+            kwargs["failover"] = True
+    def beat() -> None:
+        # heartbeat + abandonment check: a job the watchdog finished
+        # under our feet (reap, or presumed executor death) must not keep
+        # computing -- abort at the next multiply boundary instead of
+        # running a failed job's chain to completion (and, for a wedged
+        # executor that unwedges hours later, instead of recording the
+        # rest of its phases into the replacement executor's ENGINE)
+        job.touch()
+        if job.state in TERMINAL:
+            raise JobAbandoned(job.id)
+
+    multiply = chain.oracle_multiply if degraded else None
+    result = chain.chain_product(
+        mats, multiply=multiply,
+        checkpoint_dir=job.options.get("checkpoint_dir"),
+        heartbeat=beat, **kwargs)
+    if job.state in TERMINAL:
+        # reaped while we were inside the chain (an abandoned wedged
+        # executor can unwedge HOURS later): a resubmit may own
+        # job.output by now, and a stale result must not clobber it
+        return
+    io_text.write_matrix(job.output, result.prune_zeros())
+
+
+class Daemon:
+    """The spgemmd server: accept loop + executor + watchdog + journal.
+
+    runner/probe are injectable for tests: runner(job, degraded=...) does
+    the actual work (default run_chain_job), probe() is the backend
+    liveness check used when degrading (default
+    utils/backend_probe.probe_default_backend -- subprocess + timeout,
+    because a dead TPU hangs in-process).
+    """
+
+    # one compaction per this many terminal journal events: the journal
+    # stays O(queue cap + this) records for a resident daemon instead of
+    # growing for its lifetime (class attribute so tests can shrink it)
+    JOURNAL_COMPACT_EVERY = 256
+
+    # concurrent-connection bound: every accepted connection pins one
+    # spgemmd-conn thread (+ up to protocol.MAX_LINE_BYTES of pending
+    # buffer), so a connect() loop that never closes must exhaust THIS --
+    # answered with a structured busy error -- not the device owner's
+    # memory or thread limit.  Sized above the queue cap so every queued
+    # job can have a blocked wait()er with headroom to spare.
+    MAX_CONNS = 128
+
+    # idle connections (no request line in this many seconds) are dropped:
+    # recv() raises timeout -> the handler closes.  Generous on purpose --
+    # a server-side `wait` blocks in job.wait, not recv, so legitimate
+    # long waits never trip this; only silent open sockets do.
+    CONN_IDLE_TIMEOUT_S = 600.0
+
+    # one server-side `wait` is clamped to this many seconds (a running
+    # snapshot is answered past it; client.wait polls in slices): an
+    # abandoned waiter must not pin its MAX_CONNS slot until the job
+    # terminates -- which, for a job with no deadline behind a wedged
+    # executor, is never
+    MAX_WAIT_SLICE_S = 30.0
+
+    def __init__(self, socket_path: str | None = None, *, runner=None,
+                 probe=None, queue_cap: int | None = None,
+                 job_timeout_s: float | None = None,
+                 wedge_grace_s: float | None = None, journal: bool = True):
+        self.socket_path = socket_path or protocol.default_socket_path()
+        self.journal_path = self.socket_path + ".journal"
+        self._runner = runner or run_chain_job
+        self._probe = probe
+        self._cap = queue_cap if queue_cap is not None \
+            else knobs.get("SPGEMM_TPU_SERVE_QUEUE_CAP")
+        self._job_timeout_s = job_timeout_s if job_timeout_s is not None \
+            else knobs.get("SPGEMM_TPU_SERVE_JOB_TIMEOUT")
+        # the slow-vs-wedged window must cover one whole multiply: the
+        # heartbeat fires per COMPLETED multiply, so a shorter grace would
+        # declare a healthy executor wedged mid-multiply and permanently
+        # degrade the daemon to the CPU oracle path
+        self._wedge_grace_s = wedge_grace_s if wedge_grace_s is not None \
+            else knobs.get("SPGEMM_TPU_SERVE_WEDGE_GRACE_S")
+        self._journal_enabled = journal
+        self._journal_terminal_events = 0
+        self.queue = JobQueue(self._cap)
+        self.degraded = False
+        self.degrade_reason: str | None = None
+        self._probe_outcome: str | None = None
+        self._started_at = time.time()
+        self._next_id = 1
+        self._stop = threading.Event()
+        self._lock = threading.Lock()  # ids, journal file, degrade state
+        self._listener: socket.socket | None = None
+        self._executor: threading.Thread | None = None
+        self._executor_gen = 0
+        self._current: Job | None = None  # job the live executor holds
+        self._reaped: Job | None = None   # reaped job awaiting wedge grace
+        self._reaped_at = 0.0
+        self._conn_count = 0              # live spgemmd-conn threads
+        self._threads: list[threading.Thread] = []
+
+    # ------------------------------------------------------------ journal --
+    def _journal_append(self, event: dict) -> None:
+        if not self._journal_enabled:
+            return
+        with self._lock:
+            with open(self.journal_path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(event, separators=(",", ":")) + "\n")
+            if event.get("event") in ("done", "failed"):
+                # runtime compaction: a resident daemon serving a fleet
+                # for weeks must not grow the journal (or the next
+                # restart's replay) without bound
+                self._journal_terminal_events += 1
+                if self._journal_terminal_events >= \
+                        self.JOURNAL_COMPACT_EVERY:
+                    self._journal_compact_locked()
+
+    def _journal_live_records(self) -> list[dict]:
+        """Submit records with no matching terminal event, in file order
+        (the jobs a crash/restart left unfinished)."""
+        submitted: dict[str, dict] = {}
+        with open(self.journal_path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # a torn tail write must not kill startup
+                if ev.get("event") == "submit":
+                    submitted[ev["id"]] = ev
+                elif ev.get("event") in ("done", "failed"):
+                    submitted.pop(ev.get("id"), None)
+        return list(submitted.values())
+
+    def _journal_compact_locked(self) -> None:
+        """Rewrite the journal to only its live submit records (caller
+        holds self._lock)."""
+        live = self._journal_live_records()
+        with open(self.journal_path, "w", encoding="utf-8") as f:
+            for ev in live:
+                f.write(json.dumps(ev, separators=(",", ":")) + "\n")
+        self._journal_terminal_events = 0
+
+    def _journal_replay(self) -> None:
+        """Re-queue journaled jobs that never reached a terminal state,
+        then compact the journal to exactly those (a restarted daemon must
+        not re-run completed work, and the file must not grow forever)."""
+        if not self._journal_enabled or not os.path.exists(self.journal_path):
+            return
+        live = self._journal_live_records()
+        with self._lock:
+            self._journal_compact_locked()
+        for ev in live:
+            try:
+                job = Job(ev["id"], ev["folder"], ev["output"],
+                          ev.get("options", {}),
+                          timeout_s=ev.get("timeout_s", 0.0))
+            except (KeyError, TypeError) as e:
+                log.warning("journal: skipping malformed record %r (%r)",
+                            ev, e)
+                continue
+            try:
+                self.queue.submit(job)
+                log.info("journal: re-queued unfinished job %s (%s)",
+                         job.id, job.folder)
+            except QueueFull:
+                job.finish("failed", error={
+                    "code": protocol.E_QUEUE_FULL,
+                    "message": "queue full while re-queueing from journal"},
+                    on_commit=lambda j=job: self._journal_append(
+                        {"event": "failed", "id": j.id}))
+            num = int(ev["id"].rsplit("-", 1)[-1]) \
+                if ev["id"].rsplit("-", 1)[-1].isdigit() else 0
+            self._next_id = max(self._next_id, num + 1)
+
+    # ---------------------------------------------------------- lifecycle --
+    def start(self) -> None:
+        """Bind the socket and start the accept/executor/watchdog threads.
+        Raises RuntimeError if a live daemon already owns the socket (the
+        single-device-owner contract); a stale socket file is unlinked."""
+        if os.path.exists(self.socket_path):
+            peer = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                peer.settimeout(1.0)
+                peer.connect(self.socket_path)
+            except OSError:
+                os.unlink(self.socket_path)  # stale: no listener behind it
+            else:
+                peer.close()
+                raise RuntimeError(
+                    f"a daemon is already serving on {self.socket_path}")
+        self._journal_replay()
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(self.socket_path)
+        self._listener.listen(16)
+        # accept() must poll: close() from another thread does not wake a
+        # blocked accept on Linux, and shutdown semantics vary -- the
+        # accept loop re-checks the stop flag every tick instead
+        self._listener.settimeout(0.2)
+        self._spawn_executor()
+        for target, name in ((self._accept_loop, "spgemmd-accept"),
+                             (self._watchdog_loop, "spgemmd-watchdog")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+        log.info("spgemmd serving on %s (queue cap %d, job timeout %s)",
+                 self.socket_path, self._cap,
+                 self._job_timeout_s or "none")
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stop.wait(0.5):
+                pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        ex = self._executor
+        if ex is not None:
+            ex.join(timeout=5.0)  # wedged executor: daemon flag covers it
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    # ----------------------------------------------------------- executor --
+    def _spawn_executor(self, degraded: bool | None = None) -> None:
+        if degraded is not None:
+            self.degraded = degraded
+        self._executor_gen += 1
+        gen = self._executor_gen
+        self._executor = threading.Thread(
+            target=self._executor_loop, args=(gen,),
+            name=f"spgemmd-executor-{gen}", daemon=True)
+        self._executor.start()
+
+    def _executor_loop(self, gen: int) -> None:
+        from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+
+        while not self._stop.is_set() and gen == self._executor_gen:
+            job = self.queue.next(timeout=0.2)
+            if job is None:
+                continue
+            if job.state != "queued":  # reaped while still in the FIFO
+                continue
+            job.start()
+            degraded = self.degraded
+            scope = ENGINE.scope()
+            # stashed on the job BEFORE it becomes _current: the watchdog
+            # reads it to attach per-job detail when reaping, and must
+            # never see a current job without its scope
+            job.scope, job.scope_degraded = scope, degraded
+            self._current = job
+            try:
+                self._runner(job, degraded=degraded)
+            except JobAbandoned:
+                # the watchdog already finished this job (reap / presumed
+                # death); its chain aborted at the next multiply boundary
+                # -- nothing to record, just move on to live work
+                log.info("job %s abandoned mid-chain", job.id)
+            except Exception as e:  # noqa: BLE001 -- a job must not kill the loop
+                log.warning("job %s failed: %r", job.id, e)
+                job.finish("failed", error={
+                    "code": protocol.E_JOB_ERROR, "message": repr(e)},
+                    detail=self._job_detail(scope, degraded),
+                    on_commit=lambda: self._journal_append(
+                        {"event": "failed", "id": job.id}))
+            else:
+                job.finish("done", detail=self._job_detail(scope, degraded),
+                           on_commit=lambda: self._journal_append(
+                               {"event": "done", "id": job.id}))
+            finally:
+                # an abandoned (wedged) executor can unwedge long after a
+                # replacement took over: only clear the slot if it is
+                # still ours, never the successor's current job
+                if self._current is job:
+                    self._current = None
+
+    @staticmethod
+    def _job_detail(scope, degraded: bool) -> dict:
+        """The per-job status detail: the same phases_s + engine counters
+        bench.py emits, scoped to this job alone (PhaseScope diff)."""
+        counters = scope.counter_snapshot()
+        return {"phases_s": scope.snapshot(), "degraded": degraded,
+                "plan_cache_hits": counters.get("plan_cache_hits", 0),
+                "plan_cache_misses": counters.get("plan_cache_misses", 0),
+                **{k: v for k, v in counters.items()
+                   if k not in ("plan_cache_hits", "plan_cache_misses")}}
+
+    def _reap_detail(self, job: Job) -> dict | None:
+        """Best-effort per-job detail for a watchdog-reaped job, from the
+        executor's live PhaseScope (thread-safe: timers are lock-guarded).
+        The one job an operator most needs to diagnose -- it hit its
+        deadline -- must not lose its phases_s/counters to the reap."""
+        scope = job.scope
+        if scope is None:
+            return None
+        return self._job_detail(scope, job.scope_degraded)
+
+    # ----------------------------------------------------------- watchdog --
+    def _watchdog_loop(self) -> None:
+        """Reap overdue jobs; detect executor death and wedging.
+
+        Death (the thread is gone -- runner raised a BaseException, or a
+        test killed it) and wedging (a reaped job's executor still has not
+        moved on after the grace window -- the backend-hang signature) both
+        degrade the daemon to the CPU failover path: the device owner
+        cannot be trusted, but host-only service can continue."""
+        while not self._stop.wait(0.05):
+            job = self._current
+            ex = self._executor
+            if ex is not None and not ex.is_alive():
+                # sweep every running job, not just _current: a dying
+                # thread's finally may have cleared the slot already
+                reason = "executor thread died"
+                for orphan in self.queue.running():
+                    if orphan.finish("failed", error={
+                            "code": protocol.E_EXECUTOR_DIED,
+                            "message": "executor thread died mid-job"},
+                            detail=self._reap_detail(orphan),
+                            on_commit=lambda o=orphan: self._journal_append(
+                                {"event": "failed", "id": o.id})):
+                        reason += f" during job {orphan.id}"
+                self._degrade(reason)
+                continue
+            if job is not None and self._reaped is not job and job.overdue():
+                # finish() is first-write-wins: a job that completed a
+                # beat before the deadline check stays done (no spurious
+                # failed journal event) and is never treated as a wedge
+                if job.finish("failed", error={
+                        "code": protocol.E_JOB_TIMEOUT,
+                        "message": f"job exceeded its {job.timeout_s:g}s "
+                                   "deadline and was reaped"},
+                        detail=self._reap_detail(job),
+                        on_commit=lambda: self._journal_append(
+                            {"event": "failed", "id": job.id})):
+                    self._reaped, self._reaped_at = job, time.time()
+            reaped = self._reaped
+            if reaped is not None and self._current is reaped:
+                hb = reaped.heartbeat_at or 0.0
+                if hb > self._reaped_at:
+                    # the job heartbeats (chain_product calls touch after
+                    # every multiply): the executor is slow but PROGRESSING
+                    # inside a reaped job, not wedged in a hung backend
+                    # call -- restart the grace window at the newest beat
+                    self._reaped_at = hb
+                elif time.time() - self._reaped_at > self._wedge_grace_s:
+                    self._reaped = None
+                    self._degrade(f"executor wedged on reaped job "
+                                  f"{reaped.id}")
+            elif reaped is not None and self._current is not reaped:
+                self._reaped = None  # executor moved on: slow, not wedged
+
+    def _degrade(self, reason: str) -> None:
+        """Abandon the current executor, record why, probe the backend (a
+        subprocess -- the only safe touch of a possibly-dead device) and
+        spawn a replacement executor pinned to the host-only oracle."""
+        if self._stop.is_set():
+            return
+        with self._lock:
+            already = self.degraded
+            self.degraded = True
+            self.degrade_reason = reason
+        # service first, diagnostics second: the replacement host-only
+        # executor needs nothing from the probe, and the probe subprocess
+        # can block for the full SPGEMM_TPU_PROBE_TIMEOUT (default 150 s)
+        # against a dead device -- queued jobs must not wait on it, and
+        # neither may the watchdog (it still has reaping to do), so the
+        # probe runs on its own thread and only feeds stats
+        self._spawn_executor(degraded=True)
+        if already:
+            return
+        log.warning("degrading to CPU failover path: %s", reason)
+        probe = self._probe
+        if probe is None:
+            from spgemm_tpu.utils.backend_probe import (  # noqa: PLC0415
+                probe_default_backend)
+            probe = probe_default_backend
+
+        def _run_probe() -> None:
+            try:
+                self._probe_outcome = probe()
+            except Exception as e:  # noqa: BLE001 -- diagnostics must not raise
+                self._probe_outcome = f"probe-error: {e!r}"
+            log.warning("backend probe after degrade: %s",
+                        self._probe_outcome)
+
+        threading.Thread(target=_run_probe, name="spgemmd-probe",
+                         daemon=True).start()
+
+    # ----------------------------------------------------------- protocol --
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed during shutdown
+            with self._lock:
+                admit = self._conn_count < self.MAX_CONNS
+                if admit:
+                    self._conn_count += 1
+            if not admit:
+                try:
+                    conn.sendall(protocol.encode(protocol.error(
+                        protocol.E_BUSY,
+                        f"too many concurrent connections "
+                        f"({self.MAX_CONNS}); retry shortly")))
+                except OSError:
+                    pass
+                conn.close()
+                continue
+            conn.settimeout(self.CONN_IDLE_TIMEOUT_S)
+            t = threading.Thread(target=self._handle_conn, args=(conn,),
+                                 name="spgemmd-conn", daemon=True)
+            t.start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            for line in protocol.read_lines(
+                    conn, max_line=protocol.MAX_LINE_BYTES):
+                if not line.strip():
+                    continue
+                try:
+                    msg = protocol.parse_request(line)
+                except protocol.ProtocolError as e:
+                    resp = protocol.error(e.code, e.message)
+                else:
+                    try:
+                        resp = self._dispatch(msg)
+                    except Exception as e:  # noqa: BLE001 -- daemon must survive
+                        log.warning("request handler failed: %r", e)
+                        resp = protocol.error(protocol.E_INTERNAL, repr(e))
+                conn.sendall(protocol.encode(resp))
+        except protocol.ProtocolError as e:
+            # oversized line: answer once, then drop the connection (the
+            # pending buffer cannot be resynchronized to a line boundary)
+            try:
+                conn.sendall(protocol.encode(protocol.error(e.code,
+                                                            e.message)))
+            except OSError:
+                pass
+        except OSError:
+            pass  # peer went away mid-conversation (or idled out)
+        finally:
+            conn.close()
+            with self._lock:
+                self._conn_count -= 1
+
+    def _dispatch(self, msg: dict) -> dict:
+        op = msg["op"]
+        if op == "submit":
+            return self._op_submit(msg)
+        if op == "status":
+            return self._op_status(msg, wait=False)
+        if op == "wait":
+            return self._op_status(msg, wait=True)
+        if op == "stats":
+            return self._op_stats()
+        return self._op_shutdown()
+
+    def _op_submit(self, msg: dict) -> dict:
+        if self._stop.is_set():
+            return protocol.error(protocol.E_SHUTTING_DOWN,
+                                  "daemon is shutting down")
+        folder = msg.get("folder")
+        if not isinstance(folder, str) or not folder:
+            return protocol.error(protocol.E_BAD_REQUEST,
+                                  "submit requires a non-empty `folder`")
+        options = msg.get("options") or {}
+        if not isinstance(options, dict):
+            return protocol.error(protocol.E_BAD_REQUEST,
+                                  "`options` must be a JSON object")
+        unknown = sorted(set(options) - set(SUBMIT_OPTIONS))
+        if unknown:
+            return protocol.error(
+                protocol.E_BAD_REQUEST,
+                f"unknown submit option(s) {', '.join(unknown)} (known: "
+                f"{', '.join(SUBMIT_OPTIONS)})")
+        # option VALUES are validated at admission like option names: a
+        # bad round_size/backend must answer bad-request here, not fail
+        # the job later with an opaque job-error from inside the runner
+        rs = options.get("round_size")
+        if rs is not None:
+            try:
+                rs_ok = int(rs) >= 1
+            except (TypeError, ValueError):
+                rs_ok = False
+            if not rs_ok:
+                return protocol.error(
+                    protocol.E_BAD_REQUEST,
+                    f"round_size must be an integer >= 1, got {rs!r}")
+        backend = options.get("backend")
+        if backend is not None and backend not in protocol.CHAIN_BACKENDS:
+            return protocol.error(
+                protocol.E_BAD_REQUEST,
+                f"unknown backend {backend!r} (known: "
+                f"{', '.join(protocol.CHAIN_BACKENDS)})")
+        if not os.path.isfile(os.path.join(folder, "size")):
+            return protocol.error(
+                protocol.E_BAD_REQUEST,
+                f"{folder!r} is not a chain input directory (no `size` "
+                "file)")
+        output = options.get("output") or os.path.join(folder, "matrix")
+        # an explicit 0 means "no deadline" (the knob's own semantics), so
+        # only an ABSENT option falls back to the daemon default
+        ts = options.get("timeout_s")
+        try:
+            timeout_s = float(self._job_timeout_s if ts is None else ts)
+        except (TypeError, ValueError):
+            return protocol.error(protocol.E_BAD_REQUEST,
+                                  f"timeout_s must be a number, got {ts!r}")
+        if timeout_s < 0:
+            # a negative deadline would silently mean "no deadline"
+            # (overdue() treats <= 0 as none) -- reject it like any other
+            # bad option value instead of un-deadlining the job
+            return protocol.error(
+                protocol.E_BAD_REQUEST,
+                f"timeout_s must be >= 0 (0 = no deadline), got {ts!r}")
+        with self._lock:
+            job_id = f"job-{self._next_id}"
+            self._next_id += 1
+        job = Job(job_id, folder, output, options, timeout_s=timeout_s)
+        # journal BEFORE enqueueing: the executor can pop and terminally
+        # finish a job the instant it is queued, and its done/failed
+        # journal event (committed inside Job.finish) must never precede
+        # the submit record -- replay would resurrect finished work.
+        # Journal-then-reject leaves at worst a submit record a matching
+        # failed event cancels; journal-then-crash re-runs an admitted
+        # job, which is the at-least-once contract restarts already have.
+        self._journal_append({"event": "submit", "id": job.id,
+                              "folder": folder, "output": output,
+                              "options": options, "timeout_s": timeout_s})
+        try:
+            depth = self.queue.submit(job)
+        except QueueFull as e:
+            self._journal_append({"event": "failed", "id": job.id})
+            return protocol.error(
+                protocol.E_QUEUE_FULL,
+                f"queue full ({e.cap} jobs queued); retry later or raise "
+                "SPGEMM_TPU_SERVE_QUEUE_CAP", id=None)
+        return protocol.ok(id=job.id, state=job.state, queued=depth)
+
+    def _op_status(self, msg: dict, wait: bool) -> dict:
+        job_id = msg.get("id")
+        job = self.queue.get(job_id) if isinstance(job_id, str) else None
+        if job is None:
+            return protocol.error(protocol.E_UNKNOWN_JOB,
+                                  f"no such job: {job_id!r}")
+        if wait:
+            timeout = msg.get("timeout")
+            try:
+                timeout = self.MAX_WAIT_SLICE_S if timeout is None \
+                    else min(float(timeout), self.MAX_WAIT_SLICE_S)
+            except (TypeError, ValueError):
+                return protocol.error(
+                    protocol.E_BAD_REQUEST,
+                    f"timeout must be a number, got {timeout!r}")
+            job.wait(timeout)
+        return protocol.ok(job=job.snapshot())
+
+    def _op_stats(self) -> dict:
+        from spgemm_tpu.ops import plancache  # noqa: PLC0415
+
+        try:
+            cache = plancache.stats()
+        except ValueError as e:
+            cache = {"error": str(e)}
+        return protocol.ok(
+            daemon="spgemmd",
+            uptime_s=round(time.time() - self._started_at, 3),
+            degraded=self.degraded,
+            degrade_reason=self.degrade_reason,
+            backend_probe=self._probe_outcome,
+            queue_cap=self._cap,
+            job_timeout_s=self._job_timeout_s,
+            jobs=self.queue.counts(),
+            plan_cache=cache,
+            socket=self.socket_path,
+        )
+
+    def _op_shutdown(self) -> dict:
+        self._stop.set()
+        # the serve_forever loop (or the owner's stop()) tears down; the
+        # response still goes out on this connection before it closes
+        return protocol.ok(stopping=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """`spgemm_tpu serve`: run the daemon in the foreground."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu serve",
+        description="spgemmd: resident chain-serving daemon (one process "
+                    "owns the device; jobs reuse its warm jit/plan/"
+                    "crossover caches)")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="unix socket path (default: SPGEMM_TPU_SERVE_SOCKET "
+                        "or <tmpdir>/spgemmd-<uid>.sock)")
+    p.add_argument("--device", default=None, metavar="PLATFORM",
+                   help="pin a JAX platform before serving (e.g. cpu); "
+                        "without it the default backend is probed first and "
+                        "a dead accelerator starts the daemon degraded on "
+                        "CPU instead of hanging")
+    p.add_argument("--queue-cap", type=int, default=None,
+                   help="override SPGEMM_TPU_SERVE_QUEUE_CAP for this "
+                        "daemon")
+    p.add_argument("--no-journal", action="store_true",
+                   help="disable the on-disk job journal (jobs are lost on "
+                        "restart)")
+    p.add_argument("--verbose", "-v", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="%(name)s %(message)s")
+    degraded_at_start = False
+    if args.device:
+        from spgemm_tpu.utils.backend_probe import pin  # noqa: PLC0415
+        pin(args.device)
+    else:
+        from spgemm_tpu.utils.backend_probe import failover_to_cpu  # noqa: PLC0415
+        degraded_at_start = failover_to_cpu("spgemmd")
+    daemon = Daemon(args.socket, queue_cap=args.queue_cap,
+                    journal=not args.no_journal)
+    if degraded_at_start:
+        # the device was dead before we ever owned it: CPU failover path
+        # from the first job, reported in stats like a mid-flight degrade
+        daemon.degraded = True
+        daemon.degrade_reason = "startup probe: accelerator unreachable"
+    try:
+        daemon.serve_forever()
+    except KeyboardInterrupt:
+        daemon.stop()
+    except RuntimeError as e:
+        # e.g. a live daemon already owns the socket: a clean one-line
+        # refusal, not a traceback (the operator's retry loop reads it)
+        print(f"spgemmd: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

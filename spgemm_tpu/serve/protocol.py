@@ -1,0 +1,147 @@
+"""spgemmd wire protocol: versioned newline-delimited JSON over a unix
+domain socket.
+
+One request per line, one response line per request, connections may carry
+any number of requests.  Every message is a JSON object; requests carry
+`{"v": PROTOCOL_VERSION, "op": <op>, ...}` and responses carry
+`{"v": ..., "ok": true, ...}` or `{"v": ..., "ok": false, "error":
+{"code": <code>, "message": <text>}}`.  A malformed line is answered with
+a structured `bad-request` error -- the daemon must survive garbage input
+(acceptance-gated in tests/test_serve.py), so decode failures never
+propagate past the connection handler.
+
+Ops:
+  submit   {folder, options?}       -> {id, state, queued}
+  status   {id}                     -> {job: <snapshot>}
+  wait     {id, timeout?}           -> {job: <snapshot>} (blocks until the
+                                       job is terminal or timeout elapses;
+                                       one wait is clamped server-side to
+                                       Daemon.MAX_WAIT_SLICE_S so a waiter
+                                       never pins a connection slot --
+                                       client.wait() polls in slices)
+  stats    {}                       -> daemon-wide counters, degraded flag,
+                                       plan-cache stats
+  shutdown {}                       -> {stopping: true}
+
+jax-free by design: the client must be importable (and the protocol
+parsable) without initializing any backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from spgemm_tpu.utils import knobs
+
+PROTOCOL_VERSION = 1
+
+OPS = ("submit", "status", "wait", "stats", "shutdown")
+
+# server-side bound on one request line: a peer streaming newline-free
+# bytes must exhaust THIS, not the daemon's memory (real requests are a
+# few hundred bytes; 1 MiB leaves room for pathological-but-legal paths)
+MAX_LINE_BYTES = 1 << 20
+
+# the chain engine's multiply backends a submit may name -- the ONE list
+# the daemon validates against and the client offers (the run-once CLI
+# adds its host-only "oracle" on top; the daemon reserves that path for
+# degraded mode)
+CHAIN_BACKENDS = ("xla", "pallas", "mxu", "hybrid")
+
+# request-level error codes
+E_BAD_REQUEST = "bad-request"      # unparsable line / unknown op / bad version
+E_QUEUE_FULL = "queue-full"        # admission control rejection
+E_BUSY = "too-many-connections"    # concurrent-connection bound hit
+E_UNKNOWN_JOB = "unknown-job"
+E_SHUTTING_DOWN = "shutting-down"
+E_INTERNAL = "internal-error"      # handler crash (daemon survives)
+
+# job-failure codes (in a failed job's error dict)
+E_JOB_TIMEOUT = "job-timeout"      # reaped past SPGEMM_TPU_SERVE_JOB_TIMEOUT
+E_EXECUTOR_DIED = "executor-died"  # executor thread died/wedged mid-job
+E_JOB_ERROR = "job-error"          # the chain runner raised
+
+
+class ProtocolError(Exception):
+    """A request that cannot be dispatched; carries the structured code."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def default_socket_path() -> str:
+    """SPGEMM_TPU_SERVE_SOCKET, or <tmpdir>/spgemmd-<uid>.sock (uid-scoped
+    so two users on one host never race on the same daemon socket)."""
+    configured = knobs.get("SPGEMM_TPU_SERVE_SOCKET")
+    if configured:
+        return configured
+    return os.path.join(tempfile.gettempdir(),
+                        f"spgemmd-{os.getuid()}.sock")
+
+
+def encode(msg: dict) -> bytes:
+    """One wire line for msg (compact JSON + newline)."""
+    return json.dumps(msg, separators=(",", ":")).encode() + b"\n"
+
+
+def ok(**fields) -> dict:
+    return {"v": PROTOCOL_VERSION, "ok": True, **fields}
+
+
+def error(code: str, message: str, **fields) -> dict:
+    return {"v": PROTOCOL_VERSION, "ok": False,
+            "error": {"code": code, "message": message}, **fields}
+
+
+def parse_request(line: str) -> dict:
+    """Decode + validate one request line; ProtocolError on anything the
+    dispatcher could not act on (the caller answers with error())."""
+    try:
+        msg = json.loads(line)
+    except ValueError as e:
+        raise ProtocolError(E_BAD_REQUEST,
+                            f"request is not valid JSON: {e}") from None
+    if not isinstance(msg, dict):
+        raise ProtocolError(E_BAD_REQUEST,
+                            "request must be a JSON object")
+    v = msg.get("v")
+    if v != PROTOCOL_VERSION:
+        raise ProtocolError(
+            E_BAD_REQUEST,
+            f"protocol version mismatch: daemon speaks v{PROTOCOL_VERSION}, "
+            f"request carries v={v!r}")
+    op = msg.get("op")
+    if op not in OPS:
+        raise ProtocolError(E_BAD_REQUEST,
+                            f"unknown op {op!r} (expected one of "
+                            f"{'|'.join(OPS)})")
+    return msg
+
+
+def read_lines(sock, bufsize: int = 65536, max_line: int | None = None):
+    """Yield decoded lines from a socket until EOF.  Bytes that arrive
+    after the last newline when the peer closes are NOT yielded -- a
+    request is only a request once its newline lands.
+
+    max_line bounds the pending (newline-less) buffer: past it,
+    ProtocolError(bad-request) -- the daemon answers and drops the
+    connection instead of growing without limit (garbage input must never
+    kill the device owner, and that includes OOM-killing it).  The client
+    side reads daemon-authored responses and needs no cap."""
+    buf = b""
+    while True:
+        chunk = sock.recv(bufsize)
+        if not chunk:
+            return
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            yield line.decode("utf-8", errors="replace")
+        if max_line is not None and len(buf) > max_line:
+            raise ProtocolError(
+                E_BAD_REQUEST,
+                f"request line exceeds {max_line} bytes without a newline")

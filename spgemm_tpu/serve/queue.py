@@ -1,0 +1,218 @@
+"""spgemmd job queue: bounded FIFO with admission control.
+
+Admission control is the daemon's back-pressure contract: a submit that
+arrives with SPGEMM_TPU_SERVE_QUEUE_CAP jobs already queued is rejected
+with a structured queue-full error instead of hanging the caller (the
+reference's analog is MPI ranks deadlocking when a peer falls behind --
+here overload is an answer, not a wedge).  Per-job deadlines are stored at
+submit time so the watchdog can reap a job that exceeds them with a
+structured job-timeout error.
+
+jax-free by design (imported by the client-side CLI path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+TERMINAL = ("done", "failed")
+
+
+class QueueFull(Exception):
+    """Admission-control rejection; carries the live cap for the error."""
+
+    def __init__(self, cap: int):
+        super().__init__(f"queue full: {cap} jobs already queued")
+        self.cap = cap
+
+
+class JobAbandoned(BaseException):
+    """Raised from a job's heartbeat to abort an abandoned chain at the
+    next multiply boundary (the job reached a terminal state under the
+    executor's feet: watchdog reap, or a resubmit after presumed death).
+
+    BaseException on purpose: chain_product's failover wrapper catches
+    Exception -- device loss is its use case -- and must NOT mistake the
+    abort for a device failure to retry on the host oracle.  The signal
+    pierces it to the executor loop, which catches it by name."""
+
+    def __init__(self, job_id: str):
+        super().__init__(f"job {job_id} reached a terminal state; "
+                         "abandoning its chain")
+        self.job_id = job_id
+
+
+class Job:
+    """One submitted chain job and its full lifecycle record.
+
+    States: queued -> running -> done | failed.  Terminal transitions are
+    first-write-wins: the watchdog may reap a job (failed/job-timeout)
+    while the executor is still inside the runner, and the runner's own
+    completion must then NOT resurrect it.
+    """
+
+    def __init__(self, job_id: str, folder: str, output: str,
+                 options: dict, timeout_s: float = 0.0):
+        self.id = job_id
+        self.folder = folder
+        self.output = output
+        self.options = options
+        self.timeout_s = timeout_s  # 0 = no deadline
+        self.state = "queued"
+        self.error: dict | None = None
+        self.detail: dict = {}
+        self.submitted_at = time.time()
+        self.started_at: float | None = None
+        self.finished_at: float | None = None
+        self.heartbeat_at: float | None = None
+        # set by the daemon's executor when it picks the job up: the live
+        # PhaseScope (opaque here -- the queue stays jax-free) and the
+        # path the job ran on, read by the watchdog so a reaped job's
+        # status still carries its per-job phases/counters detail
+        self.scope = None
+        self.scope_degraded = False
+        self._lock = threading.Lock()
+        self._terminal = threading.Event()
+
+    def touch(self) -> None:
+        """Progress heartbeat (chain_product calls this after every
+        completed multiply): the watchdog's slow-vs-wedged signal."""
+        self.heartbeat_at = time.time()
+
+    def start(self) -> None:
+        with self._lock:
+            if self.state == "queued":
+                self.state = "running"
+                self.started_at = time.time()
+                self.heartbeat_at = self.started_at
+
+    def finish(self, state: str, error: dict | None = None,
+               detail: dict | None = None, on_commit=None) -> bool:
+        """Terminal transition; returns False (and changes nothing) if the
+        job is already terminal -- first writer wins.
+
+        on_commit (the daemon's journal append) runs INSIDE the winning
+        transition, before the terminal state wakes wait()ers or becomes
+        snapshot-visible: a client that saw the job finish must never race
+        a daemon restart past the journal record (a restarted daemon must
+        not re-run completed work)."""
+        assert state in TERMINAL
+        with self._lock:
+            if self.state in TERMINAL:
+                return False
+            self.state = state
+            self.error = error
+            if detail:
+                self.detail = detail
+            self.finished_at = time.time()
+            try:
+                if on_commit is not None:
+                    on_commit()
+            finally:
+                self._terminal.set()
+        return True
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the job is terminal; False on timeout."""
+        return self._terminal.wait(timeout)
+
+    def overdue(self, now: float | None = None) -> bool:
+        """True iff running with a deadline and past it."""
+        if self.timeout_s <= 0 or self.state != "running":
+            return False
+        started = self.started_at or self.submitted_at
+        return (now or time.time()) - started > self.timeout_s
+
+    def snapshot(self) -> dict:
+        """Wire form for status/wait responses."""
+        with self._lock:
+            return {
+                "id": self.id,
+                "folder": self.folder,
+                "output": self.output,
+                "options": dict(self.options),
+                "state": self.state,
+                "error": self.error,
+                "detail": dict(self.detail),
+                "timeout_s": self.timeout_s,
+                "submitted_at": self.submitted_at,
+                "started_at": self.started_at,
+                "finished_at": self.finished_at,
+                "heartbeat_at": self.heartbeat_at,
+            }
+
+
+class JobQueue:
+    """Bounded FIFO over Job objects + the daemon's job index.
+
+    The cap bounds jobs in the *queued* state (a running job no longer
+    occupies a queue slot).  Completed jobs stay in the index so
+    status/wait work after the fact, but only the RETAIN_TERMINAL most
+    recent -- a resident daemon must not grow per-job state (options,
+    detail, the stashed PhaseScope) for its lifetime; a status for an
+    evicted id answers unknown-job.
+    """
+
+    # terminal jobs retained; past this the oldest are evicted at the
+    # next admission (class attribute so tests can shrink it)
+    RETAIN_TERMINAL = 512
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._fifo: deque[Job] = deque()
+        self._jobs: dict[str, Job] = {}
+        self._lock = threading.Lock()
+        self._avail = threading.Condition(self._lock)
+
+    def submit(self, job: Job) -> int:
+        """Admit job (FIFO order); QueueFull once cap jobs are queued.
+        Returns the queue depth including the new job."""
+        with self._avail:
+            queued = len(self._fifo)
+            if queued >= self.cap:
+                raise QueueFull(self.cap)
+            # evict the oldest terminal jobs beyond the retention bound
+            # (dict order = admission order, oldest first)
+            terminal = [j.id for j in self._jobs.values()
+                        if j.state in TERMINAL]
+            for jid in terminal[:max(0, len(terminal)
+                                     - self.RETAIN_TERMINAL)]:
+                del self._jobs[jid]
+            self._fifo.append(job)
+            self._jobs[job.id] = job
+            self._avail.notify()
+            return queued + 1
+
+    def next(self, timeout: float | None = None) -> Job | None:
+        """Pop the oldest queued job; None on timeout (executor idle
+        tick)."""
+        with self._avail:
+            if not self._fifo:
+                self._avail.wait(timeout)
+            if not self._fifo:
+                return None
+            return self._fifo.popleft()
+
+    def get(self, job_id: str) -> Job | None:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def running(self) -> list[Job]:
+        """Jobs currently in the running state (the watchdog's sweep set
+        when an executor dies: a dying thread's finally may already have
+        released its current-job slot)."""
+        with self._lock:
+            return [j for j in self._jobs.values() if j.state == "running"]
+
+    def counts(self) -> dict[str, int]:
+        """State histogram over every job ever admitted + live depth."""
+        with self._lock:
+            jobs = list(self._jobs.values())
+            depth = len(self._fifo)
+        hist = {"queued": 0, "running": 0, "done": 0, "failed": 0}
+        for j in jobs:
+            hist[j.state] = hist.get(j.state, 0) + 1
+        hist["depth"] = depth
+        return hist

@@ -1,0 +1,113 @@
+"""`make serve-smoke`: end-to-end spgemmd proof on the CPU backend.
+
+Starts a real daemon subprocess on a temp socket with `--device cpu`,
+submits the SAME tiny chain twice, and asserts the serving contract:
+
+  * both results are byte-exact against the host-only oracle multiply;
+  * the second job's status detail reports `plan_cache_hits >= 1` -- the
+    warm-across-jobs proof the daemon exists for (a run-once CLI would
+    re-plan from scratch);
+  * stats reports a healthy (non-degraded) daemon;
+  * shutdown is clean (daemon exits 0, socket unlinked).
+
+Any step failing exits nonzero.  This process itself stays jax-free (the
+oracle and the generator are pure numpy) -- only the daemon touches a
+backend, which is the deployment shape being smoked.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _fail(proc: subprocess.Popen | None, msg: str) -> int:
+    print(f"serve-smoke: FAIL: {msg}", file=sys.stderr)
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+    if proc is not None:
+        out, _ = proc.communicate(timeout=10)
+        sys.stderr.write(out[-4000:] if out else "")
+    return 1
+
+
+def main() -> int:
+    import numpy as np  # noqa: PLC0415
+
+    from spgemm_tpu.serve import client  # noqa: PLC0415
+    from spgemm_tpu.utils import io_text  # noqa: PLC0415
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix  # noqa: PLC0415
+    from spgemm_tpu.utils.gen import random_chain  # noqa: PLC0415
+    from spgemm_tpu.utils.semantics import chain_oracle  # noqa: PLC0415
+
+    tmp = tempfile.mkdtemp(prefix="spgemmd-smoke-")
+    sock = os.path.join(tmp, "d.sock")
+    folder = os.path.join(tmp, "chain_in")
+    n, k = 4, 4
+    mats = random_chain(n, 6, k, 0.5, np.random.default_rng(7), "full")
+    io_text.write_chain_dir(folder, mats, k)
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, k, want).prune_zeros())
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+         "--socket", sock, "--device", "cpu", "-v"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(sock):
+            if proc.poll() is not None:
+                return _fail(proc, "daemon exited before binding its socket")
+            if time.time() > deadline:
+                return _fail(proc, "daemon never bound its socket")
+            time.sleep(0.1)
+
+        outputs = []
+        for i in (1, 2):
+            out = os.path.join(tmp, f"matrix.{i}")
+            resp = client.submit(folder, sock, {"output": out})
+            resp = client.wait(resp["id"], sock, timeout=300)
+            job = resp["job"]
+            if job["state"] != "done":
+                return _fail(proc, f"job {i} ended {job['state']}: "
+                                   f"{job['error']}")
+            outputs.append((out, job))
+
+        for i, (out, _) in enumerate(outputs, 1):
+            got = open(out, "rb").read()
+            if got != want_bytes:
+                return _fail(proc, f"job {i} output does not match the "
+                                   "oracle bytes")
+        hits = outputs[1][1]["detail"].get("plan_cache_hits", 0)
+        if hits < 1:
+            return _fail(proc, "second submit reported plan_cache_hits="
+                               f"{hits}; the daemon's plan cache is cold "
+                               "across jobs")
+        st = client.stats(sock)
+        if st.get("degraded"):
+            return _fail(proc, f"daemon reports degraded: "
+                               f"{st.get('degrade_reason')}")
+
+        client.shutdown(sock)
+        try:
+            rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            return _fail(proc, "daemon did not exit after shutdown")
+        if rc != 0:
+            return _fail(proc, f"daemon exited {rc} after shutdown")
+        if os.path.exists(sock):
+            return _fail(None, "socket not unlinked on clean shutdown")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    print(f"serve-smoke: OK (2 jobs bit-exact vs oracle, warm hits={hits}, "
+          "clean shutdown)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

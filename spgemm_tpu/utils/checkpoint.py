@@ -9,12 +9,15 @@ BlockSparseMatrix arrays, so a checkpoint round-trips losslessly.
 
 from __future__ import annotations
 
+import logging
 import os
 import re
 
 import numpy as np
 
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+log = logging.getLogger("spgemm_tpu.checkpoint")
 
 _PASS_RE = re.compile(r"^pass_(\d+)\.npz$")
 
@@ -35,18 +38,8 @@ def save_pass(ckpt_dir: str, pass_idx: int, matrices: list[BlockSparseMatrix]) -
     return path
 
 
-def latest_pass(ckpt_dir: str) -> tuple[int, list[BlockSparseMatrix]] | None:
-    """Newest complete checkpoint as (pass_idx, matrices), or None."""
-    if not os.path.isdir(ckpt_dir):
-        return None
-    best = -1
-    for name in os.listdir(ckpt_dir):
-        match = _PASS_RE.match(name)
-        if match:
-            best = max(best, int(match.group(1)))
-    if best < 0:
-        return None
-    with np.load(os.path.join(ckpt_dir, f"pass_{best}.npz")) as z:
+def _load_pass(path: str) -> list[BlockSparseMatrix]:
+    with np.load(path) as z:
         n = int(z["n"])
         mats = []
         for i in range(n):
@@ -54,4 +47,29 @@ def latest_pass(ckpt_dir: str) -> tuple[int, list[BlockSparseMatrix]] | None:
             mats.append(BlockSparseMatrix(
                 rows=rows, cols=cols, k=k,
                 coords=z[f"m{i}_coords"], tiles=z[f"m{i}_tiles"]))
-    return best, mats
+    return mats
+
+
+def latest_pass(ckpt_dir: str) -> tuple[int, list[BlockSparseMatrix]] | None:
+    """Newest COMPLETE checkpoint as (pass_idx, matrices), or None.
+
+    save_pass writes atomically (tmp + rename), but the newest file can
+    still be corrupt -- a torn disk write, a copy of a half-synced
+    directory, filesystem damage.  A resume must not die on it: any pass
+    that fails to load falls back to the next-newest with a warning (every
+    pass is a self-contained snapshot, so an older one is always a valid
+    -- just earlier -- restart point).  Only when no pass loads at all
+    does the caller start from scratch."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    indices = sorted(
+        (int(m.group(1)) for m in map(_PASS_RE.match, os.listdir(ckpt_dir))
+         if m), reverse=True)
+    for idx in indices:
+        path = os.path.join(ckpt_dir, f"pass_{idx}.npz")
+        try:
+            return idx, _load_pass(path)
+        except Exception as e:  # noqa: BLE001 -- any unreadable pass falls back
+            log.warning("checkpoint %s unreadable (%r); falling back to the "
+                        "next-newest pass", path, e)
+    return None

@@ -124,6 +124,30 @@ _KNOBS = (
          "Multihost partner-loss detection window, seconds (unset: jax's "
          "default, 100 s).",
          "parallel/multihost.py", minimum=1),
+    Knob("SPGEMM_TPU_SERVE_SOCKET", "path",
+         "spgemmd unix-domain socket path (unset: "
+         "<tmpdir>/spgemmd-<uid>.sock); the on-disk job journal lives "
+         "next to it at <socket>.journal.",
+         "serve/protocol.py"),
+    Knob("SPGEMM_TPU_SERVE_QUEUE_CAP", "int",
+         "spgemmd admission cap: a submit arriving with this many jobs "
+         "already queued is rejected with a structured queue-full error "
+         "(serve/queue.py) instead of hanging the caller.",
+         "serve/daemon.py", default="64", minimum=1),
+    Knob("SPGEMM_TPU_SERVE_JOB_TIMEOUT", "float",
+         "spgemmd per-job deadline, seconds: a job running past it is "
+         "reaped with a structured job-timeout error, and an executor "
+         "still stuck on it afterwards counts as wedged (watchdog "
+         "degrade-to-CPU path); 0 = no deadline.",
+         "serve/daemon.py", default="0", minimum=0),
+    Knob("SPGEMM_TPU_SERVE_WEDGE_GRACE_S", "float",
+         "spgemmd slow-vs-wedged discrimination window, seconds: after "
+         "reaping a job the watchdog waits this long for an executor "
+         "heartbeat (one fires per COMPLETED multiply) before declaring "
+         "the executor wedged and degrading to the CPU failover path -- "
+         "must exceed the longest single multiply expected on the "
+         "deployment, or a merely-slow job degrades a healthy daemon.",
+         "serve/daemon.py", default="60", minimum=0),
     Knob("SPGEMM_TPU_PROBE_TIMEOUT", "float",
          "Backend liveness probe subprocess timeout, seconds (a dead TPU "
          "HANGS, never raises -- the probe is the only safe touch).",

@@ -88,6 +88,53 @@ class PhaseTimers:
         with self._lock:
             return dict(self.counters)
 
+    def scope(self) -> "PhaseScope":
+        """A diff view anchored at the current accumulation state.
+
+        The registry accumulates process-wide (bench.py resets it between
+        iterations, but a resident daemon must NOT reset -- concurrent
+        readers and the `cli knobs` listing see the same registry), so a
+        per-job report needs a baseline-and-diff: everything accumulated
+        AFTER scope() was called, nothing before.  Used by serve/daemon.py
+        so job 2's status never includes job 1's phases."""
+        return PhaseScope(self)
+
+
+class PhaseScope:
+    """Snapshot/diff view over a PhaseTimers (see PhaseTimers.scope):
+    snapshot()/counter_snapshot() return only what accumulated since the
+    scope was opened, with untouched names dropped."""
+
+    def __init__(self, timers: PhaseTimers):
+        self._timers = timers
+        with timers._lock:
+            self._totals0 = dict(timers.totals)
+            self._counters0 = dict(timers.counters)
+
+    def snapshot(self) -> dict[str, float]:
+        """Per-phase seconds accumulated since the scope opened (rounded,
+        zero-delta names dropped)."""
+        with self._timers._lock:
+            now = dict(self._timers.totals)
+        out = {}
+        for name, total in now.items():
+            delta = total - self._totals0.get(name, 0.0)
+            if delta > 0.0:
+                out[name] = round(delta, 4)
+        return out
+
+    def counter_snapshot(self) -> dict[str, int]:
+        """Event-counter deltas since the scope opened (zero deltas
+        dropped)."""
+        with self._timers._lock:
+            now = dict(self._timers.counters)
+        out = {}
+        for name, n in now.items():
+            delta = n - self._counters0.get(name, 0)
+            if delta:
+                out[name] = delta
+        return out
+
 
 # Global registry for the SpGEMM engine's internal phases (symbolic join /
 # round planning / numeric dispatch / assembly) -- the analog of the

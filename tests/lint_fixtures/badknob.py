@@ -26,6 +26,17 @@ def bad_planner_knob_reads():
     return ahead, cap
 
 
+def bad_serve_knob_reads():
+    # the spgemmd serving knobs are registry knobs like any other: raw
+    # reads are KNB findings (registered in utils/knobs.py, read via
+    # knobs.get in serve/daemon.py / serve/queue.py / serve/protocol.py)
+    sock = os.environ.get("SPGEMM_TPU_SERVE_SOCKET")  # seeded KNB
+    cap = os.getenv("SPGEMM_TPU_SERVE_QUEUE_CAP", "64")  # seeded KNB
+    deadline = environ["SPGEMM_TPU_SERVE_JOB_TIMEOUT"]  # seeded KNB
+    grace = os.getenv("SPGEMM_TPU_SERVE_WEDGE_GRACE_S", "60")  # seeded KNB
+    return sock, cap, deadline, grace
+
+
 def legal_non_knob_reads():
     # non-SPGEMM_TPU names are not knobs: raw access stays legal
     return os.environ.get("JAX_PLATFORMS", ""), os.getenv("HOME")

@@ -33,6 +33,44 @@ def test_latest_pass_empty(tmp_path):
     assert checkpoint.latest_pass(str(tmp_path)) is None
 
 
+def test_latest_pass_falls_back_past_truncated_newest(tmp_path, caplog):
+    """A corrupt/truncated newest pass_N.npz must not kill the resume: the
+    loader falls back to the next-newest COMPLETE pass with a warning."""
+    rng = np.random.default_rng(404)
+    mats2 = random_chain(2, 3, 2, 0.5, rng, "full")
+    checkpoint.save_pass(str(tmp_path), 2, mats2)
+    path3 = checkpoint.save_pass(str(tmp_path), 3,
+                                 random_chain(1, 3, 2, 0.5, rng, "full"))
+    with open(path3, "r+b") as f:  # tear the newest file mid-archive
+        f.truncate(os.path.getsize(path3) // 2)
+    with caplog.at_level("WARNING", logger="spgemm_tpu.checkpoint"):
+        idx, loaded = checkpoint.latest_pass(str(tmp_path))
+    assert idx == 2 and loaded == mats2
+    assert any("pass_3.npz" in r.getMessage() for r in caplog.records)
+
+
+def test_latest_pass_all_corrupt_returns_none(tmp_path):
+    (tmp_path / "pass_1.npz").write_bytes(b"not an npz at all")
+    (tmp_path / "pass_2.npz").write_bytes(b"")
+    assert checkpoint.latest_pass(str(tmp_path)) is None
+
+
+def test_chain_resume_survives_truncated_newest(tmp_path):
+    """End-to-end: chain_product resumes from the newest COMPLETE pass
+    when the newest file is torn."""
+    rng = np.random.default_rng(405)
+    mats = random_chain(5, 4, 2, 0.5, rng, "full")
+    want = chain_product(mats)
+    arr = [chain_product(mats[i : i + 2]) for i in range(0, 4, 2)] + [mats[4]]
+    ckdir = str(tmp_path / "ck")
+    checkpoint.save_pass(ckdir, 1, arr)
+    bad = checkpoint.save_pass(ckdir, 2, arr)  # pose as a newer, torn pass
+    with open(bad, "r+b") as f:
+        f.truncate(16)
+    garbage = random_chain(5, 4, 2, 0.5, np.random.default_rng(998))
+    assert chain_product(garbage, checkpoint_dir=ckdir) == want
+
+
 def test_chain_with_checkpointing_matches_plain(tmp_path):
     rng = np.random.default_rng(402)
     mats = random_chain(5, 4, 2, 0.5, rng, "full")

@@ -79,6 +79,34 @@ def test_cli_out_of_core(tmp_path, capsys):
     assert open(out, "rb").read() == _expected_bytes(mats, k)
 
 
+def test_cli_serve_subcommands_dispatch(tmp_path, capsys):
+    """`submit`/`status` dispatch to the spgemmd client handlers (fail
+    fast with rc 1 when no daemon listens -- never an argparse crash or a
+    hang)."""
+    dead = str(tmp_path / "none.sock")
+    assert run(["status", "--socket", dead]) == 1
+    assert run(["submit", str(tmp_path), "--socket", dead]) == 1
+    err = capsys.readouterr().err
+    assert "status failed" in err and "submit failed" in err
+
+
+def test_cli_serve_named_input_dir_keeps_folder_meaning(tmp_path,
+                                                        monkeypatch,
+                                                        capsys):
+    """Like `knobs`: an INPUT directory named `serve` (it has a `size`
+    file) keeps the reference-contract meaning instead of being swallowed
+    by the subcommand."""
+    rng = np.random.default_rng(71)
+    k = 2
+    mats = random_chain(2, 3, k, 0.6, rng, "small")
+    folder = str(tmp_path / "serve")
+    io_text.write_chain_dir(folder, mats, k)
+    monkeypatch.chdir(tmp_path)
+    out = str(tmp_path / "out")
+    assert run(["serve", "--output", out]) == 0
+    assert open(out, "rb").read() == _expected_bytes(mats, k)
+
+
 def test_cli_default_output_cwd(tmp_path, monkeypatch, capsys):
     """The reference writes to ./matrix in the cwd (sparse_matrix_mult.cu:595)."""
     rng = np.random.default_rng(70)

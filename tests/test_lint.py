@@ -74,14 +74,17 @@ def test_fld_scope_is_path_based(tmp_path):
 # ------------------------------------------------------------- KNB rule --
 def test_knb_fixture_each_violation_caught():
     """Every READ spelling is a finding (the three classic ones plus the
-    seeded planner-knob reads); the write/del in the same fixture (how
-    harnesses and tests drive knob values) must NOT be."""
+    seeded planner- and serve-knob reads); the write/del in the same
+    fixture (how harnesses and tests drive knob values) must NOT be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 5
+    assert [f.rule for f in findings] == ["KNB"] * 9
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
                    "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
-                   "SPGEMM_TPU_PLAN_CACHE_CAP"):
+                   "SPGEMM_TPU_PLAN_CACHE_CAP", "SPGEMM_TPU_SERVE_SOCKET",
+                   "SPGEMM_TPU_SERVE_QUEUE_CAP",
+                   "SPGEMM_TPU_SERVE_JOB_TIMEOUT",
+                   "SPGEMM_TPU_SERVE_WEDGE_GRACE_S"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -202,9 +205,10 @@ def test_json_report_fixture_run():
     assert rc.returncode == 1, rc.stderr[-2000:]
     report = json.loads(rc.stdout)
     assert report["clean"] is False
-    # badknob: 3 classic + 2 planner-knob reads; badbackend: 3 import-time
-    # touches; badplanner: 2 @host_only-body touches
-    assert report["counts"] == {"FLD": 5, "KNB": 5, "BKD": 5, "DOC": 1,
+    # badknob: 3 classic + 2 planner-knob + 4 serve-knob reads;
+    # badbackend: 3 import-time touches; badplanner: 2 @host_only-body
+    # touches
+    assert report["counts"] == {"FLD": 5, "KNB": 9, "BKD": 5, "DOC": 1,
                                 "PARSE": 0}
     for f in report["findings"]:
         assert set(f) == {"file", "line", "rule", "message"}

@@ -18,7 +18,12 @@ into every run via the `examples` heuristics below.
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis (not baked into "
+                         "every toolchain image)")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from spgemm_tpu.ops import u64
 from spgemm_tpu.ops.symbolic import symbolic_join

@@ -1,0 +1,685 @@
+"""spgemmd (serve/): protocol edge cases, admission control, watchdog
+degrade paths, the warm-plan-cache serving proof, per-job timer scoping,
+and journal-based restart resume -- all tier-1 on the 8-vdev CPU backend.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.serve import client, protocol
+from spgemm_tpu.serve.daemon import Daemon
+from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
+                                    QueueFull)
+from spgemm_tpu.utils import io_text
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_chain
+from spgemm_tpu.utils.semantics import chain_oracle
+from spgemm_tpu.utils.timers import PhaseTimers
+
+
+def _chain_folder(tmp_path, n=3, k=2, seed=7, name="chain_in"):
+    """A reference-format input dir + the oracle's output bytes."""
+    mats = random_chain(n, 4, k, 0.5, np.random.default_rng(seed), "full")
+    folder = str(tmp_path / name)
+    io_text.write_chain_dir(folder, mats, k)
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, k, want).prune_zeros())
+    return folder, want_bytes
+
+
+@pytest.fixture
+def make_daemon(tmp_path):
+    """Daemon factory bound to a per-test socket; stops them on teardown."""
+    daemons = []
+
+    def _make(idx=0, **kw):
+        d = Daemon(str(tmp_path / f"d{idx}.sock"), **kw)
+        d.start()
+        daemons.append(d)
+        return d
+
+    yield _make
+    for d in daemons:
+        d.stop()
+
+
+def _raw_roundtrip(sock_path, payload: bytes) -> dict:
+    """One raw line out, one response line back (no client validation)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(10.0)
+        s.connect(sock_path)
+        s.sendall(payload)
+        for line in protocol.read_lines(s):
+            return json.loads(line)
+    raise AssertionError("no response line")
+
+
+def _wait_until(pred, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------------- protocol --
+def test_malformed_line_gets_error_and_daemon_survives(make_daemon):
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    resp = _raw_roundtrip(d.socket_path, b"this is not json\n")
+    assert resp["ok"] is False
+    assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+    # same daemon, next request: still serving
+    st = client.stats(d.socket_path)
+    assert st["ok"] is True and st["daemon"] == "spgemmd"
+
+
+def test_protocol_version_and_op_validation(make_daemon):
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    resp = _raw_roundtrip(
+        d.socket_path, json.dumps({"v": 99, "op": "stats"}).encode() + b"\n")
+    assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+    assert "version" in resp["error"]["message"]
+    resp = _raw_roundtrip(
+        d.socket_path,
+        json.dumps({"v": protocol.PROTOCOL_VERSION,
+                    "op": "frobnicate"}).encode() + b"\n")
+    assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+    assert "frobnicate" in resp["error"]["message"]
+
+
+def test_submit_validation(tmp_path, make_daemon):
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    # not a chain dir (no `size` file)
+    with pytest.raises(client.ServeError) as ei:
+        client.submit(str(tmp_path / "nowhere"), d.socket_path)
+    assert ei.value.code == protocol.E_BAD_REQUEST
+    # unknown option names are rejected, and named
+    folder, _ = _chain_folder(tmp_path)
+    with pytest.raises(client.ServeError) as ei:
+        client.submit(folder, d.socket_path, {"round_sise": 4})
+    assert ei.value.code == protocol.E_BAD_REQUEST
+    assert "round_sise" in ei.value.message
+    # unknown job id
+    with pytest.raises(client.ServeError) as ei:
+        client.status("job-999", d.socket_path)
+    assert ei.value.code == protocol.E_UNKNOWN_JOB
+
+
+def test_oversized_line_bounded_with_bad_request(make_daemon):
+    """A newline-free byte stream past MAX_LINE_BYTES gets a structured
+    bad-request and the connection dropped -- never an unbounded buffer in
+    the device owner -- and the daemon keeps serving."""
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    resp = _raw_roundtrip(d.socket_path,
+                          b"x" * (protocol.MAX_LINE_BYTES + 2))
+    assert resp["ok"] is False
+    assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+    assert "exceeds" in resp["error"]["message"]
+    assert client.stats(d.socket_path)["ok"] is True
+
+
+def test_non_numeric_timeouts_are_bad_request(tmp_path, make_daemon):
+    """timeout_s in submit options / timeout on wait that can't float()
+    answer bad-request naming the value, not internal-error."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    with pytest.raises(client.ServeError) as ei:
+        client.submit(folder, d.socket_path, {"timeout_s": "5s"})
+    assert ei.value.code == protocol.E_BAD_REQUEST
+    assert "5s" in ei.value.message
+    j = client.submit(folder, d.socket_path)
+    resp = _raw_roundtrip(
+        d.socket_path,
+        json.dumps({"v": protocol.PROTOCOL_VERSION, "op": "wait",
+                    "id": j["id"], "timeout": "soon"}).encode() + b"\n")
+    assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+
+
+def test_shutdown_op(make_daemon):
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    resp = client.shutdown(d.socket_path)
+    assert resp["stopping"] is True
+    assert d._stop.is_set()
+
+
+# ------------------------------------------------------- admission ctrl --
+def test_queue_cap_overflow_returns_structured_rejection(tmp_path,
+                                                         make_daemon):
+    folder, _ = _chain_folder(tmp_path)
+    gate = threading.Event()
+
+    def runner(job, degraded=False):
+        gate.wait(30)
+
+    d = make_daemon(runner=runner, queue_cap=1)
+    try:
+        j1 = client.submit(folder, d.socket_path)
+        _wait_until(lambda: d.queue.get(j1["id"]).state == "running",
+                    msg="job-1 running")
+        j2 = client.submit(folder, d.socket_path)  # fills the single slot
+        assert j2["state"] == "queued"
+        with pytest.raises(client.ServeError) as ei:
+            client.submit(folder, d.socket_path)
+        assert ei.value.code == protocol.E_QUEUE_FULL
+        assert "SPGEMM_TPU_SERVE_QUEUE_CAP" in ei.value.message
+    finally:
+        gate.set()
+    for j in (j1, j2):
+        resp = client.wait(j["id"], d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "done"
+
+
+def test_queue_fifo_and_counts():
+    q = JobQueue(cap=2)
+    a, b = Job("a", "f", "o", {}), Job("b", "f", "o", {})
+    assert q.submit(a) == 1 and q.submit(b) == 2
+    with pytest.raises(QueueFull):
+        q.submit(Job("c", "f", "o", {}))
+    assert q.next(0.01) is a and q.next(0.01) is b  # FIFO order
+    assert q.next(0.01) is None
+    a.start()
+    a.finish("done")
+    assert not a.finish("failed")  # terminal transitions are first-write-wins
+    assert a.state == "done"
+    assert q.counts() == {"queued": 1, "running": 0, "done": 1,
+                          "failed": 0, "depth": 0}
+
+
+# ------------------------------------------------ watchdog degrade paths --
+def test_job_timeout_reaped_and_wedged_executor_degrades(tmp_path,
+                                                         make_daemon):
+    """A job past SPGEMM_TPU_SERVE_JOB_TIMEOUT is reaped with a structured
+    job-timeout error; the executor still stuck on it counts as wedged,
+    the daemon degrades to the CPU path and serves the next job."""
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    calls = []
+
+    def runner(job, degraded=False):
+        calls.append((job.id, degraded))
+        if not degraded:
+            unwedge.wait(60)  # a hung backend call: never raises
+
+    d = make_daemon(runner=runner, job_timeout_s=0.3, wedge_grace_s=0.2,
+                    probe=lambda: "timeout")
+    try:
+        j1 = client.submit(folder, d.socket_path)
+        resp = client.wait(j1["id"], d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "failed"
+        assert resp["job"]["error"]["code"] == protocol.E_JOB_TIMEOUT
+        _wait_until(lambda: d.degraded, msg="degrade after wedge grace")
+        # the replacement executor serves the next job on the CPU path
+        j2 = client.submit(folder, d.socket_path)
+        resp = client.wait(j2["id"], d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "done"
+        assert resp["job"]["detail"]["degraded"] is True
+        assert (j2["id"], True) in calls
+        st = client.stats(d.socket_path)
+        assert st["degraded"] is True
+        assert "wedged" in st["degrade_reason"]
+        assert st["backend_probe"] == "timeout"
+    finally:
+        unwedge.set()
+
+
+def test_heartbeating_executor_is_slow_not_wedged(tmp_path, make_daemon):
+    """A reaped job whose executor keeps HEARTBEATING (chain progress:
+    touch() after every multiply) is slow, not wedged -- the daemon must
+    not degrade, and once the runner returns it serves on, healthy."""
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        # overruns a 0.2s deadline by far, but beats every 0.05s -- a
+        # legitimately long chain, not a hung backend call
+        deadline = time.time() + 2.0
+        while time.time() < deadline and not release.is_set():
+            job.touch()
+            time.sleep(0.05)
+
+    d = make_daemon(runner=runner, job_timeout_s=0.2, wedge_grace_s=0.3,
+                    probe=lambda: "should-never-run")
+    try:
+        j1 = client.submit(folder, d.socket_path)
+        resp = client.wait(j1["id"], d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "failed"  # the deadline still binds
+        assert resp["job"]["error"]["code"] == protocol.E_JOB_TIMEOUT
+        time.sleep(1.0)  # several grace windows of heartbeating overrun
+        assert d.degraded is False
+    finally:
+        release.set()
+    # the same executor finishes the overrun job's runner and serves on
+    j2 = client.submit(folder, d.socket_path, {"timeout_s": 0})
+    resp = client.wait(j2["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "done"
+    assert d.degraded is False
+
+
+def test_submit_timeout_zero_overrides_daemon_default(tmp_path,
+                                                      make_daemon):
+    """timeout_s=0 in submit options means NO deadline (the knob's own
+    semantics), even when the daemon carries a default -- only an absent
+    option falls back."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None,
+                    job_timeout_s=7.5)
+    j0 = client.submit(folder, d.socket_path, {"timeout_s": 0})
+    j1 = client.submit(folder, d.socket_path)
+    assert d.queue.get(j0["id"]).timeout_s == 0.0   # explicit opt-out
+    assert d.queue.get(j1["id"]).timeout_s == 7.5   # absent -> default
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_executor_death_fails_job_and_daemon_degrades(tmp_path,
+                                                      make_daemon):
+    """Kill the worker mid-job (BaseException escapes the per-job catch):
+    the job fails with a structured error, the daemon degrades and still
+    serves the next job, stats reports degraded."""
+    folder, _ = _chain_folder(tmp_path)
+    calls = []
+
+    def runner(job, degraded=False):
+        calls.append((job.id, degraded))
+        if not degraded:
+            raise KeyboardInterrupt  # kills the executor thread outright
+
+    d = make_daemon(runner=runner, probe=lambda: "error")
+    j1 = client.submit(folder, d.socket_path)
+    resp = client.wait(j1["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "failed"
+    assert resp["job"]["error"]["code"] == protocol.E_EXECUTOR_DIED
+    j2 = client.submit(folder, d.socket_path)
+    resp = client.wait(j2["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "done"
+    assert (j2["id"], True) in calls
+    st = client.stats(d.socket_path)
+    assert st["degraded"] is True and "died" in st["degrade_reason"]
+
+
+# ------------------------------------- the serving proof (real engine) --
+def test_second_identical_submit_hits_warm_plan_cache(tmp_path,
+                                                      make_daemon):
+    """The tentpole acceptance: two submits of the same input through the
+    real engine -- both bit-exact vs the oracle, and the second job's
+    status detail proves the plan cache stayed warm across jobs."""
+    from spgemm_tpu.ops import plancache
+
+    folder, want_bytes = _chain_folder(tmp_path, n=3, k=2)
+    plancache.clear()
+    d = make_daemon()  # default runner: the real chain engine
+    details = []
+    for i in (1, 2):
+        out = str(tmp_path / f"matrix.{i}")
+        j = client.submit(folder, d.socket_path, {"output": out})
+        resp = client.wait(j["id"], d.socket_path, timeout=120)
+        assert resp["job"]["state"] == "done", resp["job"]["error"]
+        assert open(out, "rb").read() == want_bytes
+        details.append(resp["job"]["detail"])
+    assert details[0]["plan_cache_misses"] >= 1  # cold first job
+    assert details[1]["plan_cache_hits"] >= 1    # warm second job
+    assert details[1]["degraded"] is False
+
+
+def test_job_detail_phases_are_scoped_per_job(tmp_path, make_daemon):
+    """utils/timers accumulates process-wide; the daemon's PhaseScope diff
+    must give each job its OWN phases and counters -- two sequential jobs
+    of the same shape report (near-)equal dispatch counts, not cumulative
+    ones, and the second job shows zero fresh planner misses."""
+    from spgemm_tpu.ops import plancache
+
+    folder, _ = _chain_folder(tmp_path, n=3, k=2, seed=11, name="scoped_in")
+    plancache.clear()
+    d = make_daemon()
+    details = []
+    for i in (1, 2):
+        out = str(tmp_path / f"m{i}")
+        j = client.submit(folder, d.socket_path, {"output": out})
+        resp = client.wait(j["id"], d.socket_path, timeout=120)
+        assert resp["job"]["state"] == "done", resp["job"]["error"]
+        details.append(resp["job"]["detail"])
+    # identical work -> identical per-job dispatch counts; an unscoped
+    # registry would report job2 = job1 + job2
+    assert details[0]["dispatches"] == details[1]["dispatches"] > 0
+    # job 1's planner misses must not bleed into job 2's detail
+    assert details[0]["plan_cache_misses"] >= 1
+    assert details[1]["plan_cache_misses"] == 0
+    assert "plan" in details[0]["phases_s"]
+
+
+def test_phase_scope_diffs_only_whats_new():
+    """Unit contract of utils/timers.PhaseScope: pre-scope accumulation is
+    invisible, post-scope accumulation is exact."""
+    t = PhaseTimers()
+    t.record("a", 1.0)
+    t.incr("c", 2)
+    s = t.scope()
+    assert s.snapshot() == {} and s.counter_snapshot() == {}
+    t.record("a", 0.5)
+    t.record("b", 0.25)
+    t.incr("c")
+    assert s.snapshot() == {"a": 0.5, "b": 0.25}
+    assert s.counter_snapshot() == {"c": 1}
+
+
+def test_reaped_job_never_writes_its_output(tmp_path):
+    """An abandoned wedged executor can unwedge long after its job was
+    reaped and resubmitted: its chain must abort at the next multiply
+    boundary (JobAbandoned rides the heartbeat) and the stale result must
+    not clobber the output path a successor may own by now."""
+    from spgemm_tpu.serve.daemon import run_chain_job
+
+    folder, _ = _chain_folder(tmp_path)
+    out = str(tmp_path / "stale_out")
+    job = Job("job-x", folder, out, {})
+    job.start()
+    job.finish("failed", error={"code": protocol.E_JOB_TIMEOUT,
+                                "message": "reaped"})
+    with pytest.raises(JobAbandoned):  # the late-unwedging runner path
+        run_chain_job(job, degraded=True)
+    assert not os.path.exists(out)
+
+
+def test_abandoned_chain_pierces_the_failover_catch(tmp_path):
+    """JobAbandoned is a BaseException ON PURPOSE: chain_product's
+    failover wrapper catches Exception (device loss) and must not mistake
+    an abort for a failure to retry on the host oracle -- the abort must
+    reach the executor loop, not restart the pass."""
+    from spgemm_tpu.serve.daemon import run_chain_job
+
+    folder, _ = _chain_folder(tmp_path, n=4)
+    out = str(tmp_path / "stale_out2")
+    job = Job("job-y", folder, out, {"failover": True})
+    job.start()
+    job.finish("failed", error={"code": protocol.E_JOB_TIMEOUT,
+                                "message": "reaped"})
+    with pytest.raises(JobAbandoned):
+        run_chain_job(job)  # failover=True: Exception would be swallowed
+    assert not os.path.exists(out)
+    assert not issubclass(JobAbandoned, Exception)  # pierces catch-alls
+
+
+# ------------------------------------------------------- journal resume --
+def test_journal_submit_record_precedes_terminal_event(tmp_path,
+                                                       make_daemon):
+    """The submit record is journaled BEFORE the job is enqueued: even an
+    instantly-finishing job's done event lands after it, so replay never
+    resurrects finished work (events replay in file order)."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    j = client.submit(folder, d.socket_path)
+    client.wait(j["id"], d.socket_path, timeout=30)
+    events = [json.loads(ln)["event"] for ln in
+              open(d.journal_path, encoding="utf-8")]
+    assert events == ["submit", "done"]
+
+
+
+def test_restart_requeues_unfinished_jobs_from_journal(tmp_path,
+                                                       make_daemon):
+    """A daemon restart re-queues journaled jobs that never reached a
+    terminal state, keeps their ids, resumes their chains from the
+    checkpoint dir wired through submit, and continues the id sequence."""
+    folder, want_bytes = _chain_folder(tmp_path, n=5, k=2, seed=13)
+    ckdir = str(tmp_path / "ck")
+    out = str(tmp_path / "matrix.resume")
+    sock = str(tmp_path / "dj.sock")
+
+    # daemon 1: accept the submit but never run it (no threads started --
+    # the journal record is what a crash leaves behind)
+    d1 = Daemon(sock, runner=lambda job, degraded=False: None)
+    resp = d1._op_submit({"op": "submit", "folder": folder,
+                          "options": {"output": out,
+                                      "checkpoint_dir": ckdir}})
+    assert resp["ok"] and resp["id"] == "job-1"
+    assert os.path.exists(d1.journal_path)
+
+    # daemon 2 on the same socket: replay -> re-queue -> run for real
+    d2 = Daemon(sock)
+    d2.start()
+    try:
+        resp = client.wait("job-1", sock, timeout=120)
+        assert resp["job"]["state"] == "done", resp["job"]["error"]
+        assert open(out, "rb").read() == want_bytes
+        # checkpoint_dir was wired through: per-pass snapshots exist, so a
+        # NEXT restart would resume mid-chain instead of recomputing
+        assert any(f.startswith("pass_") for f in os.listdir(ckdir))
+        # id sequence continues after the replayed job
+        j = client.submit(folder, sock, {"output": out + ".2"})
+        assert j["id"] == "job-2"
+        client.wait(j["id"], sock, timeout=120)
+        # terminal events landed in the journal: a further restart would
+        # re-queue nothing
+        events = [json.loads(ln) for ln in
+                  open(d2.journal_path, encoding="utf-8")]
+        done = {e["id"] for e in events if e["event"] == "done"}
+        assert {"job-1", "job-2"} <= done
+    finally:
+        d2.stop()
+
+
+def test_journal_compacts_at_runtime(tmp_path, make_daemon, monkeypatch):
+    """A resident daemon must not grow its journal for its lifetime:
+    every JOURNAL_COMPACT_EVERY terminal events the file is rewritten to
+    only the still-live submit records."""
+    monkeypatch.setattr(Daemon, "JOURNAL_COMPACT_EVERY", 4)
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    for _ in range(6):
+        j = client.submit(folder, d.socket_path)
+        client.wait(j["id"], d.socket_path, timeout=30)
+    # terminal event #4 compacted submit/done pairs 1-4 away; only jobs
+    # 5 and 6 (submitted after the compaction) remain on disk
+    events = [json.loads(ln) for ln in
+              open(d.journal_path, encoding="utf-8")]
+    assert len(events) == 4
+    assert {e["id"] for e in events} == {"job-5", "job-6"}
+    # every surviving submit has its terminal event: a restart from this
+    # journal re-queues nothing
+    done = {e["id"] for e in events if e["event"] == "done"}
+    assert {e["id"] for e in events if e["event"] == "submit"} == done
+
+
+# ------------------------------------------------ review-fix regressions --
+def test_wedge_grace_comes_from_the_knob_registry(tmp_path, monkeypatch):
+    """The slow-vs-wedged window is a deployment property (it must exceed
+    the longest single multiply): a registry knob with a wide default,
+    never a hardcoded second."""
+    monkeypatch.setenv("SPGEMM_TPU_SERVE_WEDGE_GRACE_S", "7.5")
+    assert Daemon(str(tmp_path / "g1.sock"))._wedge_grace_s == 7.5
+    monkeypatch.delenv("SPGEMM_TPU_SERVE_WEDGE_GRACE_S")
+    assert Daemon(str(tmp_path / "g2.sock"))._wedge_grace_s == 60.0
+    d = Daemon(str(tmp_path / "g3.sock"), wedge_grace_s=0.2)
+    assert d._wedge_grace_s == 0.2  # explicit override (tests) still wins
+
+
+def test_reaped_slow_job_aborts_and_executor_serves_on(tmp_path,
+                                                       make_daemon):
+    """A reaped job's chain aborts at the next heartbeat: the SAME
+    executor moves on to live work -- no degrade, no zombie computing a
+    failed job's chain to completion."""
+    folder, _ = _chain_folder(tmp_path)
+
+    def runner(job, degraded=False):
+        if job.id != "job-1":
+            return
+        while True:  # job-1: slow multiplies that beat, never a hang
+            time.sleep(0.02)
+            job.touch()
+            if job.state in TERMINAL:
+                raise JobAbandoned(job.id)
+
+    d = make_daemon(runner=runner, job_timeout_s=0.2, wedge_grace_s=10.0,
+                    probe=lambda: "should-never-run")
+    j1 = client.submit(folder, d.socket_path)
+    resp = client.wait(j1["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "failed"
+    assert resp["job"]["error"]["code"] == protocol.E_JOB_TIMEOUT
+    j2 = client.submit(folder, d.socket_path, {"timeout_s": 0})
+    resp = client.wait(j2["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "done"
+    assert d.degraded is False
+    assert d._executor_gen == 1  # still the original executor thread
+
+
+def test_reaped_job_keeps_its_phase_detail(tmp_path, make_daemon):
+    """A watchdog-reaped job must not lose its per-job phases/counters:
+    the one job an operator most needs to diagnose (it hit its deadline)
+    still reports what it was doing."""
+    from spgemm_tpu.utils.timers import ENGINE
+
+    folder, _ = _chain_folder(tmp_path)
+    wedged = threading.Event()
+
+    def runner(job, degraded=False):
+        ENGINE.record("numeric_dispatch", 0.125)
+        ENGINE.incr("dispatches", 7)
+        wedged.wait(30)  # hung backend call: no beats, no return
+
+    d = make_daemon(runner=runner, job_timeout_s=0.2, wedge_grace_s=60.0,
+                    probe=lambda: "x")
+    try:
+        j = client.submit(folder, d.socket_path)
+        resp = client.wait(j["id"], d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "failed"
+        assert resp["job"]["error"]["code"] == protocol.E_JOB_TIMEOUT
+        det = resp["job"]["detail"]
+        assert det["dispatches"] == 7
+        assert det["phases_s"]["numeric_dispatch"] == 0.125
+        assert det["degraded"] is False
+    finally:
+        wedged.set()
+
+
+def test_bad_option_values_rejected_at_admission(tmp_path, make_daemon):
+    """Option VALUES get the same early bad-request as option names: a
+    bad round_size/backend must never become a late opaque job-error."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    for opts, fragment in (({"round_size": "abc"}, "round_size"),
+                           ({"round_size": 0}, "round_size"),
+                           ({"backend": "cuda"}, "cuda"),
+                           # negative would silently mean "no deadline"
+                           ({"timeout_s": -5}, "timeout_s")):
+        with pytest.raises(client.ServeError) as ei:
+            client.submit(folder, d.socket_path, opts)
+        assert ei.value.code == protocol.E_BAD_REQUEST
+        assert fragment in ei.value.message
+    j = client.submit(folder, d.socket_path, {"round_size": 4,
+                                              "backend": "xla"})
+    assert client.wait(j["id"], d.socket_path,
+                       timeout=30)["job"]["state"] == "done"
+
+
+def test_relative_paths_resolve_client_side(tmp_path, make_daemon,
+                                            monkeypatch):
+    """The daemon's cwd is not the submitter's: a relative folder/output/
+    checkpoint_dir must be resolved against the CLIENT's cwd before it
+    goes on the wire, or the daemon checks (and writes!) the wrong
+    tree."""
+    _chain_folder(tmp_path)  # creates tmp_path/chain_in
+
+    def runner(job, degraded=False):
+        assert os.path.isabs(job.folder) and os.path.isabs(job.output)
+        assert os.path.isabs(job.options["checkpoint_dir"])
+        with open(job.output, "w", encoding="utf-8") as f:
+            f.write("ok")
+
+    d = make_daemon(runner=runner)  # daemon cwd: wherever pytest runs
+    monkeypatch.chdir(tmp_path)     # client cwd: elsewhere
+    j = client.submit("chain_in", d.socket_path,
+                      {"output": "rel_out", "checkpoint_dir": "rel_ck"})
+    resp = client.wait(j["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "done", resp["job"]["error"]
+    assert (tmp_path / "rel_out").read_text() == "ok"
+
+
+def test_server_side_wait_is_sliced(tmp_path, make_daemon, monkeypatch):
+    """One server-side wait is clamped to MAX_WAIT_SLICE_S (a running
+    snapshot is answered past it), so an abandoned waiter can never pin a
+    connection slot until a deadline-less job terminates; client.wait
+    polls in slices and still sees the terminal state."""
+    monkeypatch.setattr(Daemon, "MAX_WAIT_SLICE_S", 0.2)
+    monkeypatch.setattr(client, "WAIT_SLICE_S", 0.2)
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        release.wait(30)
+
+    d = make_daemon(runner=runner)
+    j = client.submit(folder, d.socket_path)
+    # a raw wait with timeout null returns a RUNNING snapshot within the
+    # slice instead of blocking the connection until the job ends
+    t0 = time.time()
+    resp = _raw_roundtrip(
+        d.socket_path,
+        protocol.encode({"v": protocol.PROTOCOL_VERSION, "op": "wait",
+                         "id": j["id"], "timeout": None}))
+    assert time.time() - t0 < 5.0
+    assert resp["ok"] and resp["job"]["state"] in ("queued", "running")
+    # the polling client still blocks through multiple slices to terminal
+    waiter = {}
+
+    def do_wait():
+        waiter["resp"] = client.wait(j["id"], d.socket_path, timeout=30)
+
+    t = threading.Thread(target=do_wait)
+    t.start()
+    time.sleep(0.6)  # several slices elapse while the job still runs
+    release.set()
+    t.join(timeout=30)
+    assert waiter["resp"]["job"]["state"] == "done"
+
+
+def test_terminal_jobs_evicted_beyond_retention(monkeypatch):
+    """The job index must not grow for the daemon's lifetime: terminal
+    jobs beyond RETAIN_TERMINAL are evicted (oldest first) at the next
+    admission; live jobs are never touched."""
+    monkeypatch.setattr(JobQueue, "RETAIN_TERMINAL", 2)
+    q = JobQueue(cap=10)
+    jobs = [Job(f"j{i}", "f", "o", {}) for i in range(5)]
+    for j in jobs[:4]:
+        q.submit(j)
+        assert q.next(0.01) is j
+        j.start()
+        j.finish("done")
+    q.submit(jobs[4])
+    assert q.get("j0") is None and q.get("j1") is None  # evicted
+    assert q.get("j2") is jobs[2] and q.get("j3") is jobs[3]  # retained
+    assert q.get("j4") is jobs[4]  # live
+
+
+def test_connection_bound_answers_busy(make_daemon, monkeypatch):
+    """Past MAX_CONNS concurrent connections the daemon answers a
+    structured busy error and closes -- a connect() loop exhausts the
+    bound, not the device owner's threads -- and released connections
+    free slots for live service."""
+    monkeypatch.setattr(Daemon, "MAX_CONNS", 2)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    held = []
+    try:
+        for _ in range(2):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(d.socket_path)
+            held.append(s)
+        _wait_until(lambda: d._conn_count == 2, msg="2 conns admitted")
+        resp = _raw_roundtrip(
+            d.socket_path,
+            protocol.encode({"v": protocol.PROTOCOL_VERSION,
+                             "op": "stats"}))
+        assert resp["ok"] is False
+        assert resp["error"]["code"] == protocol.E_BUSY
+    finally:
+        for s in held:
+            s.close()
+    _wait_until(lambda: d._conn_count == 0, msg="conns released")
+    assert client.stats(d.socket_path)["ok"] is True
