@@ -22,7 +22,7 @@ MEMFLAG = $(MEMFLAG_$(MEM))
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test lint bench bench-large warm serve-smoke clean
+.PHONY: all native run test lint lint-sarif bench bench-large warm serve-smoke clean
 
 all: native
 
@@ -49,10 +49,16 @@ endif
 test:
 	$(PY) -m pytest tests/ -x -q
 
-# spgemm-lint: AST invariant checker (FLD fold order, KNB knob registry,
-# BKD import-time backend touch, DOC doc drift); exit 1 on any finding.
+# spgemm-lint: package-level invariant checker (FLD fold order incl. the
+# interprocedural taint pass, KNB knob registry, BKD import-time backend
+# touch, THR lock discipline, EXC exception contracts, SUP stale
+# suppressions, DOC doc drift); exit 1 on any finding.
 lint:
 	$(PY) -m spgemm_tpu.analysis --json
+
+# same run, plus a SARIF 2.1.0 log for CI / editor annotations
+lint-sarif:
+	$(PY) -m spgemm_tpu.analysis --json --sarif lint.sarif
 
 bench:
 	$(PY) bench.py

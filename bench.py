@@ -165,7 +165,7 @@ def _init_platform(args) -> str:
             try:
                 from jax._src import xla_bridge
                 xla_bridge._clear_backends()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 -- best-effort backend reset between retries; a failed clear just means the next attempt races the same state
                 pass
             if attempt < 2:
                 time.sleep(5 * (attempt + 1))
@@ -208,7 +208,7 @@ def _outer() -> int:
         proc.kill()
         try:
             proc.wait(timeout=5)  # reap -- no zombie left behind
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 -- signal-handler exit path: the kill already landed, a reap failure must not mask the exit code
             pass
         sys.exit(128 + signum)
 

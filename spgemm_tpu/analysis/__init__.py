@@ -1,38 +1,61 @@
-"""spgemm-lint: AST invariant checker for the repo's machine-enforced contracts.
+"""spgemm-lint: package-level invariant checker for the repo's machine-enforced contracts.
 
 The reference semantics (SURVEY.md section 2.9) make the wrap-then-mod u64
 arithmetic non-associative, so fold order is a correctness invariant; the
 dispatch layers (round batching, ring overlap) additionally require every
-engine knob to be jit-static discipline-clean, and the flaky-TPU environment
+engine knob to be jit-static discipline-clean; the flaky-TPU environment
 requires that no module touches a backend at import time (a dead TPU hangs,
-never raises).  Reviewer memory does not scale to those contracts -- this
-package checks them structurally:
+never raises); and the threaded runtime (plan-ahead worker, OOC pipeline,
+spgemmd) requires its lock and exception contracts to hold.  Reviewer
+memory does not scale to those contracts -- this package checks them
+structurally:
 
   FLD  ordered-fold rule: unordered reductions (jnp.sum / lax.psum /
        segment_sum / functools.reduce / array .sum()) are findings inside
-       the numeric modules unless escaped with
-       `# spgemm-lint: fld-proof(<reason>)` (the proof-gated MXU / no_mod
-       routes).
+       the numeric modules unless escaped with a reasoned fld-proof
+       comment (the proof-gated MXU / no_mod routes).  v2 adds the
+       INTERPROCEDURAL pass (callgraph.py): a numeric-module call into a
+       non-numeric helper that transitively performs an unordered
+       reduction is flagged at the call site, closing the "hide the
+       jnp.sum in utils/" hole.
   KNB  knob rule: every SPGEMM_TPU_* environment read must go through the
        central registry (spgemm_tpu/utils/knobs.py); raw os.environ /
        os.getenv reads are findings.
   BKD  backend rule: no module-import-time jax.devices()/backend-touching
-       calls outside utils/backend_probe.py.
-  DOC  drift rule: the CLAUDE.md knob table and the CLI help must cover
-       exactly the registry's knobs (generated-vs-committed diff is a
-       finding).
+       calls outside utils/backend_probe.py (nor anywhere in an
+       @host_only worker body).
+  THR  lock rule (thrrules.py): an attribute annotated
+       `# spgemm-lint: guarded-by(<lock>)` accessed outside a
+       `with <lock>:` block is a finding (__init__, *_locked methods,
+       Condition aliases exempt; escape: reasoned thr-ok comment).
+  EXC  exception rule (excrules.py): a broad `except Exception` needs the
+       `# noqa: BLE001 -- <reason>` justification; a bare `except:` /
+       `except BaseException` must end its handler in `raise` (the
+       JobAbandoned-must-pierce contract; escape: reasoned exc-ok).
+  SUP  suppression audit: every escape comment is inventoried (--json),
+       and one whose underlying finding no longer exists is itself a
+       finding (like an unused noqa).
+  DOC  drift rule: the CLAUDE.md knob table, the CLI help, and the
+       analysis --help rule-id epilog must cover exactly what the
+       registries generate.
 
-Run `python -m spgemm_tpu.analysis [--json]` (or `make lint`); the repo
-self-lints in tier-1 (tests/test_lint.py).
+Run `python -m spgemm_tpu.analysis [--json] [--sarif F]` (`make lint`,
+`make lint-sarif`); the repo self-lints in tier-1 (tests/test_lint.py).
+Everything is stdlib-only: the linter never imports jax, so it can never
+hang on a dead TPU.
 """
 
-from spgemm_tpu.analysis.core import (Finding, is_numeric_module, lint_file,
-                                      lint_paths, lint_repo, repo_root)
+from spgemm_tpu.analysis.core import (RULES, Finding, Suppression,
+                                      is_numeric_module, lint_file,
+                                      lint_paths, lint_report, lint_repo,
+                                      repo_root)
 from spgemm_tpu.analysis.docrules import (KNOB_TABLE_BEGIN, KNOB_TABLE_END,
+                                          check_analysis_help,
                                           check_claude_md, check_cli_help)
 
 __all__ = [
-    "Finding", "lint_file", "lint_paths", "lint_repo", "repo_root",
-    "is_numeric_module", "check_claude_md", "check_cli_help",
+    "Finding", "Suppression", "RULES", "lint_file", "lint_paths",
+    "lint_report", "lint_repo", "repo_root", "is_numeric_module",
+    "check_analysis_help", "check_claude_md", "check_cli_help",
     "KNOB_TABLE_BEGIN", "KNOB_TABLE_END",
 ]

@@ -1,14 +1,21 @@
-"""CLI: `python -m spgemm_tpu.analysis [paths...] [--json]` (or `make lint`).
+"""CLI: `python -m spgemm_tpu.analysis [paths...] [--json|--sarif F]`.
 
 Default run (no paths): self-lint the whole spgemm_tpu package plus the
-repo doc-drift checks (CLAUDE.md knob table, CLI help coverage).  Explicit
-paths lint just those files/dirs; the doc checks then run only when
---claude-md is passed (fixture testing drives this).
+repo doc-drift checks (CLAUDE.md knob table, CLI help coverage, the rule-id
+coverage of this very --help).  Explicit paths lint just those files/dirs;
+the doc checks then run only when --claude-md is passed (fixture testing
+drives this).
 
 Exit status: 0 = clean, 1 = findings (CI-gateable).  --json emits one
 machine-readable report object on stdout:
   {"findings": [{"file", "line", "rule", "message"}, ...],
-   "counts": {"FLD": n, "KNB": n, "BKD": n, "DOC": n}, "clean": bool}
+   "counts": {<rule id>: n for every registered rule},
+   "suppressions": [{"file", "line", "rule", "reason", "stale"}, ...],
+   "clean": bool}
+(the suppression inventory lists EVERY escape-hatch comment in the run --
+fld-proof / thr-ok / exc-ok -- with stale=true for an escape that no longer
+suppresses anything; a stale escape is also a SUP finding).
+--sarif F additionally writes a SARIF 2.1.0 log to F (`make lint-sarif`).
 """
 
 from __future__ import annotations
@@ -19,7 +26,43 @@ import json
 import os
 import sys
 
-from spgemm_tpu.analysis import core, docrules
+from spgemm_tpu.analysis import core, docrules, sarif
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The analysis CLI parser.  The epilog is generated from the rule-id
+    registry (core.RULES) so docrules.check_analysis_help can hold this
+    --help to covering every rule id without a hand-maintained list."""
+    epilog = "rule ids:\n" + "\n".join(
+        f"  {rule_id:6s}{doc}" for rule_id, doc in core.RULES.items())
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu.analysis",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        description="spgemm-lint: package-level invariant checker (FLD fold "
+                    "order incl. interprocedural taint, KNB knob registry, "
+                    "BKD import-time backend touch, THR lock discipline, "
+                    "EXC exception contracts, SUP stale suppressions, DOC "
+                    "doc drift)",
+        epilog=epilog)
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the spgemm_tpu "
+                        "package, bench.py, benchmarks/, the graft entry, "
+                        "+ repo doc checks)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the machine-readable findings + suppression-"
+                        "inventory report")
+    p.add_argument("--sarif", default=None, metavar="FILE",
+                   help="also write a SARIF 2.1.0 log to FILE "
+                        "(`make lint-sarif` writes lint.sarif)")
+    p.add_argument("--claude-md", default=None, metavar="PATH",
+                   help="CLAUDE.md to diff the knob table against "
+                        "(default: the repo's, on a default run)")
+    p.add_argument("--no-doc", action="store_true",
+                   help="skip the DOC drift checks")
+    p.add_argument("--write-knob-table", action="store_true",
+                   help="regenerate the CLAUDE.md knob-table block from "
+                        "the registry and exit")
+    return p
 
 
 def _write_knob_table(path: str) -> int:
@@ -49,26 +92,7 @@ def _write_knob_table(path: str) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
-    p = argparse.ArgumentParser(
-        prog="spgemm_tpu.analysis",
-        description="spgemm-lint: AST invariant checker (FLD fold order, "
-                    "KNB knob registry, BKD import-time backend touch, "
-                    "DOC doc drift)")
-    p.add_argument("paths", nargs="*",
-                   help="files/dirs to lint (default: the spgemm_tpu "
-                        "package, bench.py, benchmarks/, the graft entry, "
-                        "+ repo doc checks)")
-    p.add_argument("--json", action="store_true", dest="as_json",
-                   help="emit the machine-readable findings report")
-    p.add_argument("--claude-md", default=None, metavar="PATH",
-                   help="CLAUDE.md to diff the knob table against "
-                        "(default: the repo's, on a default run)")
-    p.add_argument("--no-doc", action="store_true",
-                   help="skip the DOC drift checks")
-    p.add_argument("--write-knob-table", action="store_true",
-                   help="regenerate the CLAUDE.md knob-table block from "
-                        "the registry and exit")
-    args = p.parse_args(argv)
+    args = build_parser().parse_args(argv)
 
     root = core.repo_root()
     default_claude = os.path.join(root, "CLAUDE.md")
@@ -81,17 +105,21 @@ def main(argv: list[str] | None = None) -> int:
     else:
         paths = core.default_paths()
         claude_md = args.claude_md or default_claude
-    # the DOC half (knob table + CLI help) runs only when a CLAUDE.md is in
-    # play: default runs always, explicit-path runs only with --claude-md
-    findings = core.lint_paths(paths, claude_md=claude_md,
-                               doc=not args.no_doc and claude_md is not None)
+    # the DOC half (knob table + CLI/analysis help) runs only when a
+    # CLAUDE.md is in play: default runs always, explicit-path runs only
+    # with --claude-md
+    findings, suppressions = core.lint_report(
+        paths, claude_md=claude_md,
+        doc=not args.no_doc and claude_md is not None)
 
+    if args.sarif:
+        sarif.write(args.sarif, findings)
     if args.as_json:
         counts = collections.Counter(f.rule for f in findings)
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
-            "counts": {rule: counts.get(rule, 0)
-                       for rule in ("FLD", "KNB", "BKD", "DOC", "PARSE")},
+            "counts": {rule: counts.get(rule, 0) for rule in core.RULES},
+            "suppressions": [s.to_dict() for s in suppressions],
             "clean": not findings,
         }, indent=2))
     else:

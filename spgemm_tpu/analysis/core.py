@@ -1,17 +1,26 @@
-"""spgemm-lint driver: file walking, rule scoping, findings.
+"""spgemm-lint driver: file walking, rule scoping, findings, suppressions.
 
 Rule scoping is by path SUFFIX (posix-normalized), so the test fixtures
 under tests/lint_fixtures/ops/... exercise exactly the production scoping
 logic.  Everything here is stdlib-only (ast + os): the linter must be
 runnable in CI without initializing jax -- importing a backend to lint for
 backend-touching imports would be self-defeating on a host whose TPU hangs.
+
+v2 grew the per-module AST passes into a package-level analysis: a run
+over several files parses them once into LintUnits, runs the per-file
+rule families, then the interprocedural fold-order pass (callgraph.py)
+over the whole unit set, and finally the suppression audit -- every
+escape-hatch comment is inventoried, and an escape whose underlying
+finding no longer exists is itself a finding (SUP, like an unused noqa).
 """
 
 from __future__ import annotations
 
 import ast
 import fnmatch
+import io
 import os
+import tokenize
 from dataclasses import asdict, dataclass
 
 # FLD scope: the modules on the numeric path, where the reference's
@@ -32,15 +41,62 @@ KNOB_REGISTRY_SUFFIX = "/utils/knobs.py"
 # BKD exemption: the probe exists precisely to touch the backend safely.
 BACKEND_PROBE_SUFFIX = "/utils/backend_probe.py"
 
+# Escape-hatch directives, one marker per rule family that has one.  Every
+# escape needs a non-empty reason -- the reason is the reviewable citation
+# -- and every escape is audited: one that suppresses nothing is a SUP
+# finding (see lint_report).
 FLD_ESCAPE = "spgemm-lint: fld-proof("
+THR_ESCAPE = "spgemm-lint: thr-ok("
+EXC_ESCAPE = "spgemm-lint: exc-ok("
+ESCAPE_MARKERS = {"FLD": FLD_ESCAPE, "THR": THR_ESCAPE, "EXC": EXC_ESCAPE}
+
+# The rule-id registry: single source for the CLI --help epilog, the JSON
+# counts object, and the SARIF tool.driver.rules metadata (docrules checks
+# the --help epilog covers every id, so the list cannot silently drift).
+RULES = {
+    "FLD": "unordered reduction on the numeric path (fold order is "
+           "load-bearing; includes the interprocedural pass: a numeric-"
+           "module call into a helper that transitively performs an "
+           "unordered reduction); escape: fld-proof(<reason>)",
+    "KNB": "raw SPGEMM_TPU_* environment read outside the central registry "
+           "spgemm_tpu/utils/knobs.py",
+    "BKD": "backend-touching call at module import time (or anywhere in a "
+           "@host_only worker body) outside utils/backend_probe.py",
+    "THR": "attribute declared `# spgemm-lint: guarded-by(<lock>)` "
+           "accessed without holding the lock; escape: thr-ok(<reason>)",
+    "EXC": "broad `except Exception` without a `# noqa: BLE001 -- "
+           "<reason>` justification, or a bare except / "
+           "`except BaseException` that does not provably re-raise "
+           "(the JobAbandoned contract); escape: exc-ok(<reason>)",
+    "DOC": "generated doc drift (CLAUDE.md knob table, CLI help knob "
+           "coverage, analysis --help rule-id coverage)",
+    "SUP": "stale suppression: an escape-hatch comment whose underlying "
+           "finding no longer exists (delete the escape)",
+    "PARSE": "file does not parse (no other rule ran on it)",
+}
 
 
 @dataclass(frozen=True)
 class Finding:
     file: str   # repo-relative posix path (absolute if outside the repo)
     line: int   # 1-indexed
-    rule: str   # family id: FLD | KNB | BKD | DOC | PARSE
+    rule: str   # family id: see RULES
     message: str
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """One escape-hatch comment, for the --json inventory.  stale=True
+    means the escape suppresses nothing (also reported as a SUP finding)."""
+
+    file: str
+    line: int
+    rule: str    # the family the escape belongs to (FLD | THR | EXC)
+    reason: str
+    stale: bool
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -71,50 +127,108 @@ def is_numeric_module(path: str) -> bool:
             or any(fnmatch.fnmatch(p, g) for g in NUMERIC_GLOBS))
 
 
-def _escape_lines(source: str, marker: str) -> set[int]:
-    """1-indexed lines carrying an escape-hatch directive with a non-empty
-    reason.  A bare `fld-proof()` is NOT an escape: the reason is the
-    reviewable proof citation."""
-    lines = set()
-    for i, text in enumerate(source.splitlines(), start=1):
+def comment_map(source: str) -> dict[int, str]:
+    """1-indexed line -> comment text (including the `#`).  Tokenize-based,
+    so directive markers quoted in docstrings or string literals (this very
+    package documents its own markers) never register as live directives.
+    A file that fails to tokenize yields {} -- it will carry a PARSE
+    finding and no directive-driven rule runs on it anyway."""
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return {}
+    return out
+
+
+def _escape_map(comments: dict[int, str], marker: str) -> dict[int, str]:
+    """1-indexed line -> reason for escape-hatch directives with a
+    non-empty reason.  A bare `fld-proof()` is NOT an escape: the reason
+    is the reviewable proof citation."""
+    out: dict[int, str] = {}
+    for i, text in comments.items():
         pos = text.find(marker)
         if pos < 0:
             continue
         rest = text[pos + len(marker):]
         reason = rest.split(")", 1)[0].strip()
         if reason:
-            lines.add(i)
-    return lines
+            out[i] = reason
+    return out
+
+
+class LintUnit:
+    """One parsed file: source, AST (None on a syntax error), numeric-path
+    scoping, and the per-rule escape maps.  Parsed once per run and shared
+    by the per-file rules, the interprocedural pass, and the audit."""
+
+    def __init__(self, path: str, *, numeric: bool | None = None):
+        self.path = path
+        self.file = rel_file(path)
+        with open(path, encoding="utf-8") as f:
+            self.source = f.read()
+        self.parse_finding: Finding | None = None
+        try:
+            self.tree: ast.AST | None = ast.parse(self.source, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            # a broken file means NO rule ran on it -- its own rule id, so
+            # JSON-count consumers never blame a rule family for it
+            self.parse_finding = Finding(
+                self.file, e.lineno or 1, "PARSE",
+                f"file does not parse: {e.msg}")
+        self.numeric = is_numeric_module(path) if numeric is None else numeric
+        self.comments = comment_map(self.source)
+        self.escapes = {rule: _escape_map(self.comments, marker)
+                        for rule, marker in ESCAPE_MARKERS.items()}
+
+
+def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
+                                        set[tuple[str, str, int]]]:
+    """The per-file rule families (FLD/KNB/BKD/THR/EXC) over one unit.
+
+    Each escapable family runs ONCE with escapes ignored; the escape
+    filter is applied here, so the same pass yields both the surviving
+    findings and the raw (file, rule, line) triples the suppression audit
+    needs to tell used escapes from stale ones."""
+    from spgemm_tpu.analysis import excrules, rules, thrrules  # noqa: PLC0415
+
+    if unit.tree is None:
+        return [unit.parse_finding], set()
+    p = _posix(unit.path)
+    findings: list[Finding] = []
+    raw: set[tuple[str, str, int]] = set()
+
+    def escaping(family: list[Finding], rule: str) -> list[Finding]:
+        escapes = set(unit.escapes[rule])
+        out = []
+        for f in family:
+            raw.add((f.file, rule, f.line))
+            if f.line not in escapes and f.line - 1 not in escapes:
+                out.append(f)
+        return out
+
+    if unit.numeric:
+        findings += escaping(rules.check_fld(unit.tree, unit.file, set()),
+                             "FLD")
+    if not p.endswith(KNOB_REGISTRY_SUFFIX):
+        findings += rules.check_knb(unit.tree, unit.file)
+    if not p.endswith(BACKEND_PROBE_SUFFIX):
+        findings += rules.check_bkd(unit.tree, unit.file)
+    findings += escaping(thrrules.check_thr(unit, set()), "THR")
+    findings += escaping(excrules.check_exc(unit, set()), "EXC")
+    return findings, raw
 
 
 def lint_file(path: str, *, numeric: bool | None = None) -> list[Finding]:
-    """Run the AST rule families (FLD/KNB/BKD) over one file.
+    """Run the per-file rule families over one file.
 
     numeric: override the path-based FLD scoping (tests); None = derive
-    from the path suffix."""
-    from spgemm_tpu.analysis import rules  # noqa: PLC0415
-
-    with open(path, encoding="utf-8") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        # a broken file means NO rule ran on it -- its own rule id, so
-        # JSON-count consumers never blame a rule family for a parse error
-        return [Finding(rel_file(path), e.lineno or 1, "PARSE",
-                        f"file does not parse: {e.msg}")]
-    p = _posix(path)
-    findings: list[Finding] = []
-    if numeric is None:
-        numeric = is_numeric_module(path)
-    if numeric:
-        escapes = _escape_lines(source, FLD_ESCAPE)
-        findings += rules.check_fld(tree, rel_file(path), escapes)
-    if not p.endswith(KNOB_REGISTRY_SUFFIX):
-        findings += rules.check_knb(tree, rel_file(path))
-    if not p.endswith(BACKEND_PROBE_SUFFIX):
-        findings += rules.check_bkd(tree, rel_file(path))
-    return findings
+    from the path suffix.  The cross-file passes (interprocedural FLD,
+    suppression audit) need the whole unit set -- use lint_paths."""
+    return _lint_unit(LintUnit(path, numeric=numeric))[0]
 
 
 def _walk_py(path: str) -> list[str]:
@@ -128,22 +242,77 @@ def _walk_py(path: str) -> list[str]:
     return out
 
 
-def lint_paths(paths: list[str], *, claude_md: str | None = None,
-               doc: bool = True) -> list[Finding]:
-    """Lint files/directories; optionally run the DOC drift checks against
-    the given CLAUDE.md (None = skip the table check)."""
-    from spgemm_tpu.analysis import docrules  # noqa: PLC0415
+def _audit_suppressions(units: list[LintUnit],
+                        raw: set[tuple[str, str, int]],
+                        extra_used: set[tuple[str, int]]) -> list[Suppression]:
+    """The suppression inventory.  An escape is USED when the raw run of
+    its rule family (escapes ignored -- the (file, rule, line) triples the
+    per-file pass already produced) has a finding on the escape's line or
+    the line below (the two lines an escape can attach to), or -- for
+    FLD -- when it sits on an unordered reduction whose taint it suppresses
+    in the interprocedural pass (extra_used, from callgraph.check)."""
+    out: list[Suppression] = []
+    for u in units:
+        for rule, escapes in u.escapes.items():
+            for line, reason in sorted(escapes.items()):
+                used = ((u.file, rule, line) in raw
+                        or (u.file, rule, line + 1) in raw
+                        or (rule == "FLD" and ((u.file, line) in extra_used
+                                               or (u.file, line + 1)
+                                               in extra_used)))
+                out.append(Suppression(u.file, line, rule, reason,
+                                       stale=not used))
+    return out
 
+
+def lint_report(paths: list[str], *, claude_md: str | None = None,
+                doc: bool = True) -> tuple[list[Finding], list[Suppression]]:
+    """The full v2 run over files/directories: per-file rules, the
+    interprocedural fold-order pass, the suppression audit (stale escapes
+    are SUP findings; the full inventory is returned for --json), and
+    optionally the DOC drift checks (claude_md None = skip the table
+    check; the CLI/analysis help checks ride the same flag)."""
+    from spgemm_tpu.analysis import callgraph, docrules  # noqa: PLC0415
+
+    units = [LintUnit(f) for path in paths for f in _walk_py(path)]
     findings: list[Finding] = []
-    for path in paths:
-        for f in _walk_py(path):
-            findings += lint_file(f)
+    raw: set[tuple[str, str, int]] = set()
+    for u in units:
+        unit_findings, unit_raw = _lint_unit(u)
+        findings += unit_findings
+        raw |= unit_raw
+    cg_findings, cg_raw, cg_used = callgraph.check(units)
+    findings += cg_findings
+    # interprocedural raw findings feed the audit exactly like per-file
+    # raw runs: a call-site escape is used iff a raw finding sits ON the
+    # escape's line or the line below -- the audit itself checks both, so
+    # only the finding's own line goes into the used set (widening it
+    # here would vouch for an escape two lines above the finding, which
+    # suppresses nothing)
+    used = set(cg_used)
+    for f in cg_raw:
+        used.add((f.file, f.line))
+    suppressions = _audit_suppressions(units, raw, used)
+    for s in suppressions:
+        if s.stale:
+            findings.append(Finding(
+                s.file, s.line, "SUP",
+                f"stale suppression: `{ESCAPE_MARKERS[s.rule]}{s.reason})` "
+                f"suppresses nothing here (no underlying {s.rule} finding "
+                "on this or the next line); delete the escape comment"))
     if doc:
         if claude_md is not None:
             findings += docrules.check_claude_md(claude_md)
         findings += docrules.check_cli_help()
+        findings += docrules.check_analysis_help()
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
-    return findings
+    return findings, suppressions
+
+
+def lint_paths(paths: list[str], *, claude_md: str | None = None,
+               doc: bool = True) -> list[Finding]:
+    """lint_report without the suppression inventory (findings only)."""
+    return lint_report(paths, claude_md=claude_md, doc=doc)[0]
 
 
 def default_paths() -> list[str]:

@@ -19,8 +19,8 @@ from spgemm_tpu.analysis.core import Finding
 # ---------------------------------------------------------------- FLD ----
 # Unordered-reduction call names.  `.sum()` as a METHOD on anything is
 # flagged too: on the numeric path even a host-side numpy sum over values
-# is a fold whose order must be argued, and the escape hatch
-# (`# spgemm-lint: fld-proof(<reason>)`) is exactly that argument.
+# is a fold whose order must be argued, and the fld-proof escape hatch
+# (reason mandatory) is exactly that argument.
 # Builtin bare `sum(...)` is a left fold (ordered) and stays legal.
 FLD_TERMINALS = {"psum", "psum_scatter", "segment_sum", "tree_reduce"}
 FLD_REDUCE_NAMESPACES = {"functools", "ft"}
@@ -76,6 +76,27 @@ def _str_const(node: ast.expr) -> str | None:
     return None
 
 
+def fld_violation(name: str) -> str | None:
+    """The finding message for a spelled call name that is an unordered
+    reduction, or None.  Shared by the per-module FLD pass below and the
+    interprocedural taint scan (analysis/callgraph.py)."""
+    head, _, last = name.rpartition(".")
+    root = head.split(".", 1)[0] if head else ""
+    if last in FLD_TERMINALS:
+        return (f"unordered reduction `{name}` on the numeric path: the "
+                "wrap-then-mod fold is non-associative (SURVEY.md 2.9)")
+    if last == "sum" and head:  # any `<expr>.sum(...)` method/ns call
+        return (f"`{name}` is an unordered reduction: the reference "
+                "fold order is load-bearing on the numeric path "
+                "(SURVEY.md 2.9); use the ordered MAC/fold helpers "
+                "(ops/u64.py) or escape with a fld-proof(<reason>)")
+    if last == "reduce" and (root in FLD_REDUCE_NAMESPACES or not head):
+        return (f"`{name}` folds in container-iteration order, not the "
+                "reference's j-ascending pair order; spell the fold "
+                "explicitly or escape with fld-proof(<reason>)")
+    return None
+
+
 def check_fld(tree: ast.AST, file: str, escapes: set[int]) -> list[Finding]:
     """FLD: unordered reductions on the numeric path.
 
@@ -89,22 +110,7 @@ def check_fld(tree: ast.AST, file: str, escapes: set[int]) -> list[Finding]:
         name = dotted_name(node.func)
         if name is None:
             continue
-        head, _, last = name.rpartition(".")
-        root = head.split(".", 1)[0] if head else ""
-        bad = None
-        if last in FLD_TERMINALS:
-            bad = (f"unordered reduction `{name}` on the numeric path: the "
-                   "wrap-then-mod fold is non-associative (SURVEY.md 2.9)")
-        elif last == "sum" and head:  # any `<expr>.sum(...)` method/ns call
-            bad = (f"`{name}` is an unordered reduction: the reference "
-                   "fold order is load-bearing on the numeric path "
-                   "(SURVEY.md 2.9); use the ordered MAC/fold helpers "
-                   "(ops/u64.py) or escape with a fld-proof(<reason>)")
-        elif last == "reduce" and (root in FLD_REDUCE_NAMESPACES
-                                   or not head):
-            bad = (f"`{name}` folds in container-iteration order, not the "
-                   "reference's j-ascending pair order; spell the fold "
-                   "explicitly or escape with fld-proof(<reason>)")
+        bad = fld_violation(name)
         if bad is None:
             continue
         if node.lineno in escapes or node.lineno - 1 in escapes:

@@ -1,0 +1,57 @@
+"""SARIF 2.1.0 emitter: CI/editor-annotatable lint output.
+
+`python -m spgemm_tpu.analysis --sarif lint.sarif` (or `make lint-sarif`)
+writes one run with the full rule-id registry as tool.driver.rules and one
+result per finding -- the shape GitHub code scanning and SARIF-aware
+editors consume.  The contract test (tests/test_lint.py) pins the schema
+shape; stale suppressions travel as ordinary SUP results, and the full
+escape inventory stays a --json feature (SARIF's per-result suppressions
+model suppressed results, not escape comments)."""
+
+from __future__ import annotations
+
+import json
+
+from spgemm_tpu.analysis.core import RULES, Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def render(findings: list[Finding]) -> dict:
+    """The SARIF log object (plain dict, json.dump-ready)."""
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                # no informationUri: SARIF 2.1.0 requires an ABSOLUTE URI
+                # there and this repo has no canonical hosted URL -- the
+                # property is optional, and strict consumers (GitHub code
+                # scanning) reject relative ones.  The human pointer is
+                # ARCHITECTURE.md "Enforced invariants (spgemm-lint)".
+                "name": "spgemm-lint",
+                "rules": [{
+                    "id": rule_id,
+                    "shortDescription": {"text": doc},
+                } for rule_id, doc in RULES.items()],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "error",
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.file},
+                        "region": {"startLine": f.line},
+                    },
+                }],
+            } for f in findings],
+        }],
+    }
+
+
+def write(path: str, findings: list[Finding]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(render(findings), f, indent=2)
+        f.write("\n")

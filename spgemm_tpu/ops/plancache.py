@@ -36,8 +36,8 @@ import numpy as np
 from spgemm_tpu.utils import knobs
 
 _LOCK = threading.Lock()
-_CACHE: "OrderedDict[str, object]" = OrderedDict()
-_STATS = {"hits": 0, "misses": 0}
+_CACHE: "OrderedDict[str, object]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
+_STATS = {"hits": 0, "misses": 0}  # spgemm-lint: guarded-by(_LOCK)
 
 
 def enabled() -> bool:

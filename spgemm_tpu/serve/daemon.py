@@ -164,22 +164,29 @@ class Daemon:
         self._wedge_grace_s = wedge_grace_s if wedge_grace_s is not None \
             else knobs.get("SPGEMM_TPU_SERVE_WEDGE_GRACE_S")
         self._journal_enabled = journal
-        self._journal_terminal_events = 0
+        self._journal_terminal_events = 0  # spgemm-lint: guarded-by(_lock)
         self.queue = JobQueue(self._cap)
-        self.degraded = False
-        self.degrade_reason: str | None = None
-        self._probe_outcome: str | None = None
+        # degrade state: written by the watchdog, read by the executor and
+        # every stats request -- the machine-checked half of the old
+        # "# ids, journal file, degrade state" comment on _lock
+        self.degraded = False                    # spgemm-lint: guarded-by(_lock)
+        self.degrade_reason: str | None = None   # spgemm-lint: guarded-by(_lock)
+        self._probe_outcome: str | None = None   # spgemm-lint: guarded-by(_lock)
         self._started_at = time.time()
-        self._next_id = 1
+        self._next_id = 1                        # spgemm-lint: guarded-by(_lock)
         self._stop = threading.Event()
         self._lock = threading.Lock()  # ids, journal file, degrade state
         self._listener: socket.socket | None = None
+        # _executor/_executor_gen/_current/_reaped are single-writer
+        # handoff slots (watchdog writes, executor compares), lock-free by
+        # design -- the ordering argument lives on their access sites, so
+        # they stay deliberately un-annotated
         self._executor: threading.Thread | None = None
         self._executor_gen = 0
         self._current: Job | None = None  # job the live executor holds
         self._reaped: Job | None = None   # reaped job awaiting wedge grace
         self._reaped_at = 0.0
-        self._conn_count = 0              # live spgemmd-conn threads
+        self._conn_count = 0               # spgemm-lint: guarded-by(_lock)
         self._threads: list[threading.Thread] = []
 
     # ------------------------------------------------------------ journal --
@@ -256,7 +263,11 @@ class Daemon:
                         {"event": "failed", "id": j.id}))
             num = int(ev["id"].rsplit("-", 1)[-1]) \
                 if ev["id"].rsplit("-", 1)[-1].isdigit() else 0
-            self._next_id = max(self._next_id, num + 1)
+            # replay runs at start(), before any serving thread exists,
+            # but the id counter is _lock-guarded state -- hold the lock
+            # anyway (THR) rather than argue the happens-before each time
+            with self._lock:
+                self._next_id = max(self._next_id, num + 1)
 
     # ---------------------------------------------------------- lifecycle --
     def start(self) -> None:
@@ -320,7 +331,8 @@ class Daemon:
     # ----------------------------------------------------------- executor --
     def _spawn_executor(self, degraded: bool | None = None) -> None:
         if degraded is not None:
-            self.degraded = degraded
+            with self._lock:
+                self.degraded = degraded
         self._executor_gen += 1
         gen = self._executor_gen
         self._executor = threading.Thread(
@@ -338,7 +350,8 @@ class Daemon:
             if job.state != "queued":  # reaped while still in the FIFO
                 continue
             job.start()
-            degraded = self.degraded
+            with self._lock:
+                degraded = self.degraded
             scope = ENGINE.scope()
             # stashed on the job BEFORE it becomes _current: the watchdog
             # reads it to attach per-job detail when reaping, and must
@@ -473,11 +486,12 @@ class Daemon:
 
         def _run_probe() -> None:
             try:
-                self._probe_outcome = probe()
+                outcome = probe()
             except Exception as e:  # noqa: BLE001 -- diagnostics must not raise
-                self._probe_outcome = f"probe-error: {e!r}"
-            log.warning("backend probe after degrade: %s",
-                        self._probe_outcome)
+                outcome = f"probe-error: {e!r}"
+            with self._lock:
+                self._probe_outcome = outcome
+            log.warning("backend probe after degrade: %s", outcome)
 
         threading.Thread(target=_run_probe, name="spgemmd-probe",
                          daemon=True).start()
@@ -661,12 +675,16 @@ class Daemon:
             cache = plancache.stats()
         except ValueError as e:
             cache = {"error": str(e)}
+        with self._lock:
+            degraded = self.degraded
+            degrade_reason = self.degrade_reason
+            probe_outcome = self._probe_outcome
         return protocol.ok(
             daemon="spgemmd",
             uptime_s=round(time.time() - self._started_at, 3),
-            degraded=self.degraded,
-            degrade_reason=self.degrade_reason,
-            backend_probe=self._probe_outcome,
+            degraded=degraded,
+            degrade_reason=degrade_reason,
+            backend_probe=probe_outcome,
             queue_cap=self._cap,
             job_timeout_s=self._job_timeout_s,
             jobs=self.queue.counts(),
@@ -718,9 +736,13 @@ def main(argv: list[str] | None = None) -> int:
                     journal=not args.no_journal)
     if degraded_at_start:
         # the device was dead before we ever owned it: CPU failover path
-        # from the first job, reported in stats like a mid-flight degrade
-        daemon.degraded = True
-        daemon.degrade_reason = "startup probe: accelerator unreachable"
+        # from the first job, reported in stats like a mid-flight degrade.
+        # No serving thread exists yet, but degrade state is _lock-guarded
+        # (THR) -- hold the lock rather than argue the happens-before,
+        # same as _journal_replay
+        with daemon._lock:
+            daemon.degraded = True
+            daemon.degrade_reason = "startup probe: accelerator unreachable"
     try:
         daemon.serve_forever()
     except KeyboardInterrupt:

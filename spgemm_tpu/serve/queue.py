@@ -60,12 +60,15 @@ class Job:
         self.output = output
         self.options = options
         self.timeout_s = timeout_s  # 0 = no deadline
-        self.state = "queued"
-        self.error: dict | None = None
-        self.detail: dict = {}
+        self.state = "queued"                   # spgemm-lint: guarded-by(_lock)
+        self.error: dict | None = None          # spgemm-lint: guarded-by(_lock)
+        self.detail: dict = {}                  # spgemm-lint: guarded-by(_lock)
         self.submitted_at = time.time()
-        self.started_at: float | None = None
-        self.finished_at: float | None = None
+        self.started_at: float | None = None    # spgemm-lint: guarded-by(_lock)
+        self.finished_at: float | None = None   # spgemm-lint: guarded-by(_lock)
+        # heartbeat_at is DELIBERATELY lock-free: single writer (the
+        # executor's per-multiply touch), float-ref store is atomic under
+        # the GIL, and the watchdog's read tolerates staleness by design
         self.heartbeat_at: float | None = None
         # set by the daemon's executor when it picks the job up: the live
         # PhaseScope (opaque here -- the queue stays jax-free) and the
@@ -120,9 +123,10 @@ class Job:
 
     def overdue(self, now: float | None = None) -> bool:
         """True iff running with a deadline and past it."""
-        if self.timeout_s <= 0 or self.state != "running":
-            return False
-        started = self.started_at or self.submitted_at
+        with self._lock:
+            if self.timeout_s <= 0 or self.state != "running":
+                return False
+            started = self.started_at or self.submitted_at
         return (now or time.time()) - started > self.timeout_s
 
     def snapshot(self) -> dict:
@@ -161,8 +165,8 @@ class JobQueue:
 
     def __init__(self, cap: int):
         self.cap = cap
-        self._fifo: deque[Job] = deque()
-        self._jobs: dict[str, Job] = {}
+        self._fifo: deque[Job] = deque()   # spgemm-lint: guarded-by(_lock)
+        self._jobs: dict[str, Job] = {}    # spgemm-lint: guarded-by(_lock)
         self._lock = threading.Lock()
         self._avail = threading.Condition(self._lock)
 

@@ -26,9 +26,9 @@ _SYM_SRC = os.path.join(_DIR, "symbolic.cpp")
 _FOLD_SRC = os.path.join(_DIR, "parityfold.cpp")
 _SO = os.path.join(_DIR, "libsmmio.so")
 
-_lib = None
+_lib = None    # spgemm-lint: guarded-by(_lock)
 _lock = threading.Lock()
-_tried = False
+_tried = False  # spgemm-lint: guarded-by(_lock)
 
 
 def _build() -> bool:

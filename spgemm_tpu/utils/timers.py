@@ -32,9 +32,9 @@ class PhaseTimers:
     a shared name must never lose an update."""
 
     def __init__(self):
-        self.totals: dict[str, float] = {}
-        self.counts: dict[str, int] = {}
-        self.counters: dict[str, int] = {}
+        self.totals: dict[str, float] = {}    # spgemm-lint: guarded-by(_lock)
+        self.counts: dict[str, int] = {}      # spgemm-lint: guarded-by(_lock)
+        self.counters: dict[str, int] = {}    # spgemm-lint: guarded-by(_lock)
         self._lock = threading.Lock()
 
     @contextlib.contextmanager

@@ -1,0 +1,60 @@
+"""Seeded EXC violations: exception-contract discipline, one per shape.
+
+A broad `except Exception` needs the repo's `# noqa: BLE001 -- <reason>`
+justification on its line; a bare `except:` / `except BaseException` must
+end its handler in `raise` (the JobAbandoned-must-pierce contract), or be
+escaped with a reasoned exc-ok.  NOT part of the package -- linted by
+tests/test_lint.py only.
+"""
+
+
+def work():
+    raise ValueError("seeded")
+
+
+def cleanup():
+    pass
+
+
+def naked_broad():
+    try:
+        work()
+    except Exception:  # EXC: broad catch with no BLE001 justification
+        return None
+
+
+def justified_broad():
+    try:
+        work()
+    except Exception:  # noqa: BLE001 -- seeded: failover contract citation
+        return None
+
+
+def bare_no_reraise():
+    try:
+        work()
+    except:  # EXC: bare except that swallows (no trailing raise)
+        cleanup()
+
+
+def bare_reraise():
+    try:
+        work()
+    except:  # legal for EXC: the handler provably re-raises
+        cleanup()
+        raise
+
+
+def base_no_reraise():
+    try:
+        work()
+    except BaseException:  # EXC: would swallow JobAbandoned-style signals
+        cleanup()
+
+
+def base_escaped():
+    try:
+        work()
+    # spgemm-lint: exc-ok(seeded: the swallow IS this fixture's contract)
+    except BaseException:
+        cleanup()

@@ -1,0 +1,55 @@
+"""Seeded THR violations: guarded-by lock discipline, one per shape.
+
+Accesses of an annotated attribute (instance `self.X` or module global)
+outside a `with <lock>:` block are findings; __init__, *_locked methods,
+Condition aliases, and reasoned thr-ok escapes are the legal shapes.
+NOT part of the package -- linted by tests/test_lint.py only.
+"""
+
+import threading
+
+_G: dict = {}  # spgemm-lint: guarded-by(_GLOCK)
+_GLOCK = threading.Lock()
+
+
+def global_bad():
+    _G["x"] = 1  # THR: module-global write without the lock
+
+
+def global_good():
+    with _GLOCK:
+        _G["x"] = 2  # legal: lock held
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._avail = threading.Condition(self._lock)
+        self._jobs: list = []  # spgemm-lint: guarded-by(_lock)
+        self._jobs.append(0)   # legal: __init__ precedes publication
+
+    def bad_read(self):
+        return len(self._jobs)  # THR: no lock held
+
+    def good_read(self):
+        with self._lock:
+            return len(self._jobs)  # legal
+
+    def good_via_condition(self):
+        with self._avail:
+            return self._jobs.pop()  # legal: Condition aliases _lock
+
+    def bad_nested_def(self):
+        with self._lock:
+            def cb():
+                # THR: a callback runs later, usually on another thread --
+                # the enclosing `with` does not protect it
+                return list(self._jobs)
+            return cb
+
+    def drain_locked(self):
+        return self._jobs.pop()  # legal: *_locked = caller holds the lock
+
+    def escaped_read(self):
+        # spgemm-lint: thr-ok(seeded: benign lock-free len probe, logging only)
+        return len(self._jobs)  # legal: escaped with a reason

@@ -1,0 +1,8 @@
+"""Deepest module of the interprocedural FLD fixture: the two-hop
+reduction target (numeric caller -> hosthelper.outer -> inner)."""
+
+import jax.numpy as jnp
+
+
+def inner(x):
+    return jnp.sum(x)

@@ -1,0 +1,20 @@
+"""Non-numeric helper module for the interprocedural FLD fixture: the
+reductions live HERE (legal in this module's own scope) and taint the
+numeric caller across the module boundary."""
+
+import jax.numpy as jnp
+
+import hostdeep
+
+
+def hidden_sum(x):
+    return jnp.sum(x)  # the hidden reduction (legal here, taints callers)
+
+
+def outer(x):
+    return hostdeep.inner(x)  # second hop toward hostdeep's reduction
+
+
+def sized(x):
+    # spgemm-lint: fld-proof(seeded: source-proved sum keeps callers untainted)
+    return jnp.sum(x)

@@ -1,0 +1,23 @@
+"""Seeded stale suppressions: escape-hatch comments whose underlying
+finding no longer exists -- each one must be reported as a SUP finding
+(like an unused noqa) and inventoried with stale=true in --json.
+NOT part of the package -- linted by tests/test_lint.py only.
+"""
+
+
+def sized(x):
+    # spgemm-lint: fld-proof(seeded-stale: nothing to suppress below)
+    return len(x)
+
+
+def guarded():
+    # spgemm-lint: thr-ok(seeded-stale: no THR finding here)
+    return 1
+
+
+def handled():
+    try:
+        return sized([])
+    # spgemm-lint: exc-ok(seeded-stale: the handler below is narrow)
+    except ValueError:
+        return 0

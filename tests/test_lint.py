@@ -1,10 +1,13 @@
 """spgemm-lint: the repo self-lints clean (tier-1 gate), and each seeded
-fixture violation (FLD/KNB/BKD/DOC) is caught with the correct rule ID --
-both in-process and through the `python -m spgemm_tpu.analysis --json`
-report that CI consumes."""
+fixture violation (FLD incl. the interprocedural pass / KNB / BKD / THR /
+EXC / SUP / DOC) is caught with the correct rule ID -- both in-process and
+through the `python -m spgemm_tpu.analysis --json` / `--sarif` reports
+that CI consumes."""
 
 import json
 import os
+import subprocess
+import sys
 
 from conftest import run_repo_script as _run
 from spgemm_tpu.analysis import (check_claude_md, core, docrules, lint_file,
@@ -13,6 +16,12 @@ from spgemm_tpu.analysis import (check_claude_md, core, docrules, lint_file,
 REPO = core.repo_root()
 FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
 FIXTURE_CLAUDE = os.path.join(FIXTURES, "CLAUDE.md")
+
+
+def _fixture_lines(name: str, needle: str) -> list[int]:
+    """1-indexed lines of a fixture whose text contains needle."""
+    src = open(os.path.join(FIXTURES, name)).read()
+    return [i for i, ln in enumerate(src.splitlines(), 1) if needle in ln]
 
 
 # ------------------------------------------------------- self-lint gate --
@@ -196,10 +205,211 @@ def test_syntax_error_gets_its_own_rule_id(tmp_path):
     assert "does not parse" in findings[0].message
 
 
+# ------------------------------------------------------------- THR rule --
+def test_thr_fixture_each_violation_caught():
+    """Unguarded accesses of guarded-by-annotated state: a module-global
+    write, an instance read, and a nested-def access (callbacks run later,
+    usually on another thread -- the enclosing `with` does not protect
+    them)."""
+    findings = lint_file(os.path.join(FIXTURES, "badthread.py"))
+    thr = [f for f in findings if f.rule == "THR"]
+    assert len(thr) == 3 and findings == thr
+    flagged = [f.line for f in thr]
+    for needle in ("module-global write without the lock", "THR: no lock",
+                   "return list(self._jobs)"):
+        assert _fixture_lines("badthread.py", needle)[0] in flagged
+    # the legal shapes stay clean: lock held, Condition alias, __init__,
+    # *_locked convention, reasoned thr-ok escape
+    for needle in ("legal: lock held", "legal: Condition aliases",
+                   "legal: __init__", "caller holds the lock",
+                   "escaped with a reason"):
+        assert _fixture_lines("badthread.py", needle)[0] not in flagged
+
+
+def test_thr_finding_names_attribute_and_lock():
+    findings = lint_file(os.path.join(FIXTURES, "badthread.py"))
+    msgs = " ".join(f.message for f in findings)
+    assert "guarded-by(_lock)" in msgs and "guarded-by(_GLOCK)" in msgs
+    assert "self._jobs" in msgs and "_G" in msgs
+
+
+def test_thr_guard_deletion_turns_lint_red(tmp_path):
+    """The acceptance spot-check, on FIXTURE COPIES of the live serving
+    modules: deleting any one `with` lock guard in serve/queue.py or
+    serve/daemon.py must produce a THR finding (the annotations actually
+    bind)."""
+    cases = [
+        ("serve/queue.py",
+         "        with self._lock:\n            return {",
+         "        if True:\n            return {"),           # Job.snapshot
+        ("serve/daemon.py",
+         "        with self._lock:\n            degraded = self.degraded\n"
+         "            degrade_reason = self.degrade_reason",
+         "        if True:\n            degraded = self.degraded\n"
+         "            degrade_reason = self.degrade_reason"),  # _op_stats
+    ]
+    for rel, guarded, unguarded in cases:
+        src = open(os.path.join(REPO, "spgemm_tpu", rel)).read()
+        assert lint_file(os.path.join(REPO, "spgemm_tpu", rel)) == []
+        mutated = src.replace(guarded, unguarded)
+        assert mutated != src, f"guard pattern drifted in {rel}"
+        p = tmp_path / os.path.basename(rel)
+        p.write_text(mutated)
+        thr = [f for f in lint_file(str(p)) if f.rule == "THR"]
+        assert thr, f"deleting a lock guard in {rel} must turn lint red"
+
+
+# ------------------------------------------------------------- EXC rule --
+def test_exc_fixture_each_violation_caught():
+    """Naked broad catch, swallowing bare except, swallowing BaseException
+    -- and the legal shapes: BLE001-with-reason, re-raising handler,
+    reasoned exc-ok escape."""
+    findings = lint_file(os.path.join(FIXTURES, "badexcept.py"))
+    exc = [f for f in findings if f.rule == "EXC"]
+    assert len(exc) == 3 and findings == exc
+    flagged = [f.line for f in exc]
+    for needle in ("no BLE001 justification", "bare except that swallows",
+                   "would swallow JobAbandoned"):
+        assert _fixture_lines("badexcept.py", needle)[0] in flagged
+    legal = (_fixture_lines("badexcept.py", "noqa: BLE001")
+             + _fixture_lines("badexcept.py", "re-raises"))
+    assert legal and not set(legal) & set(flagged)
+
+
+def test_exc_ble_reason_must_be_nonempty(tmp_path):
+    """A bare `# noqa: BLE001` (no `-- reason`) does not justify the broad
+    catch -- the reason is the reviewable citation."""
+    p = tmp_path / "h.py"
+    p.write_text("def f():\n"
+                 "    try:\n"
+                 "        pass\n"
+                 "    except Exception:  # noqa: BLE001\n"
+                 "        pass\n")
+    assert [f.rule for f in lint_file(str(p))] == ["EXC"]
+
+
+def test_exc_base_reraise_must_be_terminal(tmp_path):
+    """A conditional re-raise does not satisfy the provably-re-raise
+    contract: the handler body must END in `raise`."""
+    p = tmp_path / "h.py"
+    p.write_text("def f(flag):\n"
+                 "    try:\n"
+                 "        pass\n"
+                 "    except BaseException:\n"
+                 "        if flag:\n"
+                 "            raise\n"
+                 "        return None\n")
+    assert [f.rule for f in lint_file(str(p))] == ["EXC"]
+
+
+# ------------------------------------------- interprocedural FLD (taint) --
+def test_interprocedural_fld_one_hop_outside_numeric():
+    """The acceptance case: a numeric module calling a helper in a
+    NON-numeric module whose body performs the unordered reduction is
+    flagged at the call site, one and two hops deep, with the witness
+    chain down to the reduction's file:line in the message."""
+    findings = core.lint_paths([os.path.join(FIXTURES, "callchain")],
+                               doc=False)
+    fld = [f for f in findings if f.rule == "FLD"]
+    assert len(fld) == 2 and findings == fld
+    assert all(f.file.endswith("callchain/ops/spgemm.py") for f in fld)
+    by_msg = {f.line: f.message for f in fld}
+    src = open(os.path.join(FIXTURES, "callchain", "ops",
+                            "spgemm.py")).read()
+    one = next(i for i, ln in enumerate(src.splitlines(), 1)
+               if "one call-hop" in ln)
+    two = next(i for i, ln in enumerate(src.splitlines(), 1)
+               if "two call-hops" in ln)
+    assert set(by_msg) == {one, two}
+    assert "hidden_sum -> `jnp.sum`" in by_msg[one]
+    assert "hosthelper.py:" in by_msg[one]
+    assert "outer -> inner -> `jnp.sum`" in by_msg[two]
+    assert "hostdeep.py:" in by_msg[two]
+    # the call-site escape and the source-proved helper stay clean
+    escaped = next(i for i, ln in enumerate(src.splitlines(), 1)
+                   if "call-site escape" in ln)
+    proved = next(i for i, ln in enumerate(src.splitlines(), 1)
+                  if "proves its sum at source" in ln)
+    assert not {escaped, escaped + 1, proved} & set(by_msg)
+
+
+def test_interprocedural_fld_same_module_helper_still_flagged(tmp_path):
+    """Module-scoped evasion INSIDE numeric code never existed (check_fld
+    sees the whole module); the taint pass must not double-report it."""
+    p = tmp_path / "ops" / "spgemm.py"
+    p.parent.mkdir()
+    p.write_text("import jax.numpy as jnp\n"
+                 "def helper(x):\n"
+                 "    return jnp.sum(x)\n"
+                 "def entry(x):\n"
+                 "    return helper(x)\n")
+    findings = core.lint_paths([str(tmp_path)], doc=False)
+    # exactly one finding: the direct reduction (per-module FLD); the
+    # same-module call edge is not re-reported by the taint pass
+    assert [f.rule for f in findings] == ["FLD"]
+    assert findings[0].line == 3
+
+
+def test_interprocedural_fld_import_alias_resolves(tmp_path):
+    """`import helpers as h; h.f(...)` resolves through the alias."""
+    (tmp_path / "ops").mkdir()
+    (tmp_path / "ops" / "u64.py").write_text(
+        "import myhelpers as h\n"
+        "def entry(x):\n"
+        "    return h.hidden(x)\n")
+    (tmp_path / "myhelpers.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def hidden(x):\n"
+        "    return jnp.sum(x)\n")
+    findings = core.lint_paths([str(tmp_path)], doc=False)
+    assert [f.rule for f in findings] == ["FLD"]
+    assert findings[0].file.endswith("ops/u64.py") and findings[0].line == 3
+
+
+# --------------------------------------------------- suppression audit --
+def test_stale_suppressions_reported():
+    """An escape comment on a line that no longer produces the underlying
+    finding is itself a finding (SUP), for every escape family."""
+    findings, suppressions = core.lint_report(
+        [os.path.join(FIXTURES, "stalesup.py")], doc=False)
+    assert [f.rule for f in findings] == ["SUP"] * 3
+    assert {s.rule for s in suppressions} == {"FLD", "THR", "EXC"}
+    assert all(s.stale for s in suppressions)
+    assert all("seeded-stale" in s.reason for s in suppressions)
+    assert [f.line for f in findings] == [s.line for s in sorted(
+        suppressions, key=lambda s: s.line)]
+
+
+def test_fld_proof_on_clean_numeric_line_is_stale(tmp_path):
+    """The acceptance case verbatim: a fld-proof(...) comment on a clean
+    line IN A NUMERIC MODULE is reported as stale."""
+    p = tmp_path / "ops" / "u64.py"
+    p.parent.mkdir()
+    p.write_text("def f(x):\n"
+                 "    # spgemm-lint: fld-proof(left over from a refactor)\n"
+                 "    return x + 1\n")
+    findings, suppressions = core.lint_report([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["SUP"]
+    assert "suppresses nothing" in findings[0].message
+    assert len(suppressions) == 1 and suppressions[0].stale
+
+
+def test_used_suppressions_inventoried_not_stale():
+    """Escapes that DO suppress something appear in the inventory with
+    stale=false and produce no SUP finding -- incl. interprocedural
+    call-site escapes and taint-suppressing source escapes."""
+    findings, suppressions = core.lint_report(
+        [os.path.join(FIXTURES, "callchain")], doc=False)
+    assert [f.rule for f in findings] == ["FLD", "FLD"]
+    assert len(suppressions) == 2  # call-site escape + source escape
+    assert not any(s.stale for s in suppressions)
+
+
 # ------------------------------------------------- JSON report contract --
 def test_json_report_fixture_run():
     """The machine-readable report: every rule family present with the
-    correct rule ID, (file, line, rule, message) per finding, exit 1."""
+    correct rule ID, (file, line, rule, message) per finding, the full
+    suppression inventory, exit 1."""
     rc = _run(["-m", "spgemm_tpu.analysis", "--json", FIXTURES,
                "--claude-md", FIXTURE_CLAUDE])
     assert rc.returncode == 1, rc.stderr[-2000:]
@@ -207,19 +417,277 @@ def test_json_report_fixture_run():
     assert report["clean"] is False
     # badknob: 3 classic + 2 planner-knob + 4 serve-knob reads;
     # badbackend: 3 import-time touches; badplanner: 2 @host_only-body
-    # touches
-    assert report["counts"] == {"FLD": 5, "KNB": 9, "BKD": 5, "DOC": 1,
-                                "PARSE": 0}
+    # touches; FLD: 5 per-module + 2 interprocedural (callchain);
+    # badthread/badexcept/stalesup: 3 each
+    assert report["counts"] == {"FLD": 7, "KNB": 9, "BKD": 5, "THR": 3,
+                                "EXC": 3, "DOC": 1, "SUP": 3, "PARSE": 0}
+    assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:
         assert set(f) == {"file", "line", "rule", "message"}
-        assert f["rule"] in ("FLD", "KNB", "BKD", "DOC")
+        assert f["rule"] in core.RULES
         assert isinstance(f["line"], int) and f["line"] >= 1
+    # the suppression inventory: every escape comment in the run, with
+    # the three stalesup.py seeds marked stale
+    sup = report["suppressions"]
+    assert all(set(s) == {"file", "line", "rule", "reason", "stale"}
+               for s in sup)
+    assert sum(s["stale"] for s in sup) == 3
+    assert all(s["file"].endswith("stalesup.py")
+               for s in sup if s["stale"])
+    assert len(sup) == 8  # 3 stale + thr-ok + exc-ok + fld escapes in use
 
 
 def test_json_report_clean_repo_run():
     """`make lint` contract: the default run exits 0 with a clean report
-    (and never needs a backend -- the linter is jax-free by design)."""
+    (and never needs a backend -- the linter is jax-free by design).  The
+    repo's own escape inventory rides along, all in use."""
     rc = _run(["-m", "spgemm_tpu.analysis", "--json"])
     assert rc.returncode == 0, rc.stdout + rc.stderr[-2000:]
     report = json.loads(rc.stdout)
     assert report["clean"] is True and report["findings"] == []
+    assert not any(s["stale"] for s in report["suppressions"])
+
+
+# ------------------------------------------------------ SARIF emission --
+def test_sarif_output_schema_shape(tmp_path):
+    """`--sarif F` (make lint-sarif) writes a SARIF 2.1.0 log: version +
+    $schema, one run, the full rule registry as tool.driver.rules, one
+    result per finding with ruleId/message/physicalLocation."""
+    out = tmp_path / "lint.sarif"
+    rc = _run(["-m", "spgemm_tpu.analysis", "--sarif", str(out),
+               os.path.join(FIXTURES, "badthread.py"),
+               os.path.join(FIXTURES, "badexcept.py")])
+    assert rc.returncode == 1
+    log = json.loads(out.read_text())
+    assert log["version"] == "2.1.0"
+    assert log["$schema"].endswith("sarif-2.1.0.json")
+    assert len(log["runs"]) == 1
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "spgemm-lint"
+    assert [r["id"] for r in driver["rules"]] == list(core.RULES)
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    assert len(run["results"]) == 6  # 3 THR + 3 EXC
+    for res in run["results"]:
+        assert res["ruleId"] in core.RULES
+        assert res["level"] == "error"
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+
+
+def test_sarif_clean_run_empty_results(tmp_path):
+    out = tmp_path / "lint.sarif"
+    rc = _run(["-m", "spgemm_tpu.analysis", "--sarif", str(out),
+               os.path.join(REPO, "spgemm_tpu", "utils", "timers.py")])
+    assert rc.returncode == 0
+    log = json.loads(out.read_text())
+    assert log["runs"][0]["results"] == []
+
+
+# -------------------------------------------- environment independence --
+def test_analysis_import_is_jax_free():
+    """The linter must never hang on a dead TPU: importing the analysis
+    package AND running the full default self-lint (incl. the DOC checks,
+    which import the CLI) pulls in no jax/jaxlib module."""
+    code = (
+        "import sys\n"
+        "import spgemm_tpu.analysis\n"
+        "from spgemm_tpu.analysis import callgraph, core, excrules, "
+        "sarif, thrrules\n"
+        "core.lint_repo()\n"
+        "bad = [m for m in sys.modules\n"
+        "       if m == 'jax' or m.startswith(('jax.', 'jaxlib'))]\n"
+        "assert not bad, f'linter pulled in jax: {bad}'\n")
+    rc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                        capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+
+
+def test_linter_reads_no_engine_env(monkeypatch):
+    """Lint results are environment-independent (CI-cacheable): a full
+    default run reads zero SPGEMM_TPU_* variables -- the knob table and
+    CLI epilog render from registry metadata, not live values."""
+    real = os.environ
+    reads: list[str] = []
+
+    class Tracker:
+        def get(self, key, default=None):
+            reads.append(key)
+            return real.get(key, default)
+
+        def __getitem__(self, key):
+            reads.append(key)
+            return real[key]
+
+        def __contains__(self, key):
+            reads.append(key)
+            return key in real
+
+        def __setitem__(self, key, value):  # pytest writes its own vars
+            real[key] = value
+
+        def __delitem__(self, key):
+            del real[key]
+
+        def __iter__(self):
+            return iter(dict(real))
+
+        def keys(self):
+            return real.keys()
+
+        def items(self):
+            return real.items()
+
+        def copy(self):
+            return real.copy()
+
+    monkeypatch.setattr(os, "environ", Tracker())
+    findings = core.lint_paths(core.default_paths(),
+                               claude_md=os.path.join(REPO, "CLAUDE.md"))
+    assert findings == []
+    engine_reads = [k for k in reads if k.startswith("SPGEMM_TPU_")]
+    assert engine_reads == [], engine_reads
+
+
+def test_analysis_help_covers_every_rule_id():
+    """The DOC half for the linter's own help: the epilog (generated from
+    core.RULES) names every rule id."""
+    assert docrules.check_analysis_help() == []
+    from spgemm_tpu.analysis.__main__ import build_parser
+    help_text = build_parser().format_help()
+    for rule in core.RULES:
+        assert rule in help_text
+
+
+# ------------------------------------------- review-hardening regressions --
+def test_interprocedural_fld_taint_survives_call_cycle(tmp_path):
+    """Regression: memoizing the in-progress None used to cut cycles
+    finalized an ancestor as clean when its only route to a reduction ran
+    through the cycle -- the call site a -> b -> d -> jnp.sum was silently
+    missed whenever b's back-edge to a was visited first."""
+    (tmp_path / "ops").mkdir()
+    (tmp_path / "ops" / "u64.py").write_text(
+        "import helpa\n"
+        "def entry(x):\n"
+        "    return helpa.a_fn(x)\n")
+    (tmp_path / "helpa.py").write_text(
+        "import helpb\n"
+        "def a_fn(x):\n"
+        "    return helpb.b_fn(x)\n")
+    (tmp_path / "helpb.py").write_text(
+        "import helpa\n"
+        "import helpd\n"
+        "def b_fn(x):\n"
+        "    helpa.a_fn(x)\n"          # cycle edge, visited first
+        "    return helpd.d_fn(x)\n")  # the route to the reduction
+    (tmp_path / "helpd.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def d_fn(x):\n"
+        "    return jnp.sum(x)\n")
+    findings = core.lint_paths([str(tmp_path)], doc=False)
+    assert [f.rule for f in findings] == ["FLD"]
+    assert findings[0].file.endswith("ops/u64.py")
+    assert "a_fn -> b_fn -> d_fn -> `jnp.sum`" in findings[0].message
+
+
+def test_thr_local_shadow_of_guarded_global_not_flagged(tmp_path):
+    """Regression: a plain local that shadows a guarded module global is
+    the LOCAL on every use (no `global` declaration), so THR must not
+    fire on it -- while `global X` rebinding stays checked, including
+    from a nested def closing over the shadowing scope."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_CACHE = {}  # spgemm-lint: guarded-by(_LOCK)\n"
+        "_LOCK = threading.Lock()\n"
+        "def local_shadow():\n"
+        "    _CACHE = {}\n"          # a plain local, not the global
+        "    _CACHE['x'] = 1\n"      # must NOT be a finding
+        "    def inner():\n"
+        "        return _CACHE\n"    # closure over the local: clean too
+        "    return inner\n"
+        "def global_rebind():\n"
+        "    global _CACHE\n"
+        "    _CACHE = {}\n"          # THE global, unguarded: finding
+        "def global_read():\n"
+        "    return len(_CACHE)\n")  # the global, unguarded: finding
+    findings = lint_file(str(p))
+    assert [f.rule for f in findings] == ["THR", "THR"]
+    assert [f.line for f in findings] == [12, 14]
+
+
+def test_exc_ble_reason_on_wrapped_handler_clause(tmp_path):
+    """Regression: a handler whose caught-type tuple wraps across lines
+    carries its justification on the clause's LAST line -- it must count
+    (a reformat of a justified handler must not break lint)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except (ValueError,\n"
+        "            Exception):  # noqa: BLE001 -- seeded: wrapped clause\n"
+        "        pass\n")
+    assert lint_file(str(p)) == []
+
+
+def test_thr_parameter_shadow_of_guarded_global_not_flagged(tmp_path):
+    """Regression: a function PARAMETER named like a guarded module global
+    is the local on every use -- THR must not fire on it."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_COUNT = 0  # spgemm-lint: guarded-by(_LOCK)\n"
+        "_LOCK = threading.Lock()\n"
+        "def param_shadow(_COUNT):\n"
+        "    return _COUNT + 1\n"       # the parameter, not the global
+        "def star_shadow(*_COUNT, **kw):\n"
+        "    return len(_COUNT)\n"      # vararg parameter: local too
+        "def real_read():\n"
+        "    return _COUNT\n")          # THE global, unguarded: finding
+    findings = lint_file(str(p))
+    assert [f.rule for f in findings] == ["THR"]
+    assert findings[0].line == 9
+
+
+def test_thr_init_not_exempt_for_module_globals(tmp_path):
+    """Regression: __init__'s exemption holds only for the instance's own
+    attributes (construction happens-before publication); a module global
+    is already published to every thread while __init__ runs, so an
+    unguarded write there is a real lost-update race -- a finding."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_COUNT = 0  # spgemm-lint: guarded-by(_LOCK)\n"
+        "_LOCK = threading.Lock()\n"
+        "class Reg:\n"
+        "    def __init__(self):\n"
+        "        global _COUNT\n"
+        "        _COUNT += 1\n"         # global in a ctor: still a finding
+        "        self.n = _COUNT\n")
+    findings = lint_file(str(p))
+    assert [f.rule for f in findings] == ["THR", "THR"]
+    assert [f.line for f in findings] == [7, 8]
+
+
+def test_fld_proof_two_lines_above_interprocedural_finding_is_stale(tmp_path):
+    """Regression: an fld-proof escape TWO lines above a tainted call
+    suppresses nothing (escapes bind to their line and the one below) --
+    the finding must still fire AND the escape must be reported stale,
+    not vouched for by a widened used-window."""
+    (tmp_path / "ops").mkdir()
+    (tmp_path / "ops" / "u64.py").write_text(
+        "import farhelp\n"
+        "def entry(x):\n"
+        "    # spgemm-lint: fld-proof(too far away to bind)\n"
+        "    y = x\n"
+        "    return farhelp.hidden(y)\n")
+    (tmp_path / "farhelp.py").write_text(
+        "import jax.numpy as jnp\n"
+        "def hidden(x):\n"
+        "    return jnp.sum(x)\n")
+    findings, suppressions = core.lint_report([str(tmp_path)], doc=False)
+    assert sorted(f.rule for f in findings) == ["FLD", "SUP"]
+    assert len(suppressions) == 1 and suppressions[0].stale

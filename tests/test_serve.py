@@ -683,3 +683,54 @@ def test_connection_bound_answers_busy(make_daemon, monkeypatch):
             s.close()
     _wait_until(lambda: d._conn_count == 0, msg="conns released")
     assert client.stats(d.socket_path)["ok"] is True
+
+
+# ------------------------------------------- THR lock-discipline fixes --
+def test_overdue_holds_the_job_lock():
+    """Regression for the THR finding spgemm-lint v2 surfaced: overdue()
+    read state/started_at lock-free while start()/finish() wrote them
+    under _lock (a torn read could pair a stale state with a fresh
+    started_at).  Pin the fix: overdue() participates in the job lock --
+    it blocks while another thread holds it -- and stays consistent
+    across a terminal transition."""
+    job = Job("job-thr", "f", "o", {}, timeout_s=0.001)
+    job.start()
+    time.sleep(0.01)
+    assert job.overdue()
+    job._lock.acquire()
+    got = []
+    t = threading.Thread(target=lambda: got.append(job.overdue()),
+                         daemon=True)
+    t.start()
+    t.join(timeout=0.3)
+    try:
+        assert t.is_alive(), "overdue() must wait for the job lock"
+    finally:
+        job._lock.release()
+    t.join(timeout=5.0)
+    assert got == [True]
+    job.finish("failed", error={"code": "x", "message": "m"})
+    assert not job.overdue()  # terminal: never overdue again
+
+
+def test_stats_degrade_snapshot_holds_the_daemon_lock(tmp_path):
+    """Regression for the THR finding on Daemon degrade state: _op_stats
+    (and the executor's degraded read, and _spawn_executor's write) used
+    degraded/degrade_reason/_probe_outcome lock-free against the
+    watchdog's locked writes in _degrade.  Pin the fix the same way:
+    the stats snapshot participates in the daemon lock."""
+    d = Daemon(str(tmp_path / "d.sock"), journal=False)  # not started:
+    # _op_stats needs no serving threads, so no teardown either
+    d._lock.acquire()
+    got = []
+    t = threading.Thread(target=lambda: got.append(d._op_stats()),
+                         daemon=True)
+    t.start()
+    t.join(timeout=0.3)
+    try:
+        assert t.is_alive(), "_op_stats must wait for the daemon lock"
+    finally:
+        d._lock.release()
+    t.join(timeout=5.0)
+    assert got and got[0]["ok"] is True
+    assert got[0]["degraded"] is False and got[0]["degrade_reason"] is None
