@@ -22,7 +22,7 @@ MEMFLAG = $(MEMFLAG_$(MEM))
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test lint lint-sarif bench bench-large warm serve-smoke clean
+.PHONY: all native run test lint lint-sarif bench bench-large warm serve-smoke obs-smoke clean
 
 all: native
 
@@ -69,6 +69,14 @@ bench:
 serve-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m spgemm_tpu.serve.smoke
+
+# observability end-to-end proof on CPU: daemon up, one submit, Prometheus
+# `metrics` scrape (phase + plan-cache series must move), trace dumped and
+# validated through the real `cli trace-dump`, clean shutdown; exits
+# nonzero on any step.
+obs-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m spgemm_tpu.serve.obs_smoke
 
 # the reference's Large scale (1M tiles) through the out-of-core pipeline
 bench-large:

@@ -423,6 +423,26 @@ def _run(args) -> int:
     except Exception as e:  # noqa: BLE001 -- parity smoke must not kill the bench
         tpu_parity = f"error: {e!r}"
 
+    # flight-recorder dump: every bench run is replayable in a trace
+    # viewer (Perfetto/chrome://tracing) -- the spans cover the timed
+    # iterations AND the warm/single-kernel passes above, ring-bounded by
+    # SPGEMM_TPU_OBS_RING_CAP.  SPGEMM_TPU_OBS_TRACE=0 (the overhead A/B
+    # knob) reports null.
+    trace_path = None
+    from spgemm_tpu.obs import trace as obs_trace
+    if obs_trace.enabled():
+        import tempfile
+        try:
+            # a fresh private dir, not a predictable world-writable /tmp
+            # name: shared bench hosts are the documented deployment, and
+            # a pre-planted symlink at a guessable path must not redirect
+            # the dump over a victim file
+            trace_path = obs_trace.dump_json(os.path.join(
+                tempfile.mkdtemp(prefix="spgemm-bench-trace-"),
+                "bench.trace.json"))
+        except OSError as e:
+            print(f"trace dump failed: {e!r}", file=sys.stderr)
+
     # reference Table 1 scales (BASELINE.md): tiles -> total multiply time.
     # Only claim a baseline ratio when the measured workload matches a
     # published scale (within ~25%); otherwise vs_baseline is null.
@@ -459,6 +479,7 @@ def _run(args) -> int:
             "plan_ahead": knobs.get("SPGEMM_TPU_PLAN_AHEAD"),
             "plan_cache_hits": plan_hits,
             "plan_cache_misses": plan_misses,
+            "trace_path": trace_path,
             **({"fallback": {
                 "reason": f"{args.cpu_fallback}; CPU with clamped workload",
                 "standing_evidence": "see the newest BENCH_r*.json with a "

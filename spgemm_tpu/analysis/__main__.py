@@ -56,38 +56,45 @@ def build_parser() -> argparse.ArgumentParser:
                         "(`make lint-sarif` writes lint.sarif)")
     p.add_argument("--claude-md", default=None, metavar="PATH",
                    help="CLAUDE.md to diff the knob table against "
-                        "(default: the repo's, on a default run)")
+                        "(default: the repo's, on a default run; the "
+                        "metrics table is checked in the ARCHITECTURE.md "
+                        "beside it)")
+    p.add_argument("--architecture-md", default=None, metavar="PATH",
+                   help="ARCHITECTURE.md for --write-metrics-table "
+                        "(default: the repo's)")
     p.add_argument("--no-doc", action="store_true",
                    help="skip the DOC drift checks")
     p.add_argument("--write-knob-table", action="store_true",
                    help="regenerate the CLAUDE.md knob-table block from "
                         "the registry and exit")
+    p.add_argument("--write-metrics-table", action="store_true",
+                   help="regenerate the ARCHITECTURE.md metrics-table "
+                        "block from the obs/metrics.py registry and exit")
     return p
 
 
-def _write_knob_table(path: str) -> int:
-    """Regenerate the marked CLAUDE.md block in place."""
-    block = docrules.render_knob_block()
+def _write_block(path: str, begin_marker: str, end_marker: str,
+                 block: str, what: str) -> int:
+    """Regenerate one marked generated-doc block in place."""
     try:
         with open(path, encoding="utf-8") as f:
             text = f.read()
     except OSError:
         print(f"cannot read {path}", file=sys.stderr)
         return 1
-    begin = text.find(docrules.KNOB_TABLE_BEGIN)
-    end = text.find(docrules.KNOB_TABLE_END)
+    begin = text.find(begin_marker)
+    end = text.find(end_marker)
     if begin < 0 or end < 0 or end < begin:
-        print(f"{path}: knob-table markers missing; paste this block where "
-              "the knob table belongs:\n\n" + block, file=sys.stderr)
+        print(f"{path}: {what} markers missing; paste this block where "
+              f"the {what} belongs:\n\n" + block, file=sys.stderr)
         return 1
-    new = (text[:begin] + block
-           + text[end + len(docrules.KNOB_TABLE_END):])
+    new = text[:begin] + block + text[end + len(end_marker):]
     if new != text:
         with open(path, "w", encoding="utf-8") as f:
             f.write(new)
-        print(f"updated knob table in {path}")
+        print(f"updated {what} in {path}")
     else:
-        print(f"knob table in {path} already current")
+        print(f"{what} in {path} already current")
     return 0
 
 
@@ -96,8 +103,22 @@ def main(argv: list[str] | None = None) -> int:
 
     root = core.repo_root()
     default_claude = os.path.join(root, "CLAUDE.md")
-    if args.write_knob_table:
-        return _write_knob_table(args.claude_md or default_claude)
+    if args.write_knob_table or args.write_metrics_table:
+        # both flags compose: "regenerate everything" must not silently
+        # leave the second table stale behind the first's early return
+        rc = 0
+        if args.write_knob_table:
+            rc = max(rc, _write_block(
+                args.claude_md or default_claude,
+                docrules.KNOB_TABLE_BEGIN, docrules.KNOB_TABLE_END,
+                docrules.render_knob_block(), "knob table"))
+        if args.write_metrics_table:
+            rc = max(rc, _write_block(
+                args.architecture_md or os.path.join(root,
+                                                     "ARCHITECTURE.md"),
+                docrules.METRICS_TABLE_BEGIN, docrules.METRICS_TABLE_END,
+                docrules.render_metrics_block(), "metrics table"))
+        return rc
 
     if args.paths:
         paths = args.paths

@@ -68,8 +68,12 @@ RULES = {
            "<reason>` justification, or a bare except / "
            "`except BaseException` that does not provably re-raise "
            "(the JobAbandoned contract); escape: exc-ok(<reason>)",
-    "DOC": "generated doc drift (CLAUDE.md knob table, CLI help knob "
-           "coverage, analysis --help rule-id coverage)",
+    "MET": "ENGINE.phase/record/incr metric name that is not a string "
+           "literal declared in the metrics registry "
+           "spgemm_tpu/obs/metrics.py (no ad-hoc time-series names)",
+    "DOC": "generated doc drift (CLAUDE.md knob table, ARCHITECTURE.md "
+           "metrics table, CLI help knob coverage, analysis --help "
+           "rule-id coverage)",
     "SUP": "stale suppression: an escape-hatch comment whose underlying "
            "finding no longer exists (delete the escape)",
     "PARSE": "file does not parse (no other rule ran on it)",
@@ -193,7 +197,8 @@ def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
     filter is applied here, so the same pass yields both the surviving
     findings and the raw (file, rule, line) triples the suppression audit
     needs to tell used escapes from stale ones."""
-    from spgemm_tpu.analysis import excrules, rules, thrrules  # noqa: PLC0415
+    from spgemm_tpu.analysis import (excrules, metrules, rules,  # noqa: PLC0415
+                                     thrrules)
 
     if unit.tree is None:
         return [unit.parse_finding], set()
@@ -219,6 +224,7 @@ def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
         findings += rules.check_bkd(unit.tree, unit.file)
     findings += escaping(thrrules.check_thr(unit, set()), "THR")
     findings += escaping(excrules.check_exc(unit, set()), "EXC")
+    findings += metrules.check_met(unit.tree, unit.file)
     return findings, raw
 
 
@@ -303,6 +309,17 @@ def lint_report(paths: list[str], *, claude_md: str | None = None,
     if doc:
         if claude_md is not None:
             findings += docrules.check_claude_md(claude_md)
+            # the metrics table lives in ARCHITECTURE.md beside the
+            # CLAUDE.md in play.  Only a CUSTOM --claude-md with no
+            # sibling ARCHITECTURE.md (fixture runs) skips the check; on
+            # the repo's own doc set a missing/renamed ARCHITECTURE.md is
+            # a DOC finding ("cannot read"), never a silently disabled
+            # drift guard -- symmetric with the knob table.
+            doc_dir = os.path.dirname(os.path.abspath(claude_md))
+            arch = os.path.join(doc_dir, "ARCHITECTURE.md")
+            if os.path.exists(arch) or doc_dir == _posix(repo_root()) \
+                    or doc_dir == repo_root():
+                findings += docrules.check_architecture_md(arch)
         findings += docrules.check_cli_help()
         findings += docrules.check_analysis_help()
     findings.sort(key=lambda f: (f.file, f.line, f.rule))

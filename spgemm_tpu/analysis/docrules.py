@@ -1,11 +1,16 @@
 """DOC rule: generated-vs-committed doc drift.
 
-Two halves:
+Three halves:
 
   * CLAUDE.md knob table -- the block between the `<!-- knob-table:begin -->`
     and `<!-- knob-table:end -->` markers must equal
     `knobs.knob_table_md()` exactly (regenerate with
     `python -m spgemm_tpu.analysis --write-knob-table`).
+  * ARCHITECTURE.md metrics table -- the block between the
+    `<!-- metrics-table:begin/end -->` markers must equal
+    `obs.metrics.metrics_table_md()` (regenerate with
+    `--write-metrics-table`): the scrape surface is registry-generated
+    exactly like the knobs.
   * CLI help -- `cli.build_parser()` help text must cover every registered
     knob name.  The epilog is generated from the registry
     (`knobs.cli_epilog`), so this check fails only if someone hardcodes or
@@ -21,6 +26,9 @@ from spgemm_tpu.utils import knobs
 KNOB_TABLE_BEGIN = "<!-- knob-table:begin -->"
 KNOB_TABLE_END = "<!-- knob-table:end -->"
 
+METRICS_TABLE_BEGIN = "<!-- metrics-table:begin -->"
+METRICS_TABLE_END = "<!-- metrics-table:end -->"
+
 
 def render_knob_block() -> str:
     """The full marked block, ready to paste into CLAUDE.md."""
@@ -28,34 +36,59 @@ def render_knob_block() -> str:
             f"{KNOB_TABLE_END}")
 
 
-def check_claude_md(path: str) -> list[Finding]:
-    """Diff the committed knob table against the registry-generated one."""
+def render_metrics_block() -> str:
+    """The full marked block, ready to paste into ARCHITECTURE.md."""
+    from spgemm_tpu.obs import metrics  # noqa: PLC0415
+
+    return (f"{METRICS_TABLE_BEGIN}\n{metrics.metrics_table_md()}\n"
+            f"{METRICS_TABLE_END}")
+
+
+def _check_marked_block(path: str, begin_marker: str, end_marker: str,
+                        generated: str, what: str,
+                        regen_flag: str) -> list[Finding]:
+    """Shared marker-block diff for the generated doc tables."""
     file = rel_file(path)
     try:
         with open(path, encoding="utf-8") as f:
             text = f.read()
     except OSError:
-        return [Finding(file, 1, "DOC", "knob-table check: cannot read "
-                        f"{file} (expected the generated knob table "
-                        f"between {KNOB_TABLE_BEGIN} / {KNOB_TABLE_END})")]
-    begin = text.find(KNOB_TABLE_BEGIN)
-    end = text.find(KNOB_TABLE_END)
+        return [Finding(file, 1, "DOC", f"{what} check: cannot read "
+                        f"{file} (expected the generated {what} "
+                        f"between {begin_marker} / {end_marker})")]
+    begin = text.find(begin_marker)
+    end = text.find(end_marker)
     if begin < 0 or end < 0 or end < begin:
         return [Finding(file, 1, "DOC",
-                        f"knob-table markers missing: {file} must carry the "
-                        f"generated knob table between {KNOB_TABLE_BEGIN} "
-                        f"and {KNOB_TABLE_END} (run `python -m "
-                        "spgemm_tpu.analysis --write-knob-table`)")]
-    committed = text[begin + len(KNOB_TABLE_BEGIN):end].strip()
-    generated = knobs.knob_table_md().strip()
-    if committed != generated:
+                        f"{what} markers missing: {file} must carry the "
+                        f"generated {what} between {begin_marker} "
+                        f"and {end_marker} (run `python -m "
+                        f"spgemm_tpu.analysis {regen_flag}`)")]
+    committed = text[begin + len(begin_marker):end].strip()
+    if committed != generated.strip():
         line = text[:begin].count("\n") + 1
         return [Finding(file, line, "DOC",
-                        "knob table drifted from the registry "
-                        "(spgemm_tpu/utils/knobs.py): regenerate with "
-                        "`python -m spgemm_tpu.analysis --write-knob-table`"
-                        f" (or paste knobs.knob_table_md() into {file})")]
+                        f"{what} drifted from its registry: regenerate "
+                        f"with `python -m spgemm_tpu.analysis "
+                        f"{regen_flag}`")]
     return []
+
+
+def check_claude_md(path: str) -> list[Finding]:
+    """Diff the committed knob table against the registry-generated one."""
+    return _check_marked_block(path, KNOB_TABLE_BEGIN, KNOB_TABLE_END,
+                               knobs.knob_table_md(), "knob table",
+                               "--write-knob-table")
+
+
+def check_architecture_md(path: str) -> list[Finding]:
+    """Diff the committed metrics table against the obs/metrics.py
+    registry (the same keep-it-generated contract as the knob table)."""
+    from spgemm_tpu.obs import metrics  # noqa: PLC0415
+
+    return _check_marked_block(path, METRICS_TABLE_BEGIN, METRICS_TABLE_END,
+                               metrics.metrics_table_md(), "metrics table",
+                               "--write-metrics-table")
 
 
 def check_analysis_help() -> list[Finding]:

@@ -46,6 +46,11 @@ class _PlanAheadWorker:
         self._outq: queue_mod.Queue = queue_mod.Queue()
         self._stop = threading.Event()
         self._sem = threading.Semaphore(ahead)
+        # captured on the CALLING thread: the worker's plan phases, cache
+        # counters, and spans must attribute to the job that spawned it
+        # (per-job PhaseScope + flight-recorder tags), not to the worker
+        # thread's anonymous context
+        self._attr = ENGINE.attribution()
         self._thread = threading.Thread(
             target=self._work, args=(list(pairs), planner),
             name="chain-planner", daemon=True)
@@ -54,14 +59,15 @@ class _PlanAheadWorker:
     @host_only
     def _work(self, pairs, planner):
         try:
-            for i, (a, b) in enumerate(pairs):
-                while not self._sem.acquire(timeout=0.2):
+            with ENGINE.attributed(self._attr):
+                for i, (a, b) in enumerate(pairs):
+                    while not self._sem.acquire(timeout=0.2):
+                        if self._stop.is_set():
+                            return
                     if self._stop.is_set():
                         return
-                if self._stop.is_set():
-                    return
-                self._outq.put((i, planner(a, b), None))
-                pairs[i] = None  # drop the operand refs as soon as planned
+                    self._outq.put((i, planner(a, b), None))
+                    pairs[i] = None  # drop operand refs as soon as planned
         except Exception as e:  # noqa: BLE001 -- re-raised on the consumer
             self._outq.put((None, None, e))
 

@@ -26,7 +26,9 @@ grepping the environment.
 `serve` / `submit` / `status` drive spgemmd (spgemm_tpu/serve/): a
 resident daemon owning the device whose warm jit/plan/crossover caches are
 reused across jobs, vs this run-once entrypoint paying them per
-invocation.
+invocation.  `metrics` scrapes the daemon's Prometheus text-format
+surface and `trace-dump` serializes its span flight recorder as
+Perfetto/Chrome trace_event JSON (spgemm_tpu/obs/).
 """
 
 from __future__ import annotations
@@ -200,8 +202,17 @@ def _subcommands() -> dict:
         from spgemm_tpu.serve import client  # noqa: PLC0415
         return client.main_status(argv)
 
+    def metrics(argv: list[str]) -> int:
+        from spgemm_tpu.serve import client  # noqa: PLC0415
+        return client.main_metrics(argv)
+
+    def trace_dump(argv: list[str]) -> int:
+        from spgemm_tpu.serve import client  # noqa: PLC0415
+        return client.main_trace_dump(argv)
+
     return {"knobs": run_knobs, "serve": serve,
-            "submit": submit, "status": status}
+            "submit": submit, "status": status,
+            "metrics": metrics, "trace-dump": trace_dump}
 
 
 def run(argv: list[str] | None = None) -> int:
@@ -209,12 +220,13 @@ def run(argv: list[str] | None = None) -> int:
 
     if argv is None:
         argv = sys.argv[1:]
-    # `knobs`/`serve`/`submit`/`status` are subcommands UNLESS an INPUT
-    # directory of that name exists (the reference contract requires a
-    # `size` file) -- a pre-existing `./knobs` matrix folder keeps its old
-    # meaning, while an unrelated scratch dir does not swallow the
-    # subcommand
-    if (argv and argv[0] in ("knobs", "serve", "submit", "status")
+    # `knobs`/`serve`/`submit`/`status`/`metrics`/`trace-dump` are
+    # subcommands UNLESS an INPUT directory of that name exists (the
+    # reference contract requires a `size` file) -- a pre-existing
+    # `./knobs` matrix folder keeps its old meaning, while an unrelated
+    # scratch dir does not swallow the subcommand
+    if (argv and argv[0] in ("knobs", "serve", "submit", "status",
+                             "metrics", "trace-dump")
             and not os.path.exists(os.path.join(argv[0], "size"))):
         return _subcommands()[argv[0]](argv[1:])
     parser = build_parser()

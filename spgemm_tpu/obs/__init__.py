@@ -1,0 +1,15 @@
+"""L5 observability: span tracing, metrics, trace export.
+
+Host-side and jax-free BY CONSTRUCTION (pinned by a subprocess test,
+mirroring the linter's jax-free contract): the flight recorder and the
+metrics registry are scraped/dumped from client processes and watchdog
+threads that must never touch -- or hang on -- a backend.
+
+  * obs/trace.py   -- the span flight recorder: every PhaseTimers phase
+    enter/exit emits a span (monotonic ts, duration, parent, job/trace
+    tags) into a bounded in-process ring, exportable as Perfetto/Chrome
+    trace_event JSON.
+  * obs/metrics.py -- the metrics registry (knobs.py-style single source
+    of truth: name, type, help) + Prometheus text-format 0.0.4 renderer
+    behind spgemmd's `metrics` op and `spgemm_tpu.cli metrics`.
+"""

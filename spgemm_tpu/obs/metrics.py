@@ -1,0 +1,298 @@
+"""Metrics registry + Prometheus text-format 0.0.4 renderer.
+
+knobs.py-style single source of truth: every scrapeable metric family is
+declared here ONCE (name, type counter|gauge|histogram, labels, consuming
+module, help text), and every ENGINE phase/counter name the package may
+pass to `ENGINE.phase/record/incr` is declared here too.  From the
+registry are generated:
+
+  * the renderer's validation -- `render()` raises on an undeclared
+    family name, so an ad-hoc metric cannot ship silently;
+  * the ARCHITECTURE.md metrics table (`metrics_table_md`; the linter's
+    DOC rule diffs the generated text against the committed block, and
+    `python -m spgemm_tpu.analysis --write-metrics-table` regenerates it);
+  * the MET lint rule's name set (`analysis/metrules.py`): an
+    `ENGINE.incr("...")`/`record`/`phase` whose name literal is not
+    declared below is a lint finding -- no ad-hoc series names.
+
+jax-free by design: imported by the linter, the client CLI, and spgemmd's
+scrape path, none of which may touch a backend.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# Engine PHASE names (wall-seconds accumulators): the only names the
+# package may pass to ENGINE.phase(...) / ENGINE.record(...).  Each
+# becomes one spgemm_phase_* label value and one span name in the flight
+# recorder.
+ENGINE_PHASES: dict[str, str] = {
+    "plan": "full symbolic plan (join + rounds + assembly permutation)",
+    "plan_wait": "how long dispatch actually blocked on planning",
+    "symbolic_join": "host symbolic join over operand structures",
+    "plan_rounds": "round bucketing + assembly permutation",
+    "numeric_dispatch": "numeric kernel launches (host dispatch span)",
+    "assembly": "on-device result assembly / OOC host landing",
+    "stage_prep": "OOC staging worker: host gather/pack of one round",
+    "ring_plan": "ring schedule planning",
+    "ring_hop": "one-hop ring wire probe",
+    "ring_fold": "per-slab ring fold",
+    "dcn_exchange": "multihost partial exchange over DCN",
+    "serve_queue_wait": "spgemmd: submit-to-execution queue wait",
+    "serve_execute": "spgemmd: one job's executor span",
+}
+
+# Engine event COUNTER names: the only names the package may pass to
+# ENGINE.incr(...).  Each becomes one spgemm_engine_events_total label
+# value.
+ENGINE_COUNTERS: dict[str, str] = {
+    "dispatches": "numeric kernel launches",
+    "ring_steps": "ring rotation steps executed",
+    "dcn_chunks": "bounded DCN exchange chunks shipped",
+    "plan_cache_hits": "structure-keyed plan cache hits",
+    "plan_cache_misses": "structure-keyed plan cache misses",
+    "serve_reaps": "spgemmd watchdog job reaps (deadline exceeded)",
+    "serve_degrades": "spgemmd degrade transitions to the CPU path",
+}
+
+
+@dataclass(frozen=True)
+class Metric:
+    """One declared metric family.
+
+    kind: 'counter' | 'gauge' | 'histogram'.  Histogram samples are fed
+    as {"buckets": {le: cumulative_count}, "sum": s, "count": n}.
+    labels: the exact label names every sample of the family must carry.
+    module: the producing module (repo-relative), for docs.
+    """
+
+    name: str
+    kind: str
+    doc: str
+    module: str
+    labels: tuple[str, ...] = ()
+
+
+_METRICS = (
+    Metric("spgemm_phase_seconds_total", "counter",
+           "Wall seconds accumulated per engine phase (the ENGINE "
+           "registry's totals; phase names are declared in "
+           "obs/metrics.ENGINE_PHASES).",
+           "utils/timers.py", labels=("phase",)),
+    Metric("spgemm_phase_entries_total", "counter",
+           "Times each engine phase was entered.",
+           "utils/timers.py", labels=("phase",)),
+    Metric("spgemm_engine_events_total", "counter",
+           "Engine event counters (ENGINE.incr names, declared in "
+           "obs/metrics.ENGINE_COUNTERS: dispatches, ring_steps, "
+           "plan_cache_hits/misses, ...).",
+           "utils/timers.py", labels=("event",)),
+    Metric("spgemm_plan_cache_hits_total", "counter",
+           "Structure-keyed plan cache hits since process start.",
+           "ops/plancache.py"),
+    Metric("spgemm_plan_cache_misses_total", "counter",
+           "Structure-keyed plan cache misses since process start.",
+           "ops/plancache.py"),
+    Metric("spgemm_plan_cache_entries", "gauge",
+           "Plans currently retained in the LRU.",
+           "ops/plancache.py"),
+    Metric("spgemm_plan_cache_capacity", "gauge",
+           "Configured plan-cache LRU capacity "
+           "(SPGEMM_TPU_PLAN_CACHE_CAP).",
+           "ops/plancache.py"),
+    Metric("spgemm_trace_spans", "gauge",
+           "Spans currently retained in the flight-recorder ring.",
+           "obs/trace.py"),
+    Metric("spgemm_trace_spans_emitted_total", "counter",
+           "Spans emitted into the flight recorder since process start.",
+           "obs/trace.py"),
+    Metric("spgemm_trace_spans_dropped_total", "counter",
+           "Spans evicted from the ring (oldest-first past "
+           "SPGEMM_TPU_OBS_RING_CAP).",
+           "obs/trace.py"),
+    Metric("spgemmd_uptime_seconds", "gauge",
+           "Seconds since the serving daemon started.",
+           "serve/daemon.py"),
+    Metric("spgemmd_degraded", "gauge",
+           "1 when the daemon is on the CPU failover path (wedged/dead "
+           "executor), else 0.",
+           "serve/daemon.py"),
+    Metric("spgemmd_queue_depth", "gauge",
+           "Jobs currently waiting in the admission FIFO.",
+           "serve/daemon.py"),
+    Metric("spgemmd_connections", "gauge",
+           "Concurrent client connections held open.",
+           "serve/daemon.py"),
+    Metric("spgemmd_jobs", "gauge",
+           "Jobs in the live index by state (terminal states bounded by "
+           "JobQueue.RETAIN_TERMINAL).",
+           "serve/daemon.py", labels=("state",)),
+    Metric("spgemmd_jobs_terminal_total", "counter",
+           "Daemon-lifetime terminal job outcomes: done, error (runner "
+           "raised), timeout (watchdog reap -- a later wedge declaration "
+           "does not re-count the job; alert on spgemmd_degraded / "
+           "serve_degrades for wedges), abandoned (executor thread died "
+           "mid-job).",
+           "serve/daemon.py", labels=("outcome",)),
+    Metric("spgemmd_journal_bytes", "gauge",
+           "On-disk size of the job journal next to the socket.",
+           "serve/daemon.py"),
+    Metric("spgemmd_journal_compactions_total", "counter",
+           "Journal compactions since daemon start (startup replay "
+           "included).",
+           "serve/daemon.py"),
+    Metric("spgemmd_job_wall_seconds", "histogram",
+           "Per-job wall time start-to-terminal (reaped jobs included).",
+           "serve/daemon.py"),
+)
+
+REGISTRY: dict[str, Metric] = {m.name: m for m in _METRICS}
+
+# spgemmd_job_wall_seconds bucket upper bounds (seconds); +Inf implicit
+JOB_WALL_BUCKETS = (0.1, 1.0, 10.0, 60.0, 600.0, 3600.0)
+
+
+# ---------------------------------------------------------- text format --
+def escape_help(text: str) -> str:
+    """Prometheus 0.0.4 HELP escaping: backslash and newline."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label(value: str) -> str:
+    """Prometheus 0.0.4 label-value escaping: backslash, quote, newline."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return f"{float(v):.10g}"
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{escape_label(str(v))}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def render(samples: list[tuple]) -> str:
+    """Prometheus text-format 0.0.4 for `samples`: (family, labels, value)
+    tuples, histogram values as {"buckets", "sum", "count"} dicts.
+
+    Families render in REGISTRY order with one HELP/TYPE header each; an
+    undeclared family name raises ValueError (declaring is the price of
+    emitting -- the same contract as the knob registry), as does a sample
+    whose label names differ from the declaration."""
+    by_family: dict[str, list[tuple[dict, object]]] = {}
+    for family, labels, value in samples:
+        m = REGISTRY.get(family)
+        if m is None:
+            raise ValueError(
+                f"undeclared metric {family!r}: register it in "
+                "spgemm_tpu/obs/metrics.py (no ad-hoc series names)")
+        if tuple(sorted(labels)) != tuple(sorted(m.labels)):
+            raise ValueError(
+                f"metric {family!r} declares labels {m.labels}, sample "
+                f"carries {tuple(sorted(labels))}")
+        by_family.setdefault(family, []).append((dict(labels), value))
+    lines: list[str] = []
+    for m in _METRICS:
+        rows = by_family.get(m.name)
+        if rows is None:
+            continue
+        lines.append(f"# HELP {m.name} {escape_help(m.doc)}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        for labels, value in sorted(rows,
+                                    key=lambda r: sorted(r[0].items())):
+            if m.kind == "histogram":
+                buckets = value["buckets"]
+                for le in sorted(buckets):
+                    lab = _fmt_labels({**labels, "le": f"{le:g}"})
+                    lines.append(f"{m.name}_bucket{lab} "
+                                 f"{_fmt_value(buckets[le])}")
+                inf_lab = _fmt_labels({**labels, "le": "+Inf"})
+                lines.append(f"{m.name}_bucket{inf_lab} "
+                             f"{_fmt_value(value['count'])}")
+                lines.append(f"{m.name}_sum{_fmt_labels(labels)} "
+                             f"{_fmt_value(value['sum'])}")
+                lines.append(f"{m.name}_count{_fmt_labels(labels)} "
+                             f"{_fmt_value(value['count'])}")
+            else:
+                lines.append(f"{m.name}{_fmt_labels(labels)} "
+                             f"{_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------- engine collection --
+def collect_engine() -> list[tuple]:
+    """Samples for the process-wide engine state: ENGINE phase totals and
+    event counters, plan-cache stats, flight-recorder ring health.  The
+    daemon layers its serving gauges on top; bench/CLI could render this
+    alone.  jax-free (timers/plancache/trace all are)."""
+    from spgemm_tpu.ops import plancache  # noqa: PLC0415
+    from spgemm_tpu.obs import trace  # noqa: PLC0415
+    from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+
+    samples: list[tuple] = []
+    totals = ENGINE.snapshot()
+    counts = ENGINE.count_snapshot()
+    for name in sorted(totals):
+        samples.append(("spgemm_phase_seconds_total", {"phase": name},
+                        totals[name]))
+        samples.append(("spgemm_phase_entries_total", {"phase": name},
+                        counts.get(name, 0)))
+    for name, n in sorted(ENGINE.counter_snapshot().items()):
+        samples.append(("spgemm_engine_events_total", {"event": name}, n))
+    try:
+        cache = plancache.stats()
+    except ValueError:
+        cache = None  # invalid cache knob: skip the rows, keep the scrape
+    if cache is not None:
+        samples += [
+            ("spgemm_plan_cache_hits_total", {}, cache["hits"]),
+            ("spgemm_plan_cache_misses_total", {}, cache["misses"]),
+            ("spgemm_plan_cache_entries", {}, cache["entries"]),
+            ("spgemm_plan_cache_capacity", {}, cache["capacity"]),
+        ]
+    ring = trace.RECORDER.stats()
+    samples += [
+        ("spgemm_trace_spans", {}, ring["spans"]),
+        ("spgemm_trace_spans_emitted_total", {}, ring["emitted"]),
+        ("spgemm_trace_spans_dropped_total", {}, ring["dropped"]),
+    ]
+    return samples
+
+
+# -------------------------------------------------------- generated docs --
+def metrics_table_md() -> str:
+    """The generated ARCHITECTURE.md metrics table (families + the
+    declared ENGINE phase/counter name sets).  The DOC lint rule diffs
+    this text against the committed block between the
+    `<!-- metrics-table:begin -->` / `<!-- metrics-table:end -->` markers;
+    regenerate with `python -m spgemm_tpu.analysis
+    --write-metrics-table`."""
+    lines = [
+        "| metric | type | labels | produced in | what it measures |",
+        "|---|---|---|---|---|",
+    ]
+
+    def md(cell: str) -> str:
+        return cell.replace("|", "\\|")
+
+    for m in _METRICS:
+        labels = ", ".join(f"`{label}`" for label in m.labels) or "—"
+        lines.append(f"| `{m.name}` | {m.kind} | {labels} | `{m.module}` "
+                     f"| {md(m.doc)} |")
+    lines.append("")
+    lines.append("Declared `phase` label values (ENGINE phase names): "
+                 + ", ".join(f"`{n}`" for n in ENGINE_PHASES) + ".")
+    lines.append("")
+    lines.append("Declared `event` label values (ENGINE counter names): "
+                 + ", ".join(f"`{n}`" for n in ENGINE_COUNTERS) + ".")
+    return "\n".join(lines)

@@ -1,0 +1,242 @@
+"""Span flight recorder: the engine's in-process black box.
+
+Every `utils/timers.PhaseTimers` phase enter/exit emits a span here --
+name, monotonic timestamp, duration, thread, parent span, and whatever
+job/trace tags are active on the emitting thread -- into a bounded ring
+(`SPGEMM_TPU_OBS_RING_CAP`, default 4096 spans; the oldest are evicted
+and counted, never an unbounded buffer inside a resident daemon).  The
+ring is what a wedge/degrade postmortem reads: spgemmd snapshots it next
+to the job journal on every reap/degrade transition, the `trace` op and
+`spgemm_tpu.cli trace-dump` serialize it as Perfetto/Chrome trace_event
+JSON, and bench.py attaches a dump path to every run's detail.
+
+`SPGEMM_TPU_OBS_TRACE=0` disables span emission entirely (timers still
+accumulate totals) -- the whole-engine A/B knob that proves the
+recorder's overhead, like every other engine knob.
+
+jax-free and lock-disciplined by construction: the ring is guarded by a
+lock the THR lint rule enforces; the per-thread open-span stack and tag
+map live in a threading.local (thread-affine by definition, nothing to
+guard).  Parenting is lexical per thread: the span open on a thread when
+another begins is its parent, so a numeric_dispatch span nests under the
+serve_execute span of the job that dispatched it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from spgemm_tpu.utils import knobs
+
+# one monotonic origin per process: every span timestamp is microseconds
+# since this module loaded, so spans from any thread share one timeline
+_BASE = time.perf_counter()
+
+
+def enabled() -> bool:
+    """SPGEMM_TPU_OBS_TRACE=0|1 (default 1): span emission on/off.  Read
+    lazily per span, like every knob -- tests and A/B harnesses flip it
+    mid-process."""
+    return knobs.get("SPGEMM_TPU_OBS_TRACE")
+
+
+def ring_cap() -> int:
+    """SPGEMM_TPU_OBS_RING_CAP (default 4096): spans retained."""
+    return knobs.get("SPGEMM_TPU_OBS_RING_CAP")
+
+
+class FlightRecorder:
+    """Bounded in-process span ring + per-thread span stacks and tags.
+
+    begin()/end() bracket a phase (the PhaseTimers integration); point()
+    records an externally-timed span ending now (timers.record); instant()
+    records a zero-duration marker (degrade/reap transitions).  All are
+    no-ops while the knob is off -- a disabled recorder costs one env read
+    per phase."""
+
+    def __init__(self):
+        self._spans: deque = deque()  # spgemm-lint: guarded-by(_lock)
+        self._dropped = 0             # spgemm-lint: guarded-by(_lock)
+        self._emitted = 0             # spgemm-lint: guarded-by(_lock)
+        self._next_id = 1             # spgemm-lint: guarded-by(_lock)
+        self._lock = threading.Lock()
+        self._tls = threading.local()  # open-span stack + tags, thread-affine
+
+    # ------------------------------------------------------ thread state --
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def current_tags(self) -> dict:
+        """The emitting thread's active job/trace tags (a copy)."""
+        return dict(getattr(self._tls, "tags", ()) or {})
+
+    @contextlib.contextmanager
+    def tagged(self, **tags):
+        """Attach tags (job_id/trace_id/...) to every span emitted by this
+        thread inside the block; None values are dropped.  Nests: inner
+        blocks layer over -- and on exit restore -- the outer map."""
+        prev = getattr(self._tls, "tags", None)
+        merged = dict(prev or {})
+        merged.update({k: v for k, v in tags.items() if v is not None})
+        self._tls.tags = merged
+        try:
+            yield
+        finally:
+            self._tls.tags = prev
+
+    # --------------------------------------------------------- emission --
+    def _new_id(self) -> int:
+        """Span ids are assigned at OPEN time: a child span commits before
+        its still-open parent, so the parent id the child records must be
+        the id the parent will eventually commit under."""
+        with self._lock:
+            span_id = self._next_id
+            self._next_id += 1
+        return span_id
+
+    def begin(self, name: str):
+        """Open a span on this thread; returns the token end() consumes
+        (None while disabled -- end(None) is a no-op)."""
+        if not enabled():
+            return None
+        stack = self._stack()
+        parent = stack[-1][0] if stack else None
+        token = (self._new_id(), name, time.perf_counter(), parent)
+        stack.append(token)
+        return token
+
+    def end(self, token) -> None:
+        """Close the span `token` opened and ring-commit it."""
+        if token is None:
+            return
+        now = time.perf_counter()
+        stack = self._stack()
+        span_id, name, t0, parent = token
+        # unwind to our own entry: a knob flip mid-phase (or an abandoned
+        # begin) can leave younger entries above it on this thread's stack
+        while stack:
+            if stack.pop()[0] == span_id:
+                break
+        self._commit(span_id, name, t0, now - t0, parent, "X")
+
+    def point(self, name: str, seconds: float) -> None:
+        """A span whose endpoints the caller timed itself (timers.record):
+        ends now, lasted `seconds`, parented under this thread's open
+        span."""
+        if not enabled():
+            return
+        stack = self._stack()
+        parent = stack[-1][0] if stack else None
+        self._commit(self._new_id(), name, time.perf_counter() - seconds,
+                     seconds, parent, "X")
+
+    def instant(self, name: str, **tags) -> None:
+        """Zero-duration marker (reap/wedge/degrade transitions)."""
+        if not enabled():
+            return
+        stack = self._stack()
+        parent = stack[-1][0] if stack else None
+        with self.tagged(**tags):
+            self._commit(self._new_id(), name, time.perf_counter(), 0.0,
+                         parent, "i")
+
+    def _commit(self, span_id: int, name: str, t0: float, dur_s: float,
+                parent, ph: str) -> None:
+        thread = threading.current_thread()
+        span = {
+            "id": span_id,
+            "name": name,
+            "ph": ph,
+            "ts": round((t0 - _BASE) * 1e6, 3),     # us on the shared origin
+            "dur": round(max(dur_s, 0.0) * 1e6, 3),  # us
+            "tid": thread.ident,
+            "thread": thread.name,
+            "parent": parent,
+        }
+        tags = self.current_tags()
+        if tags:
+            span["tags"] = tags
+        cap = ring_cap()
+        with self._lock:
+            self._spans.append(span)
+            self._emitted += 1
+            while len(self._spans) > cap:
+                self._spans.popleft()
+                self._dropped += 1
+
+    # -------------------------------------------------------- inspection --
+    def snapshot(self) -> list[dict]:
+        """Retained spans, oldest first (copies -- safe to serialize)."""
+        with self._lock:
+            return [dict(s) for s in self._spans]
+
+    def stats(self) -> dict:
+        """Ring health for metrics: retained/emitted/dropped + config."""
+        with self._lock:
+            retained = len(self._spans)
+            emitted = self._emitted
+            dropped = self._dropped
+        return {"spans": retained, "emitted": emitted, "dropped": dropped,
+                "capacity": ring_cap(), "enabled": enabled()}
+
+    def clear(self) -> None:
+        """Drop every span and zero the counters (tests, harnesses)."""
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+            self._emitted = 0
+
+
+# The process-wide recorder: every PhaseTimers instance emits here, the
+# daemon snapshots it, the CLI dumps it.
+RECORDER = FlightRecorder()
+
+
+# ------------------------------------------------------- Perfetto export --
+def to_trace_events(spans: list[dict] | None = None) -> list[dict]:
+    """Chrome/Perfetto trace_event JSON array for the given spans (default:
+    the live ring).  Complete events ('X') carry ts+dur; instants stay
+    'i'; one metadata event per thread names it in the viewer."""
+    if spans is None:
+        spans = RECORDER.snapshot()
+    pid = os.getpid()
+    events: list[dict] = []
+    named_tids: dict[int, str] = {}
+    for s in spans:
+        tid = s.get("tid") or 0
+        if tid not in named_tids:
+            named_tids[tid] = s.get("thread", f"thread-{tid}")
+        args = dict(s.get("tags") or {})
+        args["span_id"] = s.get("id")
+        if s.get("parent") is not None:
+            args["parent"] = s["parent"]
+        ev = {"name": s["name"], "cat": "spgemm", "ph": s.get("ph", "X"),
+              "ts": s["ts"], "pid": pid, "tid": tid, "args": args}
+        if ev["ph"] == "X":
+            ev["dur"] = s.get("dur", 0.0)
+        events.append(ev)
+    meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": name}} for tid, name in sorted(named_tids.items())]
+    return meta + events
+
+
+def dump_json(path: str, spans: list[dict] | None = None) -> str:
+    """Write the trace_event array to `path` (parent dirs created) and
+    return the path -- the one serializer behind `cli trace-dump`, the
+    daemon's postmortem auto-dump, and bench.py's detail.trace_path."""
+    events = to_trace_events(spans)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(events, f, separators=(",", ":"))
+    os.replace(tmp, path)  # a reader never sees a torn dump
+    return path

@@ -771,6 +771,9 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
         land_err: list = []
         prep_err: list = []
         slots = threading.Semaphore(depth)
+        # the workers' stage_prep/assembly phases and spans belong to the
+        # multiply that spawned them (per-job PhaseScope + trace tags)
+        attr = timers.attribution()
 
         def _put(q, item):
             """Bounded put that can never deadlock a dying pipeline: bail
@@ -785,30 +788,32 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
         def _stager():
             try:
-                for rnd in rounds:
-                    if stop.is_set() or land_err:
-                        return
-                    with timers.phase("stage_prep"):
-                        prep = host_prep(rnd)
-                    if not _put(stageq, (rnd, prep)):
-                        return
+                with timers.attributed(attr):
+                    for rnd in rounds:
+                        if stop.is_set() or land_err:
+                            return
+                        with timers.phase("stage_prep"):
+                            prep = host_prep(rnd)
+                        if not _put(stageq, (rnd, prep)):
+                            return
             except Exception as e:  # noqa: BLE001 -- re-raised below
                 prep_err.append(e)
             finally:
                 _put(stageq, None)
 
         def _lander():
-            while True:
-                item = landq.get()
-                if item is None:
-                    return
-                if not land_err:  # keep draining after a failure so the
-                    try:          # producer can never deadlock
-                        with timers.phase("assembly"):
-                            land(*item)
-                    except Exception as e:  # noqa: BLE001 -- re-raised below
-                        land_err.append(e)
-                slots.release()
+            with timers.attributed(attr):
+                while True:
+                    item = landq.get()
+                    if item is None:
+                        return
+                    if not land_err:  # keep draining after a failure so the
+                        try:          # producer can never deadlock
+                            with timers.phase("assembly"):
+                                land(*item)
+                        except Exception as e:  # noqa: BLE001 -- re-raised below
+                            land_err.append(e)
+                    slots.release()
 
         lander = threading.Thread(target=_lander, name="ooc-landing",
                                   daemon=True)

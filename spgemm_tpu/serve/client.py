@@ -93,6 +93,16 @@ def stats(socket_path: str | None = None) -> dict:
     return request({"op": "stats"}, socket_path)
 
 
+def metrics(socket_path: str | None = None) -> str:
+    """The daemon's Prometheus text-format 0.0.4 scrape body."""
+    return request({"op": "metrics"}, socket_path)["text"]
+
+
+def trace(socket_path: str | None = None) -> list[dict]:
+    """The daemon's span flight recorder as trace_event JSON events."""
+    return request({"op": "trace"}, socket_path)["trace_events"]
+
+
 def shutdown(socket_path: str | None = None) -> dict:
     return request({"op": "shutdown"}, socket_path)
 
@@ -141,6 +151,59 @@ def main_submit(argv: list[str] | None = None) -> int:
     print(json.dumps(resp, indent=2))
     if args.wait and resp.get("job", {}).get("state") != "done":
         return 1
+    return 0
+
+
+def main_metrics(argv: list[str] | None = None) -> int:
+    """`spgemm_tpu metrics`: scrape the running daemon's Prometheus
+    surface (text-format 0.0.4 on stdout -- pipe it straight into a
+    node-exporter textfile collector or curl-style probe)."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu metrics",
+        description="scrape the running spgemmd daemon's metrics "
+                    "(Prometheus text-format 0.0.4: engine phase seconds, "
+                    "plan-cache hits/misses, queue depth, degrade state, "
+                    "terminal job totals)")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
+                        "or <tmpdir>/spgemmd-<uid>.sock)")
+    args = p.parse_args(argv)
+    try:
+        sys.stdout.write(metrics(args.socket))
+    except (ServeError, OSError) as e:
+        print(f"metrics failed: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main_trace_dump(argv: list[str] | None = None) -> int:
+    """`spgemm_tpu trace-dump`: serialize the daemon's span flight
+    recorder as Perfetto/Chrome trace_event JSON (open the file at
+    https://ui.perfetto.dev or chrome://tracing)."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu trace-dump",
+        description="dump the running spgemmd daemon's span flight "
+                    "recorder as Perfetto/Chrome trace_event JSON")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
+                        "or <tmpdir>/spgemmd-<uid>.sock)")
+    p.add_argument("--output", "-o", default=None, metavar="FILE",
+                   help="write the trace_event array here "
+                        "(default: stdout)")
+    args = p.parse_args(argv)
+    try:
+        events = trace(args.socket)
+    except (ServeError, OSError) as e:
+        print(f"trace-dump failed: {e}", file=sys.stderr)
+        return 1
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            json.dump(events, f, separators=(",", ":"))
+        print(f"wrote {len(events)} trace events to {args.output}",
+              file=sys.stderr)
+    else:
+        json.dump(events, sys.stdout, separators=(",", ":"))
+        sys.stdout.write("\n")
     return 0
 
 

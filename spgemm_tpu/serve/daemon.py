@@ -50,6 +50,8 @@ import sys
 import threading
 import time
 
+from spgemm_tpu.obs import metrics as obs_metrics
+from spgemm_tpu.obs import trace as obs_trace
 from spgemm_tpu.serve import protocol
 from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
                                     QueueFull)
@@ -124,6 +126,12 @@ class Daemon:
     # growing for its lifetime (class attribute so tests can shrink it)
     JOURNAL_COMPACT_EVERY = 256
 
+    # flight dumps retained in <socket>.flight/: a resident daemon whose
+    # jobs keep timing out writes one dump per reap, so like every other
+    # client-growable resource (RETAIN_TERMINAL, MAX_CONNS, the journal)
+    # the dir is bounded -- oldest dumps pruned past this many
+    FLIGHT_RETAIN = 64
+
     # concurrent-connection bound: every accepted connection pins one
     # spgemmd-conn thread (+ up to protocol.MAX_LINE_BYTES of pending
     # buffer), so a connect() loop that never closes must exhaust THIS --
@@ -151,6 +159,9 @@ class Daemon:
                  wedge_grace_s: float | None = None, journal: bool = True):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.journal_path = self.socket_path + ".journal"
+        # postmortem flight dumps (watchdog reap / wedge / degrade) land
+        # here, next to the journal: <socket>.flight/<job>.trace.json
+        self.flight_dir = self.socket_path + ".flight"
         self._runner = runner or run_chain_job
         self._probe = probe
         self._cap = queue_cap if queue_cap is not None \
@@ -165,6 +176,20 @@ class Daemon:
             else knobs.get("SPGEMM_TPU_SERVE_WEDGE_GRACE_S")
         self._journal_enabled = journal
         self._journal_terminal_events = 0  # spgemm-lint: guarded-by(_lock)
+        self._journal_compactions = 0      # spgemm-lint: guarded-by(_lock)
+        # daemon-lifetime terminal outcomes (stats + the Prometheus
+        # spgemmd_jobs_terminal_total series): the queue index evicts old
+        # terminal jobs, so a scraper needs these to tell a healthy idle
+        # daemon from one that just degraded and recovered
+        self._terminal_totals = {"done": 0, "error": 0, "timeout": 0,
+                                 "abandoned": 0}  # spgemm-lint: guarded-by(_lock)
+        self._job_wall = {
+            "buckets": {le: 0 for le in obs_metrics.JOB_WALL_BUCKETS},
+            "sum": 0.0, "count": 0}        # spgemm-lint: guarded-by(_lock)
+        # flight dumps in THIS daemon's write order: retention must prune
+        # oldest-first even on filesystems whose mtime granularity ties a
+        # reap burst (mtime orders only pre-restart leftovers)
+        self._flight_order: list[str] = []  # spgemm-lint: guarded-by(_lock)
         self.queue = JobQueue(self._cap)
         # degrade state: written by the watchdog, read by the executor and
         # every stats request -- the machine-checked half of the old
@@ -232,6 +257,7 @@ class Daemon:
             for ev in live:
                 f.write(json.dumps(ev, separators=(",", ":")) + "\n")
         self._journal_terminal_events = 0
+        self._journal_compactions += 1
 
     def _journal_replay(self) -> None:
         """Re-queue journaled jobs that never reached a terminal state,
@@ -256,11 +282,13 @@ class Daemon:
                 log.info("journal: re-queued unfinished job %s (%s)",
                          job.id, job.folder)
             except QueueFull:
-                job.finish("failed", error={
-                    "code": protocol.E_QUEUE_FULL,
-                    "message": "queue full while re-queueing from journal"},
-                    on_commit=lambda j=job: self._journal_append(
-                        {"event": "failed", "id": j.id}))
+                if job.finish("failed", error={
+                        "code": protocol.E_QUEUE_FULL,
+                        "message": "queue full while re-queueing from "
+                                   "journal"},
+                        on_commit=lambda j=job: self._journal_append(
+                            {"event": "failed", "id": j.id})):
+                    self._observe_terminal(job, "error")
             num = int(ev["id"].rsplit("-", 1)[-1]) \
                 if ev["id"].rsplit("-", 1)[-1].isdigit() else 0
             # replay runs at start(), before any serving thread exists,
@@ -359,7 +387,18 @@ class Daemon:
             job.scope, job.scope_degraded = scope, degraded
             self._current = job
             try:
-                self._runner(job, degraded=degraded)
+                # every span this job's work emits (executor thread + the
+                # plan-ahead / OOC workers it spawns, which adopt the
+                # attribution) carries the job id; queue wait is the
+                # first per-job phase so a scraper sees admission latency
+                with obs_trace.RECORDER.tagged(job_id=job.id,
+                                               trace_id=job.id):
+                    ENGINE.record("serve_queue_wait",
+                                  max(0.0, (job.started_at
+                                            or job.submitted_at)
+                                      - job.submitted_at))
+                    with ENGINE.phase("serve_execute"):
+                        self._runner(job, degraded=degraded)
             except JobAbandoned:
                 # the watchdog already finished this job (reap / presumed
                 # death); its chain aborted at the next multiply boundary
@@ -367,16 +406,24 @@ class Daemon:
                 log.info("job %s abandoned mid-chain", job.id)
             except Exception as e:  # noqa: BLE001 -- a job must not kill the loop
                 log.warning("job %s failed: %r", job.id, e)
-                job.finish("failed", error={
-                    "code": protocol.E_JOB_ERROR, "message": repr(e)},
-                    detail=self._job_detail(scope, degraded),
-                    on_commit=lambda: self._journal_append(
-                        {"event": "failed", "id": job.id}))
+                if job.finish("failed", error={
+                        "code": protocol.E_JOB_ERROR, "message": repr(e)},
+                        detail=self._job_detail(scope, degraded),
+                        on_commit=lambda: self._journal_append(
+                            {"event": "failed", "id": job.id})):
+                    self._observe_terminal(job, "error")
             else:
-                job.finish("done", detail=self._job_detail(scope, degraded),
-                           on_commit=lambda: self._journal_append(
-                               {"event": "done", "id": job.id}))
+                if job.finish("done",
+                              detail=self._job_detail(scope, degraded),
+                              on_commit=lambda: self._journal_append(
+                                  {"event": "done", "id": job.id})):
+                    self._observe_terminal(job, "done")
             finally:
+                # detach the per-job collector: a wedged executor that
+                # unwedges hours later closes the OLD job's scope here --
+                # while it was wedged, its accumulation stayed attributed
+                # to that scope, never the replacement executor's job
+                scope.close()
                 # an abandoned (wedged) executor can unwedge long after a
                 # replacement took over: only clear the slot if it is
                 # still ours, never the successor's current job
@@ -404,6 +451,68 @@ class Daemon:
             return None
         return self._job_detail(scope, job.scope_degraded)
 
+    # ------------------------------------------------------ observability --
+    def _observe_terminal(self, job: Job, outcome: str) -> None:
+        """Bookkeeping for a terminal transition THIS daemon committed
+        (call only when Job.finish returned True): daemon-lifetime outcome
+        totals + the job-wall histogram behind `stats` and the Prometheus
+        surface."""
+        snap = job.snapshot()
+        started = snap["started_at"] or snap["submitted_at"]
+        wall = max(0.0, (snap["finished_at"] or time.time()) - started)
+        with self._lock:
+            self._terminal_totals[outcome] = \
+                self._terminal_totals.get(outcome, 0) + 1
+            hist = self._job_wall
+            hist["sum"] += wall
+            hist["count"] += 1
+            for le in hist["buckets"]:
+                if wall <= le:
+                    hist["buckets"][le] += 1
+
+    def _flight_dump(self, name: str) -> str | None:
+        """Snapshot the span flight recorder next to the journal
+        (<socket>.flight/<name>.trace.json, Perfetto trace_event JSON) --
+        the postmortem evidence for a reap/wedge/degrade.  Best-effort:
+        diagnostics must never take down the device owner."""
+        path = os.path.join(self.flight_dir, f"{name}.trace.json")
+        try:
+            obs_trace.dump_json(path)
+        except OSError as e:
+            log.warning("flight dump %s failed: %r", path, e)
+            return None
+        # retention: drop the oldest dumps past FLIGHT_RETAIN so a
+        # perpetually-reaping daemon cannot exhaust the disk the device
+        # owner lives on.  Ordering is this process's write order (mtime
+        # ties within one reap burst on a coarse-mtime filesystem must
+        # never evict the dump just written); leftovers from a previous
+        # daemon run order by mtime, ahead of anything written in this
+        # one.  A prune failure (a cleanup cron racing listdir/unlink) is
+        # its own warning -- the dump above LANDED, and an incident
+        # responder must not be told the evidence is missing.
+        try:
+            with self._lock:
+                if path in self._flight_order:
+                    self._flight_order.remove(path)  # re-dump: now newest
+                self._flight_order.append(path)
+                ours = list(self._flight_order)
+            on_disk = {os.path.join(self.flight_dir, f)
+                       for f in os.listdir(self.flight_dir)
+                       if f.endswith(".trace.json")}
+            ordered = sorted(on_disk - set(ours), key=os.path.getmtime) \
+                + [p for p in ours if p in on_disk]
+            for stale in ordered[:max(0, len(ordered)
+                                      - self.FLIGHT_RETAIN)]:
+                os.unlink(stale)
+                with self._lock:
+                    if stale in self._flight_order:
+                        self._flight_order.remove(stale)
+        except OSError as e:
+            log.warning("flight-dump retention prune failed (dump %s "
+                        "still on disk): %r", path, e)
+        log.info("flight recorder dumped to %s", path)
+        return path
+
     # ----------------------------------------------------------- watchdog --
     def _watchdog_loop(self) -> None:
         """Reap overdue jobs; detect executor death and wedging.
@@ -428,6 +537,8 @@ class Daemon:
                             on_commit=lambda o=orphan: self._journal_append(
                                 {"event": "failed", "id": o.id})):
                         reason += f" during job {orphan.id}"
+                        self._observe_terminal(orphan, "abandoned")
+                        self._flight_dump(orphan.id)
                 self._degrade(reason)
                 continue
             if job is not None and self._reaped is not job and job.overdue():
@@ -442,6 +553,16 @@ class Daemon:
                         on_commit=lambda: self._journal_append(
                             {"event": "failed", "id": job.id})):
                     self._reaped, self._reaped_at = job, time.time()
+                    # the reap's postmortem evidence: a counter on the
+                    # Prometheus surface, an instant marker in the span
+                    # timeline, and the flight dump an operator opens
+                    # first
+                    from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+                    ENGINE.incr("serve_reaps")
+                    obs_trace.RECORDER.instant("serve_reap",
+                                               job_id=job.id)
+                    self._observe_terminal(job, "timeout")
+                    self._flight_dump(job.id)
             reaped = self._reaped
             if reaped is not None and self._current is reaped:
                 hb = reaped.heartbeat_at or 0.0
@@ -453,6 +574,7 @@ class Daemon:
                     self._reaped_at = hb
                 elif time.time() - self._reaped_at > self._wedge_grace_s:
                     self._reaped = None
+                    self._flight_dump(f"{reaped.id}.wedged")
                     self._degrade(f"executor wedged on reaped job "
                                   f"{reaped.id}")
             elif reaped is not None and self._current is not reaped:
@@ -478,6 +600,10 @@ class Daemon:
         if already:
             return
         log.warning("degrading to CPU failover path: %s", reason)
+        from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+        ENGINE.incr("serve_degrades")
+        obs_trace.RECORDER.instant("serve_degrade", job_id=None)
+        self._flight_dump("degrade")
         probe = self._probe
         if probe is None:
             from spgemm_tpu.utils.backend_probe import (  # noqa: PLC0415
@@ -566,6 +692,10 @@ class Daemon:
             return self._op_status(msg, wait=True)
         if op == "stats":
             return self._op_stats()
+        if op == "metrics":
+            return self._op_metrics()
+        if op == "trace":
+            return self._op_trace()
         return self._op_shutdown()
 
     def _op_submit(self, msg: dict) -> dict:
@@ -668,6 +798,18 @@ class Daemon:
             job.wait(timeout)
         return protocol.ok(job=job.snapshot())
 
+    def _journal_stats(self) -> dict:
+        """Journal health for stats/metrics: on-disk size + compactions
+        (a scraper watching bytes vs compactions sees runaway growth)."""
+        try:
+            size = os.path.getsize(self.journal_path)
+        except OSError:
+            size = 0
+        with self._lock:
+            compactions = self._journal_compactions
+        return {"path": self.journal_path, "enabled": self._journal_enabled,
+                "bytes": size, "compactions": compactions}
+
     def _op_stats(self) -> dict:
         from spgemm_tpu.ops import plancache  # noqa: PLC0415
 
@@ -679,6 +821,7 @@ class Daemon:
             degraded = self.degraded
             degrade_reason = self.degrade_reason
             probe_outcome = self._probe_outcome
+            terminal = dict(self._terminal_totals)
         return protocol.ok(
             daemon="spgemmd",
             uptime_s=round(time.time() - self._started_at, 3),
@@ -688,9 +831,58 @@ class Daemon:
             queue_cap=self._cap,
             job_timeout_s=self._job_timeout_s,
             jobs=self.queue.counts(),
+            # daemon-lifetime terminal outcomes: the queue's counts()
+            # histogram is bounded by RETAIN_TERMINAL eviction, so only
+            # these totals distinguish "healthy and idle" from "just
+            # recovered after reaping half the fleet's jobs"
+            jobs_terminal=terminal,
+            journal=self._journal_stats(),
+            trace=obs_trace.RECORDER.stats(),
+            flight_dir=self.flight_dir,
             plan_cache=cache,
             socket=self.socket_path,
         )
+
+    def _op_metrics(self) -> dict:
+        """The scrapeable surface: Prometheus text-format 0.0.4 rendered
+        from the obs/metrics.py registry -- engine phase/counter series,
+        plan-cache and flight-recorder state, plus the daemon's serving
+        gauges.  The future mesh scheduler is born scrapeable."""
+        samples = obs_metrics.collect_engine()
+        with self._lock:
+            degraded = self.degraded
+            terminal = dict(self._terminal_totals)
+            conns = self._conn_count
+            wall = {"buckets": dict(self._job_wall["buckets"]),
+                    "sum": self._job_wall["sum"],
+                    "count": self._job_wall["count"]}
+        counts = self.queue.counts()
+        depth = counts.pop("depth")
+        journal = self._journal_stats()
+        samples += [
+            ("spgemmd_uptime_seconds", {},
+             round(time.time() - self._started_at, 3)),
+            ("spgemmd_degraded", {}, int(degraded)),
+            ("spgemmd_queue_depth", {}, depth),
+            ("spgemmd_connections", {}, conns),
+            ("spgemmd_journal_bytes", {}, journal["bytes"]),
+            ("spgemmd_journal_compactions_total", {},
+             journal["compactions"]),
+            ("spgemmd_job_wall_seconds", {}, wall),
+        ]
+        samples += [("spgemmd_jobs", {"state": state}, n)
+                    for state, n in sorted(counts.items())]
+        samples += [("spgemmd_jobs_terminal_total", {"outcome": outcome}, n)
+                    for outcome, n in sorted(terminal.items())]
+        return protocol.ok(
+            content_type="text/plain; version=0.0.4; charset=utf-8",
+            text=obs_metrics.render(samples))
+
+    def _op_trace(self) -> dict:
+        """The span flight recorder as Perfetto/Chrome trace_event JSON
+        (the same serialization the postmortem auto-dump writes)."""
+        events = obs_trace.to_trace_events()
+        return protocol.ok(spans=len(events), trace_events=events)
 
     def _op_shutdown(self) -> dict:
         self._stop.set()

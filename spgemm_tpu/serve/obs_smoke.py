@@ -1,0 +1,161 @@
+"""`make obs-smoke`: end-to-end observability proof on the CPU backend.
+
+Starts a real spgemmd subprocess on a temp socket, scrapes its Prometheus
+`metrics` surface before and after one real chain job, and asserts the
+observability contract:
+
+  * the scrape is parseable text-format 0.0.4 with HELP/TYPE headers;
+  * the per-phase engine series (`spgemm_phase_seconds_total{phase=...}`)
+    and the plan-cache series MOVE across the submit -- a daemon whose
+    metrics never change is a daemon you cannot operate;
+  * terminal job accounting works (`spgemmd_jobs_terminal_total{
+    outcome="done"}` counts the job);
+  * the `trace` op returns Perfetto/Chrome trace_event JSON whose spans
+    carry the job id, and `spgemm_tpu.cli trace-dump -o F` round-trips it
+    through the real CLI to a valid JSON file;
+  * shutdown is clean.
+
+Any step failing exits nonzero.  This process itself stays jax-free (the
+client and the generator are pure numpy) -- only the daemon touches a
+backend, which is the deployment shape being smoked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _fail(proc: subprocess.Popen | None, msg: str) -> int:
+    print(f"obs-smoke: FAIL: {msg}", file=sys.stderr)
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+    if proc is not None:
+        out, _ = proc.communicate(timeout=10)
+        sys.stderr.write(out[-4000:] if out else "")
+    return 1
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """`{name{labels}: value}` for every sample line (HELP/TYPE skipped);
+    a malformed value line raises -- the smoke's format check."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        series, value = line.rsplit(" ", 1)
+        out[series] = float(value)
+    return out
+
+
+def main() -> int:
+    import numpy as np  # noqa: PLC0415
+
+    from spgemm_tpu.serve import client  # noqa: PLC0415
+    from spgemm_tpu.utils import io_text  # noqa: PLC0415
+    from spgemm_tpu.utils.gen import random_chain  # noqa: PLC0415
+
+    tmp = tempfile.mkdtemp(prefix="spgemmd-obs-smoke-")
+    sock = os.path.join(tmp, "d.sock")
+    folder = os.path.join(tmp, "chain_in")
+    n, k = 4, 4
+    mats = random_chain(n, 6, k, 0.5, np.random.default_rng(7), "full")
+    io_text.write_chain_dir(folder, mats, k)
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+         "--socket", sock, "--device", "cpu", "-v"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(sock):
+            if proc.poll() is not None:
+                return _fail(proc, "daemon exited before binding its socket")
+            if time.time() > deadline:
+                return _fail(proc, "daemon never bound its socket")
+            time.sleep(0.1)
+
+        text0 = client.metrics(sock)
+        before = parse_prometheus(text0)
+        if "spgemmd_uptime_seconds" not in before:
+            return _fail(proc, "first scrape lacks the daemon gauges")
+
+        out = os.path.join(tmp, "matrix.1")
+        resp = client.submit(folder, sock, {"output": out})
+        job_id = resp["id"]
+        resp = client.wait(job_id, sock, timeout=300)
+        if resp["job"]["state"] != "done":
+            return _fail(proc, f"job ended {resp['job']['state']}: "
+                               f"{resp['job']['error']}")
+
+        text1 = client.metrics(sock)
+        if "# TYPE spgemm_phase_seconds_total counter" not in text1:
+            return _fail(proc, "post-job scrape lacks the TYPE header for "
+                               "the phase series")
+        after = parse_prometheus(text1)
+        plan_series = 'spgemm_phase_seconds_total{phase="plan"}'
+        if after.get(plan_series, 0.0) <= before.get(plan_series, 0.0):
+            return _fail(proc, f"{plan_series} did not move across the "
+                               "submit")
+        cache_moved = (
+            after.get("spgemm_plan_cache_misses_total", 0)
+            + after.get("spgemm_plan_cache_hits_total", 0)
+            > before.get("spgemm_plan_cache_misses_total", 0)
+            + before.get("spgemm_plan_cache_hits_total", 0))
+        if not cache_moved:
+            return _fail(proc, "plan-cache series did not move across "
+                               "the submit")
+        if after.get('spgemmd_jobs_terminal_total{outcome="done"}') != 1.0:
+            return _fail(proc, "terminal-outcome counter did not count "
+                               "the done job")
+        if after.get("spgemm_trace_spans_emitted_total", 0) <= 0:
+            return _fail(proc, "flight recorder emitted no spans")
+
+        events = client.trace(sock)
+        if not events or not isinstance(events, list):
+            return _fail(proc, "trace op returned no events")
+        for ev in events:
+            need = {"name", "ph", "pid", "tid"}
+            if ev.get("ph") != "M":  # metadata events carry no timestamp
+                need = need | {"ts"}
+            if not (need <= set(ev)):
+                return _fail(proc, f"malformed trace event: {ev}")
+        tagged = [ev for ev in events
+                  if ev.get("args", {}).get("job_id") == job_id]
+        if not tagged:
+            return _fail(proc, f"no span carries job_id={job_id}")
+
+        dump = os.path.join(tmp, "flight.trace.json")
+        rc = subprocess.run(
+            [sys.executable, "-m", "spgemm_tpu.cli", "trace-dump",
+             "--socket", sock, "-o", dump],
+            capture_output=True, text=True, timeout=60)
+        if rc.returncode != 0:
+            return _fail(proc, f"cli trace-dump failed: {rc.stderr[-500:]}")
+        with open(dump, encoding="utf-8") as f:
+            dumped = json.load(f)
+        if not isinstance(dumped, list) or not dumped:
+            return _fail(proc, "cli trace-dump wrote no trace_event array")
+
+        client.shutdown(sock)
+        try:
+            rcode = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            return _fail(proc, "daemon did not exit after shutdown")
+        if rcode != 0:
+            return _fail(proc, f"daemon exited {rcode} after shutdown")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    print(f"obs-smoke: OK (phase+plan-cache series moved, {len(events)} "
+          f"trace events, {len(tagged)} tagged {job_id}, clean shutdown)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,7 +20,17 @@ Ops:
                                        never pins a connection slot --
                                        client.wait() polls in slices)
   stats    {}                       -> daemon-wide counters, degraded flag,
-                                       plan-cache stats
+                                       plan-cache stats, journal size/
+                                       compactions, per-outcome terminal
+                                       job totals
+  metrics  {}                       -> {text: <Prometheus text-format
+                                       0.0.4>, content_type} -- the
+                                       scrapeable surface (obs/metrics.py
+                                       registry; `spgemm_tpu.cli metrics`)
+  trace    {}                       -> {trace_events: [...]} -- the span
+                                       flight recorder as Perfetto/Chrome
+                                       trace_event JSON (obs/trace.py;
+                                       `spgemm_tpu.cli trace-dump`)
   shutdown {}                       -> {stopping: true}
 
 jax-free by design: the client must be importable (and the protocol
@@ -37,7 +47,7 @@ from spgemm_tpu.utils import knobs
 
 PROTOCOL_VERSION = 1
 
-OPS = ("submit", "status", "wait", "stats", "shutdown")
+OPS = ("submit", "status", "wait", "stats", "metrics", "trace", "shutdown")
 
 # server-side bound on one request line: a peer streaming newline-free
 # bytes must exhaust THIS, not the daemon's memory (real requests are a

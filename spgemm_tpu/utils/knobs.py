@@ -148,6 +148,17 @@ _KNOBS = (
          "must exceed the longest single multiply expected on the "
          "deployment, or a merely-slow job degrades a healthy daemon.",
          "serve/daemon.py", default="60", minimum=0),
+    Knob("SPGEMM_TPU_OBS_TRACE", "bool01",
+         "Span flight recorder: 1 = every engine phase enter/exit emits a "
+         "span into the bounded in-process ring (obs/trace.py), 0 = no "
+         "span recording (timers still accumulate; the whole-engine A/B "
+         "pair for proving the recorder's overhead).",
+         "obs/trace.py", default="1"),
+    Knob("SPGEMM_TPU_OBS_RING_CAP", "int",
+         "Flight-recorder capacity in spans: the ring keeps the newest N "
+         "spans and evicts the oldest (dropped spans are counted, never "
+         "an unbounded buffer in a resident daemon).",
+         "obs/trace.py", default="4096", minimum=1),
     Knob("SPGEMM_TPU_PROBE_TIMEOUT", "float",
          "Backend liveness probe subprocess timeout, seconds (a dead TPU "
          "HANGS, never raises -- the probe is the only safe touch).",

@@ -5,6 +5,11 @@ The reference wraps every phase in chrono spans with the prints commented out
 "time taken X seconds" (:679).  Here phases are named context managers
 accumulated in a registry, reported as structured lines, with optional
 jax.profiler traces; the CLI keeps the final `time taken` line for parity.
+
+Every phase enter/exit additionally emits a SPAN into the process-wide
+flight recorder (spgemm_tpu/obs/trace.py: bounded ring, job/trace tags,
+parenting, Perfetto export; `SPGEMM_TPU_OBS_TRACE=0` disables emission) --
+the accumulation below is the metrics surface, the spans are the timeline.
 """
 
 from __future__ import annotations
@@ -13,6 +18,8 @@ import contextlib
 import logging
 import threading
 import time
+
+from spgemm_tpu.obs import trace
 
 log = logging.getLogger("spgemm_tpu.timers")
 
@@ -29,39 +36,60 @@ class PhaseTimers:
     and the plan-cache counters run on the worker under plan-ahead and on
     the main thread under SPGEMM_TPU_PLAN_AHEAD=0, and a failover retry
     can interleave the two within one process) -- a read-modify-write on
-    a shared name must never lose an update."""
+    a shared name must never lose an update.
+
+    Per-job attribution: scope() opens a PhaseScope bound to the CALLING
+    THREAD -- accumulation lands in a scope only when the accumulating
+    thread carries it, so two concurrent scopes (a watchdog-reaped job's
+    wedged executor + the replacement executor's next job) can never
+    double-count each other's overlap.  Worker threads doing a job's work
+    adopt its scopes via attribution()/attributed()."""
 
     def __init__(self):
         self.totals: dict[str, float] = {}    # spgemm-lint: guarded-by(_lock)
         self.counts: dict[str, int] = {}      # spgemm-lint: guarded-by(_lock)
         self.counters: dict[str, int] = {}    # spgemm-lint: guarded-by(_lock)
+        # thread ident -> PhaseScopes that thread's accumulation feeds
+        self._sinks: dict[int, list] = {}     # spgemm-lint: guarded-by(_lock)
         self._lock = threading.Lock()
+
+    def _add_phase_locked(self, name: str, dt: float) -> None:
+        self.totals[name] = self.totals.get(name, 0.0) + dt
+        self.counts[name] = self.counts.get(name, 0) + 1
+        for sink in self._sinks.get(threading.get_ident(), ()):
+            sink._add_phase_locked(name, dt)
+
+    def _add_counter_locked(self, name: str, n: int) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+        for sink in self._sinks.get(threading.get_ident(), ()):
+            sink._add_counter_locked(name, n)
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        token = trace.RECORDER.begin(name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
+            trace.RECORDER.end(token)
             with self._lock:
-                self.totals[name] = self.totals.get(name, 0.0) + dt
-                self.counts[name] = self.counts.get(name, 0) + 1
+                self._add_phase_locked(name, dt)
 
     def record(self, name: str, seconds: float):
         """Accumulate an externally measured duration under a phase name --
         for spans whose endpoints the caller must place itself (e.g. the ring
         layer's one-hop wire probe, timed around its own completion barrier
         rather than a `with` block)."""
+        trace.RECORDER.point(name, seconds)
         with self._lock:
-            self.totals[name] = self.totals.get(name, 0.0) + seconds
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self._add_phase_locked(name, seconds)
 
     def incr(self, name: str, n: int = 1):
         """Bump a named event counter (e.g. 'dispatches' per numeric
         launch); safe from any thread."""
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + n
+            self._add_counter_locked(name, n)
 
     def log_report(self):
         with self._lock:
@@ -73,6 +101,8 @@ class PhaseTimers:
             log.info("counter %s: %d", name, n)
 
     def reset(self):
+        """Zero the process-wide accumulation (bench iterations).  Open
+        scopes are untouched: they hold their own deltas."""
         with self._lock:
             self.totals.clear()
             self.counts.clear()
@@ -83,64 +113,133 @@ class PhaseTimers:
         with self._lock:
             return {name: round(t, 4) for name, t in self.totals.items()}
 
+    def count_snapshot(self) -> dict[str, int]:
+        """Per-phase entry counts, next to snapshot() (metrics surface)."""
+        with self._lock:
+            return dict(self.counts)
+
     def counter_snapshot(self) -> dict[str, int]:
         """Event counters, for embedding next to snapshot() in bench output."""
         with self._lock:
             return dict(self.counters)
 
     def scope(self) -> "PhaseScope":
-        """A diff view anchored at the current accumulation state.
+        """A per-job collector bound to the calling thread.
 
         The registry accumulates process-wide (bench.py resets it between
         iterations, but a resident daemon must NOT reset -- concurrent
         readers and the `cli knobs` listing see the same registry), so a
-        per-job report needs a baseline-and-diff: everything accumulated
-        AFTER scope() was called, nothing before.  Used by serve/daemon.py
-        so job 2's status never includes job 1's phases."""
+        per-job report needs attribution: everything accumulated by the
+        opening thread (and any worker that adopted the scope via
+        attributed()) while the scope is open, nothing else.  Used by
+        serve/daemon.py so job 2's detail never includes job 1's phases --
+        even when job 1's wedged executor is still accumulating
+        concurrently.  close() (or the context manager) detaches it."""
         return PhaseScope(self)
+
+    def attribution(self):
+        """Opaque token capturing the calling thread's attribution: its
+        active scopes plus its flight-recorder tags.  Hand it to a worker
+        thread doing this thread's work (chain plan-ahead planner, OOC
+        staging/landing) and wrap the worker body in attributed(token) so
+        per-job scopes and span tags follow the work, not the thread."""
+        with self._lock:
+            sinks = tuple(self._sinks.get(threading.get_ident(), ()))
+        return (sinks, trace.RECORDER.current_tags())
+
+    @contextlib.contextmanager
+    def attributed(self, token):
+        """Adopt an attribution() token on the current (worker) thread for
+        the duration of the block."""
+        sinks, tags = token
+        ident = threading.get_ident()
+        with self._lock:
+            lst = self._sinks.setdefault(ident, [])
+            lst.extend(sinks)
+        try:
+            with trace.RECORDER.tagged(**tags):
+                yield
+        finally:
+            with self._lock:
+                lst = self._sinks.get(ident)
+                if lst is not None:
+                    for sink in sinks:
+                        if sink in lst:
+                            lst.remove(sink)
+                    if not lst:
+                        self._sinks.pop(ident, None)
 
 
 class PhaseScope:
-    """Snapshot/diff view over a PhaseTimers (see PhaseTimers.scope):
-    snapshot()/counter_snapshot() return only what accumulated since the
-    scope was opened, with untouched names dropped."""
+    """Per-job accumulation collector over a PhaseTimers (see
+    PhaseTimers.scope): snapshot()/counter_snapshot() return exactly what
+    the attributed threads accumulated while the scope was open.
+
+    The pre-PR-7 implementation was a baseline-and-diff over the global
+    totals, which double-counted whenever two scopes were open
+    concurrently (a reaped job's wedged executor unwedging while the
+    replacement executor runs the next job: both diffs saw both jobs'
+    accumulation).  Scopes are now explicit sinks: accumulation lands in
+    a scope only from threads carrying it, so concurrent scopes are
+    disjoint by construction (pinned by a threaded regression test in
+    tests/test_serve.py)."""
 
     def __init__(self, timers: PhaseTimers):
         self._timers = timers
-        with timers._lock:
-            self._totals0 = dict(timers.totals)
-            self._counters0 = dict(timers.counters)
+        self._lock = timers._lock  # one lock: scopes are timers state
+        self.totals: dict[str, float] = {}   # spgemm-lint: guarded-by(_lock)
+        self.counts: dict[str, int] = {}     # spgemm-lint: guarded-by(_lock)
+        self.counters: dict[str, int] = {}   # spgemm-lint: guarded-by(_lock)
+        ident = threading.get_ident()
+        with self._lock:
+            timers._sinks.setdefault(ident, []).append(self)
+
+    def _add_phase_locked(self, name: str, dt: float) -> None:
+        self.totals[name] = self.totals.get(name, 0.0) + dt
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    def _add_counter_locked(self, name: str, n: int) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def close(self) -> None:
+        """Detach from every thread; the collected deltas stay readable.
+        Idempotent -- a wedged executor that unwedges hours later closes a
+        scope the daemon already reported from."""
+        with self._lock:
+            sinks = self._timers._sinks
+            for ident in list(sinks):
+                lst = sinks[ident]
+                while self in lst:
+                    lst.remove(self)
+                if not lst:
+                    sinks.pop(ident, None)
+
+    def __enter__(self) -> "PhaseScope":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def snapshot(self) -> dict[str, float]:
-        """Per-phase seconds accumulated since the scope opened (rounded,
-        zero-delta names dropped)."""
-        with self._timers._lock:
-            now = dict(self._timers.totals)
-        out = {}
-        for name, total in now.items():
-            delta = total - self._totals0.get(name, 0.0)
-            if delta > 0.0:
-                out[name] = round(delta, 4)
-        return out
+        """Per-phase seconds attributed to this scope (rounded)."""
+        with self._lock:
+            return {name: round(t, 4) for name, t in self.totals.items()}
 
     def counter_snapshot(self) -> dict[str, int]:
-        """Event-counter deltas since the scope opened (zero deltas
-        dropped)."""
-        with self._timers._lock:
-            now = dict(self._timers.counters)
-        out = {}
-        for name, n in now.items():
-            delta = n - self._counters0.get(name, 0)
-            if delta:
-                out[name] = delta
-        return out
+        """Event-counter deltas attributed to this scope."""
+        with self._lock:
+            return dict(self.counters)
 
 
 # Global registry for the SpGEMM engine's internal phases (symbolic join /
 # round planning / numeric dispatch / assembly) -- the analog of the
 # reference's per-phase chrono spans inside helper() (sparse_matrix_mult.cu:
 # 160-274, report.pdf Table 2).  The engine accumulates here on every
-# multiply; the CLI (--profile) and bench.py reset + report it.
+# multiply; the CLI (--profile) and bench.py reset + report it.  Phase and
+# counter NAMES are declared in obs/metrics.py (ENGINE_PHASES /
+# ENGINE_COUNTERS) -- the MET lint rule rejects undeclared names at call
+# sites, so the Prometheus surface and the flight recorder can never grow
+# ad-hoc series.
 ENGINE = PhaseTimers()
 
 

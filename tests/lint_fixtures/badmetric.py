@@ -1,0 +1,36 @@
+"""MET fixture: ENGINE metric-name registry discipline.
+
+Seeded violations: an undeclared phase name, an undeclared counter name,
+and a computed (non-literal) name.  Legal shapes alongside: declared
+names, and an ad-hoc PhaseTimers instance (not the ENGINE registry, so
+out of MET scope by design).
+"""
+
+from spgemm_tpu.utils.timers import ENGINE as timers
+
+
+def bad_phase(x):
+    with timers.phase("made_up_phase"):  # MET: undeclared phase name
+        return x
+
+
+def bad_counter():
+    timers.incr("made_up_counter")  # MET: undeclared counter name
+
+
+def bad_dynamic(name):
+    timers.record(name, 0.5)  # MET: computed metric name
+
+
+def legal_declared(x):
+    with timers.phase("plan"):  # legal: declared phase
+        timers.incr("dispatches")  # legal: declared counter
+        return x
+
+
+def legal_local_instance():
+    from spgemm_tpu.utils.timers import PhaseTimers
+
+    t = PhaseTimers()
+    with t.phase("driver-local"):  # legal: not the ENGINE registry
+        pass

@@ -170,6 +170,63 @@ def test_host_only_marker_on_planner_entrypoints():
     assert getattr(_PlanAheadWorker._work, "__spgemm_host_only__", False)
 
 
+# ------------------------------------------------------------- MET rule --
+def test_met_fixture_each_violation_caught():
+    """Undeclared phase/counter names and a computed name are findings;
+    declared names and ad-hoc PhaseTimers instances stay legal."""
+    findings = lint_file(os.path.join(FIXTURES, "badmetric.py"))
+    met = [f for f in findings if f.rule == "MET"]
+    assert len(met) == 3 and findings == met
+    flagged = [f.line for f in met]
+    for needle in ("MET: undeclared phase name",
+                   "MET: undeclared counter name",
+                   "MET: computed metric name"):
+        assert _fixture_lines("badmetric.py", needle)[0] in flagged
+    msgs = " ".join(f.message for f in met)
+    assert "made_up_phase" in msgs and "made_up_counter" in msgs
+    assert "ENGINE_PHASES" in msgs and "ENGINE_COUNTERS" in msgs
+    for needle in ("legal: declared phase", "legal: declared counter",
+                   "legal: not the ENGINE registry"):
+        assert _fixture_lines("badmetric.py", needle)[0] not in flagged
+
+
+def test_met_alias_spellings_resolve(tmp_path):
+    """Both repo spellings -- `from ...timers import ENGINE` and the
+    `import ... as t` + `t.ENGINE` form -- resolve to the registry, and
+    the keyword spelling `name=` is in scope too (both mint the
+    series)."""
+    p = tmp_path / "h.py"
+    p.write_text("from spgemm_tpu.utils.timers import ENGINE\n"
+                 "import spgemm_tpu.utils.timers as t\n"
+                 "from spgemm_tpu.utils import timers\n"
+                 "def f(i):\n"
+                 "    ENGINE.incr('nope_a')\n"
+                 "    t.ENGINE.incr('nope_b')\n"
+                 "    timers.ENGINE.incr('nope_c')\n"
+                 "    ENGINE.incr(name='nope_kw')\n"
+                 "    ENGINE.incr(name=f'dyn_{i}')\n"
+                 "    ENGINE.incr('dispatches')\n"
+                 "    ENGINE.incr(name='dispatches')\n")
+    findings = lint_file(str(p))
+    assert [f.rule for f in findings] == ["MET"] * 5
+    assert [f.line for f in findings] == [5, 6, 7, 8, 9]
+
+
+def test_met_registry_covers_live_call_sites():
+    """Every ENGINE phase/counter name the package actually uses is
+    declared (the repo self-lint enforces this; spot-check the registry
+    side so a deleted declaration cannot slip through unnoticed)."""
+    from spgemm_tpu.obs.metrics import ENGINE_COUNTERS, ENGINE_PHASES
+
+    for name in ("plan", "plan_wait", "numeric_dispatch", "assembly",
+                 "ring_fold", "dcn_exchange", "serve_execute",
+                 "serve_queue_wait"):
+        assert name in ENGINE_PHASES
+    for name in ("dispatches", "plan_cache_hits", "plan_cache_misses",
+                 "ring_steps", "serve_reaps", "serve_degrades"):
+        assert name in ENGINE_COUNTERS
+
+
 # ------------------------------------------------------------- DOC rule --
 def test_doc_fixture_drift_caught():
     findings = check_claude_md(FIXTURE_CLAUDE)
@@ -192,6 +249,38 @@ def test_doc_current_table_passes_and_tamper_fails(tmp_path):
 
 def test_doc_cli_help_covers_every_knob():
     assert docrules.check_cli_help() == []
+
+
+def test_doc_metrics_table_current_and_tamper_fails(tmp_path):
+    """The ARCHITECTURE.md metrics table is held to the obs/metrics.py
+    registry exactly like the knob table is to knobs.py."""
+    good = tmp_path / "ARCHITECTURE.md"
+    good.write_text("# arch\n\n" + docrules.render_metrics_block() + "\n")
+    assert docrules.check_architecture_md(str(good)) == []
+    tampered = good.read_text().replace("spgemm_phase_seconds_total",
+                                        "spgemm_gone_total")
+    good.write_text(tampered)
+    findings = docrules.check_architecture_md(str(good))
+    assert [f.rule for f in findings] == ["DOC"]
+    assert "drifted" in findings[0].message
+    good.write_text("# no markers at all\n")
+    findings = docrules.check_architecture_md(str(good))
+    assert [f.rule for f in findings] == ["DOC"]
+    assert "markers missing" in findings[0].message
+
+
+def test_write_metrics_table_regenerates(tmp_path):
+    """`--write-metrics-table` rewrites the marked block in place, after
+    which the DOC check passes."""
+    arch = tmp_path / "ARCHITECTURE.md"
+    arch.write_text("# doc\n" + docrules.METRICS_TABLE_BEGIN + "\nstale\n"
+                    + docrules.METRICS_TABLE_END + "\ntail\n")
+    rc = _run(["-m", "spgemm_tpu.analysis", "--write-metrics-table",
+               "--architecture-md", str(arch)])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert docrules.check_architecture_md(str(arch)) == []
+    assert arch.read_text().startswith("# doc\n")
+    assert arch.read_text().endswith("\ntail\n")
 
 
 # ----------------------------------------------------------- PARSE rule --
@@ -418,9 +507,11 @@ def test_json_report_fixture_run():
     # badknob: 3 classic + 2 planner-knob + 4 serve-knob reads;
     # badbackend: 3 import-time touches; badplanner: 2 @host_only-body
     # touches; FLD: 5 per-module + 2 interprocedural (callchain);
-    # badthread/badexcept/stalesup: 3 each
+    # badthread/badexcept/stalesup: 3 each; badmetric: undeclared phase +
+    # undeclared counter + computed name
     assert report["counts"] == {"FLD": 7, "KNB": 9, "BKD": 5, "THR": 3,
-                                "EXC": 3, "DOC": 1, "SUP": 3, "PARSE": 0}
+                                "EXC": 3, "MET": 3, "DOC": 1, "SUP": 3,
+                                "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:
         assert set(f) == {"file", "line", "rule", "message"}

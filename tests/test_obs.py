@@ -1,0 +1,245 @@
+"""L5 observability (spgemm_tpu/obs/): flight-recorder ring bounds, span
+nesting/tags, the SPGEMM_TPU_OBS_TRACE kill switch, Prometheus text-format
+0.0.4 contract (escaping included), Perfetto trace_event export, and the
+jax-free-import guarantee (subprocess-pinned, mirroring the linter's)."""
+
+import json
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from spgemm_tpu.obs import metrics, trace
+from spgemm_tpu.utils.timers import PhaseTimers
+
+REPO = __import__("os").path.dirname(__import__("os").path.dirname(
+    __import__("os").path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_recorder():
+    trace.RECORDER.clear()
+    yield
+    trace.RECORDER.clear()
+
+
+# --------------------------------------------------------- ring recorder --
+def test_ring_is_bounded_and_counts_drops(monkeypatch):
+    """The flight recorder must never grow unbounded in a resident
+    daemon: past the cap the OLDEST spans are evicted, and the eviction
+    is counted (silent loss would read as 'nothing happened')."""
+    monkeypatch.setenv("SPGEMM_TPU_OBS_RING_CAP", "8")
+    t = PhaseTimers()
+    for i in range(50):
+        t.record("plan", 0.001 * (i + 1))
+    st = trace.RECORDER.stats()
+    assert st["spans"] == 8 and st["capacity"] == 8
+    assert st["emitted"] == 50 and st["dropped"] == 42
+    spans = trace.RECORDER.snapshot()
+    assert len(spans) == 8
+    # newest retained: the last 8 record() durations
+    assert [s["dur"] for s in spans] == \
+        [pytest.approx(1e6 * 0.001 * (i + 1), rel=1e-6) for i in range(42, 50)]
+
+
+def test_obs_trace_zero_disables_emission(monkeypatch):
+    """The overhead A/B knob: with SPGEMM_TPU_OBS_TRACE=0 no span is
+    emitted, while the timers keep accumulating (metrics survive)."""
+    monkeypatch.setenv("SPGEMM_TPU_OBS_TRACE", "0")
+    t = PhaseTimers()
+    with t.phase("plan"):
+        pass
+    t.record("assembly", 0.5)
+    t.incr("dispatches")
+    assert trace.RECORDER.stats()["spans"] == 0
+    assert trace.RECORDER.stats()["enabled"] is False
+    assert t.snapshot()["assembly"] == 0.5
+    assert t.counter_snapshot()["dispatches"] == 1
+
+
+def test_span_nesting_parent_and_tags():
+    """Parenting is lexical per thread; tags active on the emitting
+    thread ride on every span."""
+    t = PhaseTimers()
+    with trace.RECORDER.tagged(job_id="job-9", trace_id="tr-1"):
+        with t.phase("plan"):
+            with t.phase("symbolic_join"):
+                pass
+    spans = {s["name"]: s for s in trace.RECORDER.snapshot()}
+    plan, join = spans["plan"], spans["symbolic_join"]
+    assert join["parent"] == plan["id"]
+    assert plan["parent"] is None
+    for s in (plan, join):
+        assert s["tags"] == {"job_id": "job-9", "trace_id": "tr-1"}
+        assert s["dur"] >= 0 and s["ph"] == "X"
+    # child committed first but the parent link still resolves: ids are
+    # assigned at OPEN time
+    assert join["id"] > plan["id"]
+
+
+def test_tags_nest_and_restore():
+    with trace.RECORDER.tagged(job_id="a"):
+        with trace.RECORDER.tagged(trace_id="b"):
+            assert trace.RECORDER.current_tags() == {"job_id": "a",
+                                                     "trace_id": "b"}
+        assert trace.RECORDER.current_tags() == {"job_id": "a"}
+    assert trace.RECORDER.current_tags() == {}
+
+
+def test_instant_markers():
+    trace.RECORDER.instant("serve_degrade", job_id="job-3")
+    (s,) = trace.RECORDER.snapshot()
+    assert s["ph"] == "i" and s["tags"]["job_id"] == "job-3"
+
+
+# ------------------------------------------------------- Perfetto export --
+def test_trace_events_are_valid_perfetto_json(tmp_path):
+    """The export loads as a JSON array of trace_event objects: complete
+    events carry ts+dur, thread metadata names every tid, args carry the
+    span tags."""
+    t = PhaseTimers()
+    with trace.RECORDER.tagged(job_id="job-7"):
+        with t.phase("numeric_dispatch"):
+            pass
+    path = trace.dump_json(str(tmp_path / "flight" / "x.trace.json"))
+    events = json.loads(open(path, encoding="utf-8").read())
+    assert isinstance(events, list) and events
+    phs = {ev["ph"] for ev in events}
+    assert phs <= {"X", "M", "i"}
+    complete = [ev for ev in events if ev["ph"] == "X"]
+    assert complete
+    for ev in complete:
+        assert {"name", "cat", "ts", "dur", "pid", "tid", "args"} <= set(ev)
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+    assert any(ev["ph"] == "M" and ev["name"] == "thread_name"
+               for ev in events)
+    dispatch = next(ev for ev in complete
+                    if ev["name"] == "numeric_dispatch")
+    assert dispatch["args"]["job_id"] == "job-7"
+
+
+# ---------------------------------------------------- prometheus contract --
+def test_render_escapes_help_and_label_values():
+    text = metrics.render([
+        ("spgemmd_jobs", {"state": 'we"ird\\st\nate'}, 3),
+    ])
+    # label escaping: backslash, quote, newline (format 0.0.4)
+    assert 'state="we\\"ird\\\\st\\nate"' in text
+    assert text.endswith("\n")
+    # HELP text never carries a raw newline
+    for line in text.splitlines():
+        assert not line.startswith("# HELP") or "\n" not in line[7:]
+
+
+def test_render_headers_types_and_ordering():
+    text = metrics.render([
+        ("spgemm_phase_seconds_total", {"phase": "plan"}, 1.5),
+        ("spgemm_phase_seconds_total", {"phase": "assembly"}, 0.25),
+        ("spgemmd_degraded", {}, 0),
+    ])
+    lines = text.splitlines()
+    assert "# TYPE spgemm_phase_seconds_total counter" in lines
+    assert "# TYPE spgemmd_degraded gauge" in lines
+    assert 'spgemm_phase_seconds_total{phase="assembly"} 0.25' in lines
+    assert 'spgemm_phase_seconds_total{phase="plan"} 1.5' in lines
+    assert "spgemmd_degraded 0" in lines
+    # one HELP/TYPE pair per family, immediately before its samples
+    assert lines.index("# TYPE spgemm_phase_seconds_total counter") \
+        == lines.index("# HELP spgemm_phase_seconds_total "
+                       + metrics.escape_help(
+                           metrics.REGISTRY[
+                               "spgemm_phase_seconds_total"].doc)) + 1
+
+
+def test_render_histogram_shape():
+    text = metrics.render([
+        ("spgemmd_job_wall_seconds", {},
+         {"buckets": {0.1: 1, 1.0: 2, 10.0: 2, 60.0: 2, 600.0: 2,
+                      3600.0: 2},
+          "sum": 1.25, "count": 2}),
+    ])
+    lines = text.splitlines()
+    assert "# TYPE spgemmd_job_wall_seconds histogram" in lines
+    assert 'spgemmd_job_wall_seconds_bucket{le="0.1"} 1' in lines
+    assert 'spgemmd_job_wall_seconds_bucket{le="+Inf"} 2' in lines
+    assert "spgemmd_job_wall_seconds_sum 1.25" in lines
+    assert "spgemmd_job_wall_seconds_count 2" in lines
+
+
+def test_render_rejects_undeclared_family_and_wrong_labels():
+    """The runtime half of the registry contract: an ad-hoc family name
+    (or a label set that does not match the declaration) cannot ship."""
+    with pytest.raises(ValueError, match="undeclared metric"):
+        metrics.render([("spgemm_adhoc_total", {}, 1)])
+    with pytest.raises(ValueError, match="labels"):
+        metrics.render([("spgemmd_degraded", {"oops": "x"}, 1)])
+
+
+def test_collect_engine_round_trips_through_render():
+    t_names = ("plan", "numeric_dispatch")
+    from spgemm_tpu.utils.timers import ENGINE
+
+    for name in t_names:
+        ENGINE.record(name, 0.125)
+    ENGINE.incr("dispatches", 2)
+    text = metrics.render(metrics.collect_engine())
+    for name in t_names:
+        assert f'spgemm_phase_seconds_total{{phase="{name}"}}' in text
+    assert 'spgemm_engine_events_total{event="dispatches"}' in text
+    assert "spgemm_trace_spans_emitted_total" in text
+
+
+def test_metrics_table_covers_registry():
+    table = metrics.metrics_table_md()
+    for name in metrics.REGISTRY:
+        assert f"`{name}`" in table
+    for name in list(metrics.ENGINE_PHASES) + list(metrics.ENGINE_COUNTERS):
+        assert f"`{name}`" in table
+
+
+# --------------------------------------------------------- jax-free pins --
+def test_obs_import_and_use_is_jax_free():
+    """The scrape/dump path runs on client processes and watchdog threads
+    that must never hang on a backend: importing + exercising the whole
+    obs surface (spans, render, trace export) pulls no jax/jaxlib."""
+    code = (
+        "import sys\n"
+        "from spgemm_tpu.obs import metrics, trace\n"
+        "from spgemm_tpu.utils.timers import ENGINE\n"
+        "with ENGINE.phase('plan'):\n"
+        "    ENGINE.incr('dispatches')\n"
+        "metrics.render(metrics.collect_engine())\n"
+        "trace.to_trace_events()\n"
+        "bad = [m for m in sys.modules\n"
+        "       if m == 'jax' or m.startswith(('jax.', 'jaxlib'))]\n"
+        "assert not bad, f'obs pulled in jax: {bad}'\n")
+    rc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                        capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+
+
+# ------------------------------------------------- attribution threading --
+def test_attribution_token_carries_scope_and_tags_to_worker():
+    """The worker-thread contract (chain plan-ahead, OOC staging): a
+    thread that adopts attribution() lands its accumulation in the
+    spawning job's scope and its spans under the job's tags."""
+    t = PhaseTimers()
+    with trace.RECORDER.tagged(job_id="job-42"):
+        scope = t.scope()
+        token = t.attribution()
+
+        def worker():
+            with t.attributed(token):
+                t.record("stage_prep", 0.5)
+                t.incr("dispatches", 3)
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+        scope.close()
+    assert scope.snapshot() == {"stage_prep": 0.5}
+    assert scope.counter_snapshot() == {"dispatches": 3}
+    span = next(s for s in trace.RECORDER.snapshot()
+                if s["name"] == "stage_prep")
+    assert span["tags"]["job_id"] == "job-42"

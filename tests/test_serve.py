@@ -55,7 +55,12 @@ def _raw_roundtrip(sock_path, payload: bytes) -> dict:
     with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
         s.settimeout(10.0)
         s.connect(sock_path)
-        s.sendall(payload)
+        try:
+            s.sendall(payload)
+        except BrokenPipeError:
+            # the daemon may answer-and-close (busy reply, oversized-line
+            # drop) before our bytes land; the response is still readable
+            pass
         for line in protocol.read_lines(s):
             return json.loads(line)
     raise AssertionError("no response line")
@@ -734,3 +739,237 @@ def test_stats_degrade_snapshot_holds_the_daemon_lock(tmp_path):
     t.join(timeout=5.0)
     assert got and got[0]["ok"] is True
     assert got[0]["degraded"] is False and got[0]["degrade_reason"] is None
+
+
+# --------------------------------------------------- L5 observability --
+def test_concurrent_phase_scopes_are_disjoint():
+    """The PR-7 PhaseScope fix, pinned with real threads: two scopes open
+    CONCURRENTLY over one PhaseTimers (the watchdog-reaped job's wedged
+    executor + the replacement executor's next job) must each see exactly
+    their own thread's accumulation -- the old baseline-and-diff
+    implementation reported both threads' overlap into both scopes."""
+    t = PhaseTimers()
+    start = threading.Barrier(2)
+    scopes = {}
+
+    def job(name, seconds, n):
+        scope = t.scope()          # opened on THIS thread
+        scopes[name] = scope
+        start.wait(timeout=10)     # maximize overlap
+        for _ in range(5):
+            t.record(name, seconds)
+            t.incr("dispatches", n)
+        scope.close()
+
+    threads = [threading.Thread(target=job, args=("ring_fold", 0.25, 1)),
+               threading.Thread(target=job, args=("assembly", 0.5, 10))]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert scopes["ring_fold"].snapshot() == {"ring_fold": 1.25}
+    assert scopes["ring_fold"].counter_snapshot() == {"dispatches": 5}
+    assert scopes["assembly"].snapshot() == {"assembly": 2.5}
+    assert scopes["assembly"].counter_snapshot() == {"dispatches": 50}
+    # the process-wide registry still saw everything
+    assert t.counter_snapshot()["dispatches"] == 55
+
+
+def test_closed_scope_stops_collecting():
+    t = PhaseTimers()
+    with t.scope() as s:
+        t.record("plan", 1.0)
+    t.record("plan", 9.0)  # after close: not this scope's
+    assert s.snapshot() == {"plan": 1.0}
+
+
+def test_metrics_op_serves_prometheus_and_series_move(tmp_path,
+                                                     make_daemon):
+    """The scrapeable surface: text-format 0.0.4 with daemon gauges, and
+    the per-phase + terminal-outcome series move across a job."""
+    from spgemm_tpu.serve.obs_smoke import parse_prometheus
+    from spgemm_tpu.utils.timers import ENGINE
+
+    folder, _ = _chain_folder(tmp_path)
+
+    def runner(job, degraded=False):
+        ENGINE.record("numeric_dispatch", 0.125)
+        ENGINE.incr("dispatches", 3)
+
+    d = make_daemon(runner=runner)
+    resp = client.request({"op": "metrics"}, d.socket_path)
+    assert resp["ok"] is True
+    assert resp["content_type"].startswith("text/plain; version=0.0.4")
+    before = parse_prometheus(resp["text"])
+    assert before["spgemmd_queue_depth"] == 0
+    assert before["spgemmd_degraded"] == 0
+    assert before["spgemmd_uptime_seconds"] >= 0
+    assert before['spgemmd_jobs_terminal_total{outcome="done"}'] == 0
+
+    j = client.submit(folder, d.socket_path)
+    assert client.wait(j["id"], d.socket_path,
+                       timeout=30)["job"]["state"] == "done"
+    after = parse_prometheus(client.metrics(d.socket_path))
+    series = 'spgemm_phase_seconds_total{phase="numeric_dispatch"}'
+    assert after.get(series, 0) >= before.get(series, 0) + 0.125
+    assert after['spgemmd_jobs_terminal_total{outcome="done"}'] == 1
+    assert after['spgemmd_jobs{state="done"}'] == 1
+    assert after["spgemmd_job_wall_seconds_count"] == 1
+    assert after['spgemmd_job_wall_seconds_bucket{le="+Inf"}'] == 1
+
+
+def test_trace_op_returns_tagged_trace_events(tmp_path, make_daemon):
+    """The `trace` op serializes the flight recorder as trace_event JSON;
+    a job's spans carry its job_id (executor tagging)."""
+    from spgemm_tpu.obs import trace as obs_trace
+
+    # the ring is process-wide and earlier daemons also named jobs
+    # "job-1": start from a clean timeline
+    obs_trace.RECORDER.clear()
+    folder, _ = _chain_folder(tmp_path)
+
+    def runner(job, degraded=False):
+        from spgemm_tpu.utils.timers import ENGINE
+
+        with ENGINE.phase("numeric_dispatch"):
+            pass
+
+    d = make_daemon(runner=runner)
+    j = client.submit(folder, d.socket_path)
+    assert client.wait(j["id"], d.socket_path,
+                       timeout=30)["job"]["state"] == "done"
+    events = client.trace(d.socket_path)
+    assert isinstance(events, list) and events
+    mine = [ev for ev in events
+            if ev.get("args", {}).get("job_id") == j["id"]]
+    names = {ev["name"] for ev in mine}
+    assert "serve_execute" in names and "numeric_dispatch" in names
+    # lexical parenting: the dispatch span nests under serve_execute
+    exec_span = next(ev for ev in mine if ev["name"] == "serve_execute")
+    disp_span = next(ev for ev in mine if ev["name"] == "numeric_dispatch")
+    assert disp_span["args"]["parent"] == exec_span["args"]["span_id"]
+
+
+def test_degrade_auto_dumps_flight_trace(tmp_path, make_daemon):
+    """The postmortem contract: a watchdog reap and the following
+    wedge-degrade auto-snapshot the recorder next to the journal as
+    valid Perfetto trace_event JSON -- evidence survives the wedge."""
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+
+    def runner(job, degraded=False):
+        if not degraded:
+            unwedge.wait(60)  # hung backend call: no beats, no return
+
+    d = make_daemon(runner=runner, job_timeout_s=0.3, wedge_grace_s=0.2,
+                    probe=lambda: "timeout")
+    try:
+        j1 = client.submit(folder, d.socket_path)
+        resp = client.wait(j1["id"], d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "failed"
+        _wait_until(lambda: d.degraded, msg="degrade after wedge grace")
+        reap_dump = os.path.join(d.flight_dir, f"{j1['id']}.trace.json")
+        wedge_dump = os.path.join(d.flight_dir,
+                                  f"{j1['id']}.wedged.trace.json")
+        degrade_dump = os.path.join(d.flight_dir, "degrade.trace.json")
+        for path in (reap_dump, wedge_dump, degrade_dump):
+            _wait_until(lambda p=path: os.path.exists(p),
+                        msg=f"flight dump {path}")
+            events = json.load(open(path, encoding="utf-8"))
+            assert isinstance(events, list) and events
+            assert all("ph" in ev and "name" in ev for ev in events)
+        # the reap/degrade transitions left instant markers in the ring
+        names = {ev["name"] for ev in
+                 json.load(open(degrade_dump, encoding="utf-8"))}
+        assert "serve_reap" in names
+        # stats points an operator at the evidence
+        st = client.stats(d.socket_path)
+        assert st["flight_dir"] == d.flight_dir
+        assert st["jobs_terminal"]["timeout"] == 1
+    finally:
+        unwedge.set()
+
+
+def test_stats_reports_journal_and_terminal_totals(tmp_path, make_daemon):
+    """The scraper's healthy-vs-recovered discriminators: uptime, journal
+    size/compaction count, and daemon-lifetime per-outcome totals (the
+    bounded queue index alone cannot provide them)."""
+    folder, _ = _chain_folder(tmp_path)
+    boom = []
+
+    def runner(job, degraded=False):
+        if boom:
+            raise RuntimeError("synthetic job failure")
+
+    d = make_daemon(runner=runner)
+    j = client.submit(folder, d.socket_path)
+    assert client.wait(j["id"], d.socket_path,
+                       timeout=30)["job"]["state"] == "done"
+    boom.append(True)
+    j = client.submit(folder, d.socket_path)
+    assert client.wait(j["id"], d.socket_path,
+                       timeout=30)["job"]["state"] == "failed"
+    st = client.stats(d.socket_path)
+    assert st["uptime_s"] >= 0
+    assert st["jobs_terminal"] == {"done": 1, "error": 1, "timeout": 0,
+                                   "abandoned": 0}
+    journal = st["journal"]
+    assert journal["enabled"] is True
+    assert journal["path"] == d.journal_path
+    assert journal["bytes"] > 0          # submit/done records on disk
+    assert journal["compactions"] >= 0
+    assert st["trace"]["capacity"] >= 1  # recorder health rides along
+
+
+def test_wedged_job_phases_never_bleed_into_replacement(tmp_path,
+                                                        make_daemon):
+    """The end-to-end disjointness proof: a wedged executor that keeps
+    accumulating AFTER its job was reaped (and after the replacement
+    executor started the next job) contaminates neither the replacement
+    job's detail nor loses its own."""
+    from spgemm_tpu.utils.timers import ENGINE
+
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    job2_running = threading.Event()
+
+    def runner(job, degraded=False):
+        if job.id == "job-1" and not degraded:
+            ENGINE.record("ring_fold", 0.125)   # before the wedge
+            unwedge.wait(30)                    # wedged...
+            ENGINE.record("ring_fold", 100.0)   # ...unwedges much later
+            return
+        job2_running.set()
+        ENGINE.record("assembly", 0.25)
+        unwedge.wait(30)  # keep job 2 running while job 1 unwedges
+
+    d = make_daemon(runner=runner, job_timeout_s=0.3, wedge_grace_s=0.2,
+                    probe=lambda: "timeout")
+    j1 = client.submit(folder, d.socket_path)
+    resp = client.wait(j1["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "failed"
+    _wait_until(lambda: d.degraded, msg="degrade after wedge grace")
+    j2 = client.submit(folder, d.socket_path, {"timeout_s": 0})
+    _wait_until(job2_running.is_set, msg="replacement executor on job 2")
+    unwedge.set()  # job 1's wedged thread wakes UNDER job 2
+    resp2 = client.wait(j2["id"], d.socket_path, timeout=30)
+    assert resp2["job"]["state"] == "done"
+    det2 = resp2["job"]["detail"]
+    # job 2 must not see the wedged thread's late 100 s of ring_fold
+    assert "ring_fold" not in det2["phases_s"]
+    assert det2["phases_s"]["assembly"] == 0.25
+    # and job 1's reap-time detail kept its own pre-wedge phase
+    det1 = client.status(j1["id"], d.socket_path)["job"]["detail"]
+    assert det1["phases_s"]["ring_fold"] == 0.125
+
+
+def test_flight_dump_dir_is_bounded(tmp_path):
+    """The flight dir is a client-growable resource like every other:
+    past FLIGHT_RETAIN dumps the oldest are pruned, never unbounded disk
+    on the device owner."""
+    d = Daemon(str(tmp_path / "d.sock"), journal=False)  # not started
+    d.FLIGHT_RETAIN = 5
+    for i in range(12):
+        assert d._flight_dump(f"job-{i}") is not None
+    kept = set(os.listdir(d.flight_dir))
+    assert kept == {f"job-{i}.trace.json" for i in range(7, 12)}
