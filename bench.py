@@ -364,6 +364,18 @@ def _run(args) -> int:
     # signature, and the sum cannot flake on which iteration timed best
     plan_hits = sum(t.get("plan_cache_hits", 0) for t in counter_tables)
     plan_misses = sum(t.get("plan_cache_misses", 0) for t in counter_tables)
+    # estimator routing (ops/estimate): summed like the cache counters, and
+    # collapsed into one detail.plan_route tag -- 'estimated' = at least one
+    # first-contact plan was estimator-routed this run, 'cache-hit' = every
+    # plan came from the structure cache, 'exact' otherwise
+    est_hits = sum(t.get("est_hits", 0) for t in counter_tables)
+    est_fallbacks = sum(t.get("est_fallbacks", 0) for t in counter_tables)
+    if est_hits:
+        plan_route = "estimated"
+    elif plan_hits and not plan_misses:
+        plan_route = "cache-hit"
+    else:
+        plan_route = "exact"
 
     # kernel-rate detail: a genuinely mid-chain SpGEMM (two level-1 partial
     # products, i.e. doubled bandwidth and real fill-in), same kernel
@@ -479,6 +491,9 @@ def _run(args) -> int:
             "plan_ahead": knobs.get("SPGEMM_TPU_PLAN_AHEAD"),
             "plan_cache_hits": plan_hits,
             "plan_cache_misses": plan_misses,
+            "plan_route": plan_route,
+            "est_hits": est_hits,
+            "est_fallbacks": est_fallbacks,
             "trace_path": trace_path,
             **({"fallback": {
                 "reason": f"{args.cpu_fallback}; CPU with clamped workload",

@@ -58,6 +58,75 @@ def _synth_structure(n_blocks: int, blocks_per_row: int, k: int, seed: int):
                            nnzb=len(coords), k=k, val_bound=0)
 
 
+def _cold_structure(n_blocks: int, blocks_per_row: int, k: int, seed: int):
+    """A sorted block-COO structure with ~n_blocks/blocks_per_row distinct
+    tile-rows -- enough rows that the estimator's sample is a strict
+    subset of the population (the first-contact regime ops/estimate exists
+    for; _synth_structure's sqrt-sided grid collapses to too few rows)."""
+    from types import SimpleNamespace
+
+    rng = np.random.default_rng(seed)
+    n_rows = max(2, n_blocks // max(blocks_per_row, 1))
+    rows = np.repeat(np.arange(n_rows, dtype=np.int64), blocks_per_row)
+    cols = rng.integers(0, n_rows, size=len(rows), dtype=np.int64)
+    coords = np.unique(np.stack([rows, cols], axis=1), axis=0)
+    return SimpleNamespace(coords=coords, nnzb=len(coords), k=k,
+                           val_bound=0)
+
+
+def _cold_structure_detail(args) -> dict:
+    """--cold-structure: the first-contact A/B -- a FRESH structure
+    fingerprint per iteration (the plan cache can never hit), cold plan()
+    wall timed with the sampled estimator on vs off in the same process.
+    The estimator-on figure is what a caller blocks on (the exact join is
+    deferred into SpgemmPlan.ensure_exact); ensure_exact() is then forced
+    OUTSIDE the timed span, as the chain plan-ahead worker would."""
+    from spgemm_tpu.ops import estimate, plancache
+    from spgemm_tpu.ops.spgemm import plan as plan_spgemm
+    from spgemm_tpu.utils import knobs
+
+    def timed_plan(knob_val: str, seed: int):
+        os.environ["SPGEMM_TPU_PLAN_ESTIMATE"] = knob_val
+        a = _cold_structure(args.keys, args.fanout, 8, seed)
+        b = _cold_structure(args.keys, args.fanout, 8, seed + 1)
+        t0 = time.perf_counter()
+        p = plan_spgemm(a, b, backend="xla", platform="cpu")
+        return time.perf_counter() - t0, p
+
+    # snapshot-through-the-registry (a raw env READ of a knob is a KNB
+    # lint finding; writes/dels are the blessed harness idiom)
+    prev = (None if knobs.source("SPGEMM_TPU_PLAN_ESTIMATE") != "env"
+            else "1" if knobs.get("SPGEMM_TPU_PLAN_ESTIMATE") else "0")
+    on_s = off_s = float("inf")
+    routes = []
+    estimate.clear()
+    try:
+        for i in range(args.repeats):
+            plancache.clear()
+            wall, p = timed_plan("1", 1000 + 10 * i)
+            on_s = min(on_s, wall)
+            routes.append(p.plan_route)
+            p.ensure_exact()  # the deferred join lands off the timed span
+            wall, _ = timed_plan("0", 2000 + 10 * i)
+            off_s = min(off_s, wall)
+    finally:
+        if prev is None:
+            try:
+                del os.environ["SPGEMM_TPU_PLAN_ESTIMATE"]
+            except KeyError:
+                pass
+        else:
+            os.environ["SPGEMM_TPU_PLAN_ESTIMATE"] = prev
+    return {"cold_plan": {
+        "keys": args.keys,
+        "est_on_wall_s": round(on_s, 6),
+        "est_off_wall_s": round(off_s, 6),
+        "speedup": round(off_s / on_s, 2) if on_s > 0 else None,
+        "plan_routes": routes,
+        "estimator": estimate.stats(),
+    }}
+
+
 def _repeat_structure_detail(args) -> dict:
     """--repeat-structure: time the structure-keyed plan cache's hit path
     (ops/plancache) against the cold plan, on a synthetic pair sized by
@@ -100,6 +169,12 @@ def main() -> int:
                    help="also measure the structure-keyed plan-cache hit "
                         "path (ops/plancache): emits plan_cache_hit_wall_s "
                         "next to the plan_ring_wall fields")
+    p.add_argument("--cold-structure", action="store_true",
+                   help="first-contact A/B: a fresh structure fingerprint "
+                        "per iteration, cold plan() wall with the sampled "
+                        "estimator (SPGEMM_TPU_PLAN_ESTIMATE) on vs off -- "
+                        "emits the detail.cold_plan block with the speedup "
+                        "ratio")
     args = p.parse_args()
     if args.repeats < 1:
         p.error("--repeats must be >= 1 (best-of timing needs a sample; "
@@ -123,6 +198,8 @@ def main() -> int:
               "plan_rounds_wall_s": round(rounds_s, 4)}
     if args.repeat_structure:
         detail.update(_repeat_structure_detail(args))
+    if args.cold_structure:
+        detail.update(_cold_structure_detail(args))
     print(json.dumps({
         "metric": "plan_ring_wall", "value": round(ring_s, 4), "unit": "s",
         "vs_baseline": None,

@@ -92,7 +92,24 @@ def _spgemm_config(name, a, b, backend, parity=True, sampled_parity=0):
     join = symbolic_join(a.coords, b.coords)
     flops = 2.0 * int(join.pair_ptr[-1]) * a.k ** 3
 
+    pre = ENGINE.counter_snapshot()  # the warm run IS the cold first contact
     spgemm_device(da, db, backend=backend).block_until_ready()  # warm
+    # the warm run's counter deltas record how the COLD plan routed
+    # (estimated fast-return vs inline exact join vs an earlier config's
+    # cache) -- the per-row audit trail for the estimator A/B
+    warm = ENGINE.counter_snapshot()
+    d_est = warm.get("est_hits", 0) - pre.get("est_hits", 0)
+    d_fall = warm.get("est_fallbacks", 0) - pre.get("est_fallbacks", 0)
+    d_miss = (warm.get("plan_cache_misses", 0)
+              - pre.get("plan_cache_misses", 0))
+    d_hit = (warm.get("plan_cache_hits", 0)
+             - pre.get("plan_cache_hits", 0))
+    # 'cache-hit' only when a hit actually landed -- with the cache
+    # disabled (or estimation skipped) no counter moves, and that is a
+    # plain cold exact plan, not a hit
+    cold_route = ("estimated" if d_est
+                  else "exact" if d_fall or d_miss
+                  else "cache-hit" if d_hit else "exact")
     # the timed run repeats the warm run's structure, so with the plan
     # cache on it IS the serving-path cache-hit row: phases_s.plan near
     # zero, plan_cache_hits > 0 (the counters make that auditable per row)
@@ -113,6 +130,7 @@ def _spgemm_config(name, a, b, backend, parity=True, sampled_parity=0):
         "phases_s": ENGINE.snapshot(),
         "plan_cache_hits": counters.get("plan_cache_hits", 0),
         "plan_cache_misses": counters.get("plan_cache_misses", 0),
+        "cold_plan_route": cold_route,
     }
     if parity:
         from spgemm_tpu.utils.blockcsr import BlockSparseMatrix

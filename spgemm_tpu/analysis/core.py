@@ -31,6 +31,7 @@ NUMERIC_SUFFIXES = (
     "/ops/u64.py",
     "/ops/spgemm.py",
     "/ops/mxu_spgemm.py",
+    "/ops/estimate.py",
     "/parallel/ring.py",
     "/parallel/rowshard.py",
 )

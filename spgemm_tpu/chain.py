@@ -116,8 +116,14 @@ def _make_planner(multiply, kwargs):
     round_size = kwargs.get("round_size")
 
     def planner(a, b):
-        return spgemm_mod.plan(a, b, round_size=round_size,
-                               backend=backend, platform=platform)
+        p = spgemm_mod.plan(a, b, round_size=round_size,
+                            backend=backend, platform=platform)
+        # an estimator-routed plan (ops/estimate) returns fast with the
+        # exact symbolic join deferred: complete it HERE, on the worker
+        # thread, so the join's cost overlaps device execution instead of
+        # landing on the dispatch critical path (host-pure numpy -- the
+        # @host_only contract holds)
+        return p.ensure_exact()
 
     return planner
 

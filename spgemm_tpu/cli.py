@@ -138,13 +138,17 @@ def run_knobs(argv: list[str]) -> int:
                                 "current value, default, and source")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable: {knobs: [one object per knob], "
-                        "plan_cache: live hit/miss/capacity stats}")
+                        "plan_cache: live hit/miss/capacity stats, "
+                        "estimator: live est_hits/est_fallbacks routing "
+                        "stats}")
     args = p.parse_args(argv)
     rows = knobs_registry.snapshot()
-    # live plan-cache state next to the knob rows (jax-free import): the
-    # whole-engine A/B pair SPGEMM_TPU_PLAN_AHEAD=0|2 and the cache knobs
-    # are inspectable together without a bench run
-    from spgemm_tpu.ops import plancache  # noqa: PLC0415
+    # live plan-cache + estimator state next to the knob rows (jax-free
+    # imports): the whole-engine A/B pairs (SPGEMM_TPU_PLAN_AHEAD=0|2,
+    # SPGEMM_TPU_PLAN_ESTIMATE=0|1) and the routing health (estimated vs
+    # exact-fallback plans) are inspectable together without a bench run
+    # or a metrics scrape
+    from spgemm_tpu.ops import estimate, plancache  # noqa: PLC0415
 
     try:
         cache = plancache.stats()
@@ -153,10 +157,17 @@ def run_knobs(argv: list[str]) -> int:
         # per-knob rows above already carry the error); report it in place
         cache = {"hits": 0, "misses": 0, "entries": 0,
                  "capacity": "?", "enabled": "?", "error": str(e)}
+    try:
+        est = estimate.stats()
+    except ValueError as e:
+        est = {"hits": 0, "fallbacks": 0, "enabled": "?",
+               "sample_rows": "?", "confidence_threshold": "?",
+               "error": str(e)}
     if args.as_json:
         import json  # noqa: PLC0415
 
-        print(json.dumps({"knobs": rows, "plan_cache": cache}, indent=2))
+        print(json.dumps({"knobs": rows, "plan_cache": cache,
+                          "estimator": est}, indent=2))
         return 0
     name_w = max(len(r["name"]) for r in rows)
     val_w = max(len(r["value"]) for r in rows)
@@ -175,6 +186,15 @@ def run_knobs(argv: list[str]) -> int:
               "  [ops/plancache.py]")
         if cache.get("error"):
             print(f"  !! {cache['error']}")
+        e_on = est["enabled"]
+        print(f"estimator:  est_hits={est['hits']} "
+              f"est_fallbacks={est['fallbacks']} "
+              f"enabled={e_on if e_on == '?' else int(e_on)} "
+              f"sample_rows={est['sample_rows']} "
+              f"confidence>={est['confidence_threshold']}"
+              "  [ops/estimate.py]")
+        if est.get("error"):
+            print(f"  !! {est['error']}")
     except BrokenPipeError:
         # `spgemm_tpu knobs | head` closing the pipe is not an error for a
         # listing; swap in devnull so the interpreter's exit flush of

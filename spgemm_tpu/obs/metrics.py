@@ -30,6 +30,8 @@ from dataclasses import dataclass
 ENGINE_PHASES: dict[str, str] = {
     "plan": "full symbolic plan (join + rounds + assembly permutation)",
     "plan_wait": "how long dispatch actually blocked on planning",
+    "estimate": "sampled structure estimation (ops/estimate.py)",
+    "join_fallback": "exact join built inline on low estimator confidence",
     "symbolic_join": "host symbolic join over operand structures",
     "plan_rounds": "round bucketing + assembly permutation",
     "numeric_dispatch": "numeric kernel launches (host dispatch span)",
@@ -52,6 +54,10 @@ ENGINE_COUNTERS: dict[str, str] = {
     "dcn_chunks": "bounded DCN exchange chunks shipped",
     "plan_cache_hits": "structure-keyed plan cache hits",
     "plan_cache_misses": "structure-keyed plan cache misses",
+    "est_hits": "estimator-routed plans (exact join deferred off the "
+                "critical path)",
+    "est_fallbacks": "estimator fallbacks to the inline exact join "
+                     "(confidence below SPGEMM_TPU_EST_CONFIDENCE)",
     "serve_reaps": "spgemmd watchdog job reaps (deadline exceeded)",
     "serve_degrades": "spgemmd degrade transitions to the CPU path",
 }

@@ -1,0 +1,219 @@
+"""Sampled structure estimator: first-contact planning without the full join.
+
+PR 4's structure-keyed plan cache (ops/plancache) made repeated multiplies
+~145x cheaper, but a *first-contact* structure still paid the full exact
+symbolic join on the caller's critical path -- 16.6 ms per cold plan at 20k
+keys, and far worse at webbase scale where first-touch planning dominates
+job wall.  Ocean-style sampling (PAPERS.md) recovers near-exact SpGEMM
+decisions from a bounded row sample at a fraction of that cost: this module
+joins an evenly-spaced sample of A's distinct tile-rows against B's (sorted)
+row index EXACTLY -- the sampled rows' output keys, fanouts, and pair masses
+are true values, not sketches -- and scales them to the population.
+
+What the estimate steers (ops/spgemm.plan):
+  * the kernel-route partition point (whether the hybrid `_proof_fanout_cap`
+    split is worth materializing -- guarded downstream by the per-round
+    exactness proof, so an estimation error can never change bits);
+  * whether the exact symbolic join runs INLINE (low confidence -- the
+    `join_fallback` path) or DEFERRED off the critical path into the
+    plan-ahead worker (SpgemmPlan.ensure_exact);
+  * ring load balancing: `parallel/ring.plan_ring` assigns key slabs by
+    cumulative pair mass -- the quantity `row_mass` predicts -- instead of
+    raw key count.
+
+What it can never steer: fold order.  Estimation picks budgets and routing
+only; every kernel produces identical bits and each key's pair list keeps
+the reference's j-ascending order (SURVEY.md section 2.9), so estimator
+on/off is a bit-identical whole-engine A/B (pinned in tests/test_estimate).
+
+Host-only and jax-free like the rest of the planner (safe on plan-ahead
+worker threads -- the BKD contract), and in the numeric-lint FLD scope:
+the integer sizing sums below are order-free by proof, anything else would
+be a finding.
+
+Knobs (central registry, utils/knobs.py):
+  SPGEMM_TPU_PLAN_ESTIMATE  0|1 (default 1) -- estimator on/off.
+  SPGEMM_TPU_EST_SAMPLE_ROWS int >= 1 (default 48) -- row sample budget;
+    structures with this many distinct A tile-rows or fewer skip
+    estimation (the sample would be the population -- exact is free).
+  SPGEMM_TPU_EST_CONFIDENCE  float >= 0 (default 0.5) -- estimates whose
+    confidence falls below this take the exact-join fallback inline.
+
+Live stats (`stats()`) ride next to the plan-cache row in
+`spgemm_tpu.cli knobs [--json]`; the engine mirrors hit/fallback events
+into the ENGINE registry (`est_hits`/`est_fallbacks` counters) so they
+flow into bench detail and the Prometheus surface per run.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from spgemm_tpu.utils import knobs
+
+_LOCK = threading.Lock()
+_STATS = {"hits": 0, "fallbacks": 0}  # spgemm-lint: guarded-by(_LOCK)
+
+
+def enabled() -> bool:
+    """SPGEMM_TPU_PLAN_ESTIMATE=0|1 (default 1)."""
+    return knobs.get("SPGEMM_TPU_PLAN_ESTIMATE")
+
+
+def sample_budget() -> int:
+    """SPGEMM_TPU_EST_SAMPLE_ROWS (default 48): distinct A tile-rows
+    sampled, evenly spaced over the sorted row set (deterministic -- the
+    same structure always produces the same estimate)."""
+    return knobs.get("SPGEMM_TPU_EST_SAMPLE_ROWS")
+
+
+def confidence_threshold() -> float:
+    """SPGEMM_TPU_EST_CONFIDENCE (default 0.5): below it, plan() takes the
+    exact-join fallback inline; above 1 forces the fallback everywhere
+    (a zero-variance sample earns exactly 1.0)."""
+    return knobs.get("SPGEMM_TPU_EST_CONFIDENCE")
+
+
+def note_hit() -> None:
+    with _LOCK:
+        _STATS["hits"] += 1
+
+
+def note_fallback() -> None:
+    with _LOCK:
+        _STATS["fallbacks"] += 1
+
+
+def stats() -> dict:
+    """Live per-process estimator routing state, for `spgemm_tpu.cli
+    knobs` next to the plan-cache row: estimator-routed plans vs inline
+    exact-join fallbacks since process start, plus the knob values."""
+    with _LOCK:
+        return {
+            "hits": _STATS["hits"],
+            "fallbacks": _STATS["fallbacks"],
+            "enabled": enabled(),
+            "sample_rows": sample_budget(),
+            "confidence_threshold": confidence_threshold(),
+        }
+
+
+def clear() -> None:
+    """Zero the routing stats (tests, A/B harnesses)."""
+    with _LOCK:
+        _STATS["hits"] = _STATS["fallbacks"] = 0
+
+
+@dataclass
+class StructureEstimate:
+    """Scaled prediction of one A x B output structure from a row sample.
+
+    The sampled rows' figures are EXACT (a real mini-join ran over them);
+    population figures are the sampled totals scaled by
+    total_rows / sampled_rows.  `confidence` is 1 minus the relative
+    standard error of the sampled per-row pair mass -- near 1 on uniform
+    structures (banded chains), collapsing toward 0 under power-law skew,
+    which is exactly when scaled totals stop being trustworthy and the
+    exact join should run inline instead.
+    """
+
+    total_rows: int            # distinct A tile-rows in the population
+    sampled_rows: int
+    scale: float               # total_rows / sampled_rows
+    est_keys: float            # predicted output-key count
+    est_pairs: float           # predicted total tile pairs (MAC mass)
+    est_max_fanout: int        # max per-key fanout SEEN in the sample
+    class_hist: dict = field(default_factory=dict)  # shape class -> est keys
+    row_mass: np.ndarray | None = None  # per-sampled-row pair counts
+    skew: float = 0.0          # coefficient of variation of row_mass
+    confidence: float = 0.0
+
+
+def maybe_estimate(a_coords: np.ndarray, b_coords: np.ndarray,
+                   sample_rows: int | None = None) -> StructureEstimate | None:
+    """Estimate the A x B output structure from a bounded row sample, or
+    None when estimation does not apply: an empty operand (the exact join
+    is O(1) there), or a population no bigger than the sample budget (the
+    sample would be the population -- run the exact join, it costs the
+    same and is exact).
+
+    Both coord arrays must be lexicographically sorted by (row, col) --
+    the BlockSparseMatrix invariant the exact join also relies on.
+    Deterministic: evenly spaced sample positions, no RNG.
+    """
+    from spgemm_tpu.ops.symbolic import (_segment_expand,  # noqa: PLC0415
+                                         _shape_class_vec)
+
+    if sample_rows is None:
+        sample_rows = sample_budget()
+    if len(a_coords) == 0 or len(b_coords) == 0:
+        return None
+    a_rows = a_coords[:, 0]
+    row_vals, row_starts = np.unique(a_rows, return_index=True)
+    n_rows = len(row_vals)
+    if n_rows <= sample_rows:
+        return None
+    row_ends = np.append(row_starts[1:], len(a_rows))
+
+    # evenly spaced distinct sample over the sorted row set
+    take = np.unique(np.linspace(0, n_rows - 1, num=sample_rows)
+                     .astype(np.int64))
+    n_take = len(take)
+    lens = row_ends[take] - row_starts[take]
+    blk_seg, blk_off = _segment_expand(lens)  # sample-local row per block
+    blk_idx = np.repeat(row_starts[take], lens) + blk_off
+
+    # exact mini-join of the sampled rows against B's sorted row index
+    cols = a_coords[blk_idx, 1]
+    b_rows = b_coords[:, 0]
+    b_cols = b_coords[:, 1]
+    lo = np.searchsorted(b_rows, cols, side="left")
+    hi = np.searchsorted(b_rows, cols, side="right")
+    cnt = hi - lo
+    # spgemm-lint: fld-proof(integer pair-count total for sizing only; exact int64 addition is order-free, no wrap-then-mod values involved)
+    total_pairs = int(cnt.sum())
+    row_mass = np.bincount(blk_seg, weights=cnt,
+                           minlength=n_take).astype(np.int64)
+    scale = n_rows / n_take
+
+    if total_pairs == 0:
+        # sampled rows produce nothing: predict an empty-ish output with
+        # full-sample confidence semantics (uniformly zero mass has zero
+        # variance, so the formula below would also say 1.0)
+        return StructureEstimate(
+            total_rows=n_rows, sampled_rows=n_take, scale=scale,
+            est_keys=0.0, est_pairs=0.0, est_max_fanout=0,
+            class_hist={}, row_mass=row_mass, skew=0.0, confidence=1.0)
+
+    # output keys + per-key fanout for the sampled rows, exactly
+    pair_seg, pair_off = _segment_expand(cnt)
+    b_slot = np.repeat(lo, cnt) + pair_off
+    out_r = blk_seg[pair_seg].astype(np.uint64)      # sample-local row id
+    out_c = b_cols[b_slot].astype(np.uint64)
+    span = np.uint64(int(b_cols.max()) + 1)
+    fused = out_r * span + out_c                     # < n_take * span, safe
+    uniq, fan = np.unique(fused, return_counts=True)
+    keys_per_row = np.bincount((uniq // span).astype(np.int64),
+                               minlength=n_take)
+
+    classes, cls_counts = np.unique(_shape_class_vec(fan),
+                                    return_counts=True)
+    class_hist = {int(c): float(n * scale)
+                  for c, n in zip(classes, cls_counts)}
+
+    mean = float(row_mass.mean())
+    std = float(row_mass.std())
+    skew = std / mean if mean > 0 else 0.0
+    # relative standard error of the scaled total: sigma / (mu * sqrt(n))
+    rse = skew / float(np.sqrt(n_take))
+    return StructureEstimate(
+        total_rows=n_rows, sampled_rows=n_take, scale=scale,
+        # spgemm-lint: fld-proof(integer key/pair totals for prediction scaling only; exact int64 addition is order-free, no wrap-then-mod values involved)
+        est_keys=float(keys_per_row.sum()) * scale,
+        est_pairs=float(total_pairs) * scale,
+        est_max_fanout=int(fan.max()),
+        class_hist=class_hist, row_mass=row_mass, skew=skew,
+        confidence=max(0.0, 1.0 - rse))

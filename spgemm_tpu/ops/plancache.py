@@ -9,6 +9,12 @@ fingerprint over the block-coordinate arrays, so repeated inputs (the
 serving scenario, bench re-runs, failover retries) skip the planner
 entirely.
 
+Estimator-routed plans (ops/estimate) cache under the SAME structure
+fingerprint while their exact symbolic join is still deferred: the cached
+entry is the plan OBJECT, so when SpgemmPlan.ensure_exact() lands the join
+the entry is promoted in place -- every later hit serves the exact plan
+with no re-keying and no second planner run.
+
 jax-free by design: this module is imported by the CLI `knobs` listing and
 by planner WORKER threads (chain.py plan-ahead), neither of which may
 touch a backend (the BKD contract -- plans are pure numpy).

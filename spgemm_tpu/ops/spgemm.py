@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from spgemm_tpu.ops import plancache, u64
+from spgemm_tpu.ops import estimate, plancache, u64
 from spgemm_tpu.utils import knobs
 from spgemm_tpu.ops.symbolic import (SpgemmPlan, accept_round_stack,
                                      assembly_permutation, plan_rounds,
@@ -413,7 +413,16 @@ def plan(a, b, *, round_size: int | None = None, backend: str | None = None,
 @host_only
 def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
     """The pure-numpy plan builder (see plan()).  Operands need only
-    coords/nnzb/k and a value bound (val_bound attr or host tiles)."""
+    coords/nnzb/k and a value bound (val_bound attr or host tiles).
+
+    First-contact route (ops/estimate): on a cache miss with the sampled
+    estimator enabled and confident, the plan returns FAST -- budgets and
+    the kernel-route partition come from the estimate, and the exact
+    symbolic join is deferred into SpgemmPlan.ensure_exact(), which the
+    chain plan-ahead worker runs off the dispatch critical path (execute
+    forces it otherwise).  Low confidence takes the exact join inline (the
+    `join_fallback` phase).  Either way the eventual rounds come from the
+    exact join, so estimator on/off is bit-identical by construction."""
     from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
 
     if a.k != b.k:
@@ -438,38 +447,87 @@ def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
                 timers.incr("plan_cache_hits")
                 return hit
             timers.incr("plan_cache_misses")
-        with timers.phase("symbolic_join"):
-            join = symbolic_join(a.coords, b.coords)
         max_entries, default_rs = _plan_budgets(backend, platform)
-        with timers.phase("plan_rounds"):
-            if batch:
-                # round-batched dispatch: one mega-round per fanout class
-                # (partitioned at the hybrid proof threshold so kernel
-                # routing stays key-exact), bounded by the gather/SMEM
-                # budgets.  An explicit round_size still caps the key axis.
-                rounds = plan_rounds(join, a_sentinel=a.nnzb,
-                                     b_sentinel=b.nnzb,
-                                     round_size=round_size,
-                                     max_entries=max_entries, batch=True,
-                                     batch_entries=_batch_entries(k),
-                                     split_fanout=split)
-            else:
-                rs = default_rs if round_size is None else round_size
-                rounds = plan_rounds(join, a_sentinel=a.nnzb,
-                                     b_sentinel=b.nnzb, round_size=rs,
-                                     max_entries=max_entries)
-            # the assembly gather's inverse permutation is precomputed on
-            # host here, off the dispatch/assembly spans
-            take = assembly_permutation(rounds, join.num_keys) if batch \
-                else None
+        a_coords = np.asarray(a.coords)
+        b_coords = np.asarray(b.coords)
+        a_nnzb, b_nnzb = a.nnzb, b.nnzb
+
+        est = None
+        if estimate.enabled():
+            with timers.phase("estimate"):
+                est = estimate.maybe_estimate(a_coords, b_coords)
+
+        # estimate-steered kernel-route partition (ESTIMATED route only:
+        # the fallback path just declared the sample untrustworthy, and
+        # the inline exact join has the real fanouts for free): when every
+        # sampled fanout sits under the hybrid proof threshold, skip
+        # materializing the split partition (the > split part would be
+        # empty).  Safe on an estimation miss: choose_numeric re-proves
+        # every round's REAL max fanout at dispatch, so a deep key the
+        # sample missed just routes its whole class to the exact kernel --
+        # identical bits either way.
+        est_split = split
+        if (est is not None and split is not None
+                and est.est_max_fanout <= split):
+            est_split = None
+
+        def build_exact(p: SpgemmPlan, build_split) -> None:
+            """Fill join/rounds/take in place from the exact symbolic
+            join.  Host-pure (runs on plan-ahead worker threads); phase
+            accumulation attributes to whichever thread forced it."""
+            with timers.phase("symbolic_join"):
+                join = symbolic_join(a_coords, b_coords)
+            with timers.phase("plan_rounds"):
+                if batch:
+                    # round-batched dispatch: one mega-round per fanout
+                    # class (partitioned at the hybrid proof threshold so
+                    # kernel routing stays key-exact), bounded by the
+                    # gather/SMEM budgets.  An explicit round_size still
+                    # caps the key axis.
+                    rounds = plan_rounds(join, a_sentinel=a_nnzb,
+                                         b_sentinel=b_nnzb,
+                                         round_size=round_size,
+                                         max_entries=max_entries,
+                                         batch=True,
+                                         batch_entries=_batch_entries(k),
+                                         split_fanout=build_split)
+                else:
+                    rs = default_rs if round_size is None else round_size
+                    rounds = plan_rounds(join, a_sentinel=a_nnzb,
+                                         b_sentinel=b_nnzb, round_size=rs,
+                                         max_entries=max_entries)
+                # the assembly gather's inverse permutation is precomputed
+                # on host here, off the dispatch/assembly spans
+                take = assembly_permutation(rounds, join.num_keys) \
+                    if batch else None
+            p.join, p.rounds, p.take = join, rounds, take
+
         p = SpgemmPlan(backend=backend, platform=platform, k=k,
-                       a_nnzb=a.nnzb, b_nnzb=b.nnzb, join=join,
-                       rounds=rounds, take=take, batch=batch,
+                       a_nnzb=a_nnzb, b_nnzb=b_nnzb, join=None,
+                       rounds=None, take=None, batch=batch,
                        round_size=round_size, split_fanout=split,
-                       fingerprint=key,
-                       plan_s=time.perf_counter() - t0,
-                       _a_coords=np.asarray(a.coords),
-                       _b_coords=np.asarray(b.coords))
+                       fingerprint=key, estimate=est,
+                       _a_coords=a_coords, _b_coords=b_coords)
+        if (est is not None
+                and est.confidence >= estimate.confidence_threshold()):
+            # confident estimate: fast return, exact join deferred off
+            # the critical path (the plan-ahead worker or execute() runs
+            # ensure_exact; the cached entry is promoted in place)
+            estimate.note_hit()
+            timers.incr("est_hits")
+            p.plan_route = "estimated"
+            p._exact_builder = partial(build_exact, build_split=est_split)
+        elif est is not None:
+            # estimator ran but the sample is not trustworthy (skewed
+            # mass): take the exact join inline, visibly, with the FULL
+            # proof-threshold partition (never the distrusted estimate's)
+            estimate.note_fallback()
+            timers.incr("est_fallbacks")
+            with timers.phase("join_fallback"):
+                build_exact(p, build_split=split)
+        else:
+            build_exact(p, build_split=split)
+        p.plan_s = time.perf_counter() - t0
         if key is not None:
             plancache.store(key, p)
         return p
@@ -487,6 +545,10 @@ def execute(plan: SpgemmPlan, a, b):
     a = ensure_device(a)
     b = ensure_device(b)
     plan.check_operands(a, b)
+    # an estimator-routed plan may still carry a deferred exact join
+    # (direct plan() callers without a plan-ahead worker): land it now --
+    # in-place, so the plan-cache entry is promoted for every later hit
+    plan.ensure_exact()
     k = plan.k
     join, rounds, batch = plan.join, plan.rounds, plan.batch
     if join.num_keys == 0:

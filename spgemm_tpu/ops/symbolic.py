@@ -21,6 +21,7 @@ the order the reference's sorted-map traversal produces.
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -67,8 +68,12 @@ class JoinResult:
     def num_keys(self) -> int:
         return len(self.keys)
 
-    @property
+    @functools.cached_property
     def fanouts(self) -> np.ndarray:
+        """Per-key pair counts, computed ONCE and memoized: plan_rounds,
+        the ring mass balancer, and execute's proven-bound propagation all
+        consume the same array (re-deriving the histogram per call was a
+        measurable micro-cost on every cold or cache-missed plan)."""
         return np.diff(self.pair_ptr)
 
 
@@ -254,9 +259,19 @@ class SpgemmPlan:
 
     fingerprint: the structure-cache key this plan was stored under
     (ops/plancache), or None when caching was off.
-    plan_s: host wall spent building the plan.  A cache hit returns the
-        memoized object unchanged, so this stays the COLD build wall --
-        per-call hit cost lives in the `plan` phase / plan_cache counters.
+    plan_s: host wall the CALLER blocked on to get the plan (the critical-
+        path cost).  A cache hit returns the memoized object unchanged, so
+        this stays the cold figure; for an estimator-routed plan it is the
+        fast-return wall -- the deferred exact join's cost lands in the
+        `symbolic_join`/`plan_rounds` phases of whichever thread ran
+        ensure_exact().
+    estimate / plan_route: the sampled structure estimate that steered
+        this plan (ops/estimate, None when the estimator did not run) and
+        the route taken at plan time ('estimated' = fast return with the
+        exact join deferred, 'exact' = join built inline).
+    join/rounds/take are None on a DEFERRED plan until ensure_exact()
+    lands the exact join; every consumer goes through ensure_exact() (or
+    the ring_schedule/rowshard_rounds hooks, which call it).
     """
 
     backend: str           # resolved concrete backend the budgets assumed
@@ -264,19 +279,49 @@ class SpgemmPlan:
     k: int
     a_nnzb: int            # A's sentinel index, baked into every pa
     b_nnzb: int
-    join: JoinResult
-    rounds: list           # list[Round]
+    join: JoinResult | None
+    rounds: list | None    # list[Round]
     take: np.ndarray | None  # batch-mode assembly permutation (else None)
     batch: bool            # round-batched plan (SPGEMM_TPU_ROUND_BATCH)
     round_size: int | None
     split_fanout: int | None = None  # hybrid proof partition threshold
     fingerprint: str | None = None
     plan_s: float = 0.0
+    estimate: object | None = None   # ops/estimate.StructureEstimate
+    plan_route: str = "exact"        # 'estimated' | 'exact'
     # the exact block structures planned from (check_operands' real guard)
     _a_coords: np.ndarray | None = None
     _b_coords: np.ndarray | None = None
     _ring: dict = field(default_factory=dict, repr=False)
     _rowshard: dict = field(default_factory=dict, repr=False)
+    # deferred-exact completion: a host-pure callable that fills
+    # join/rounds/take in place (ops/spgemm builds it on the estimated
+    # route), dropped once run; the lock makes ensure_exact() idempotent
+    # across threads (the plan-ahead worker and the dispatch thread may
+    # race to complete the same cached plan)
+    _exact_builder: object | None = field(default=None, repr=False)
+    _complete_lock: threading.Lock = field(default_factory=threading.Lock,
+                                           repr=False)
+
+    @property
+    def is_deferred(self) -> bool:
+        """True while the exact join has not landed yet (estimated route,
+        before any consumer forced completion)."""
+        with self._complete_lock:
+            return self._exact_builder is not None
+
+    def ensure_exact(self) -> "SpgemmPlan":
+        """Materialize the deferred exact join/rounds/take in place and
+        return self.  Idempotent and thread-safe; a no-op on plans built
+        inline.  This is the in-place PROMOTION of an estimated plan-cache
+        entry: the cached object is the same object, so every later cache
+        hit serves the exact plan."""
+        with self._complete_lock:
+            builder = self._exact_builder
+            if builder is not None:
+                builder(self)
+                self._exact_builder = None
+        return self
 
     def check_operands(self, a, b) -> None:
         """Refuse to drive a mismatched operand pair.  The cheap k/nnzb
@@ -305,10 +350,16 @@ class SpgemmPlan:
         """Memoized parallel/ring.plan_ring over this plan's join -- the
         ring strategy's prebuilt-schedule hook (pure numpy; a planner
         worker thread may warm it ahead of the fold)."""
-        key = (nnzb_b, n_dev)
+        # the resolved mass-balance flag is part of the memo key: an
+        # in-process A/B flipping SPGEMM_TPU_PLAN_ESTIMATE must never be
+        # served the other leg's schedule
+        from spgemm_tpu.parallel.ring import plan_ring  # noqa: PLC0415
+        from spgemm_tpu.utils import knobs  # noqa: PLC0415
+        mb = bool(knobs.get("SPGEMM_TPU_PLAN_ESTIMATE"))
+        key = (nnzb_b, n_dev, mb)
         if key not in self._ring:
-            from spgemm_tpu.parallel.ring import plan_ring  # noqa: PLC0415
-            self._ring[key] = plan_ring(self.join, nnzb_b, n_dev)
+            self._ring[key] = plan_ring(self.ensure_exact().join,
+                                        nnzb_b, n_dev, mass_balance=mb)
         return self._ring[key]
 
     def rowshard_rounds(self, round_size: int | None = None):
@@ -317,8 +368,8 @@ class SpgemmPlan:
         rs = 512 if round_size is None else round_size
         if rs not in self._rowshard:
             self._rowshard[rs] = plan_rounds(
-                self.join, a_sentinel=self.a_nnzb, b_sentinel=self.b_nnzb,
-                round_size=rs)
+                self.ensure_exact().join, a_sentinel=self.a_nnzb,
+                b_sentinel=self.b_nnzb, round_size=rs)
         return self._rowshard[rs]
 
 

@@ -63,9 +63,21 @@ def overlap_enabled() -> bool:
 RANK_UNROLL_MAX = 8
 
 
-def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
+def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int,
+              mass_balance: bool | None = None):
     """Host-side schedule: key chunks per device, RANK-COMPACTED pair lists
     per (device, slab) step.
+
+    mass_balance (None = the SPGEMM_TPU_PLAN_ESTIMATE estimator family
+    knob): assign each device's contiguous key range by cumulative PAIR
+    MASS -- the per-key MAC count the estimator's row_mass predicts, exact
+    here since the join has landed -- instead of raw key count.  Equal
+    key-count ranges under power-law skew hand one device the deep keys
+    and pad every other device up to its step shapes (the residual ~1.45x
+    padded-MAC skew of the rank-compacted schedule); mass-balanced bounds
+    attack exactly that.  Field-mode addition is an abelian group op and
+    every key still folds whole on one device, so the split point cannot
+    change bits -- the knob is a pure load-balance A/B.
 
     Pairs land in (key, slab) cells (slab = which contiguous B chunk owns the
     pair's B tile).  A power-law structure makes almost every cell hold ONE
@@ -102,6 +114,8 @@ def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
                     into row_idx (single-sourced here; the fold's
                     accumulator MUST be allocated k_max + 1 rows)
     """
+    if mass_balance is None:
+        mass_balance = knobs.get("SPGEMM_TPU_PLAN_ESTIMATE")
     n_keys = join.num_keys
     slab_bounds = np.array([(i * nnzb_b) // n_dev for i in range(n_dev + 1)],
                            dtype=np.int64)
@@ -109,9 +123,19 @@ def plan_ring(join: JoinResult, nnzb_b: int, n_dev: int):
     s_max = int(slab_sizes.max()) if n_dev > 0 else 0
 
     # contiguous key ranges (keys are sorted by (row, col), so these are
-    # row-range slabs of C)
-    key_bounds = np.array([(d * n_keys) // n_dev for d in range(n_dev + 1)],
-                          dtype=np.int64)
+    # row-range slabs of C): equal-count legacy split, or mass-balanced --
+    # boundary d lands where the cumulative pair mass crosses d/n of the
+    # total, so each device folds ~the same MAC count even when the key
+    # fanout distribution is power-law
+    if mass_balance and n_keys > 0:
+        cum = np.concatenate(([0], np.cumsum(join.fanouts, dtype=np.int64)))
+        targets = np.arange(1, n_dev, dtype=np.int64) * cum[-1] // n_dev
+        interior = np.searchsorted(cum, targets, side="left").astype(np.int64)
+        key_bounds = np.concatenate(
+            ([0], np.maximum.accumulate(interior), [n_keys]))
+    else:
+        key_bounds = np.array([(d * n_keys) // n_dev
+                               for d in range(n_dev + 1)], dtype=np.int64)
     key_chunks = [np.arange(key_bounds[d], key_bounds[d + 1])
                   for d in range(n_dev)]
     k_max = max(1, int(np.diff(key_bounds).max()))
@@ -220,7 +244,7 @@ def spgemm_ring(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
     if plan is not None:
         plan.check_operands(a, b)
-        join = plan.join
+        join = plan.ensure_exact().join  # land a deferred estimated plan
     else:
         join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:

@@ -61,7 +61,7 @@ def spgemm_sharded(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
     if plan is not None:
         plan.check_operands(a, b)
-        join = plan.join
+        join = plan.ensure_exact().join  # land a deferred estimated plan
     else:
         join = symbolic_join(a.coords, b.coords)
     if join.num_keys == 0:

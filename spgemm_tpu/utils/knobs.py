@@ -97,6 +97,29 @@ _KNOBS = (
          "Plan-cache LRU capacity (plans retained per process; a plan "
          "holds its padded pa/pb index arrays, ~8 bytes per tile pair).",
          "ops/plancache.py", default="32", minimum=1),
+    Knob("SPGEMM_TPU_PLAN_ESTIMATE", "bool01",
+         "Sampled structure estimator for first-contact plans: 1 = a "
+         "bounded row sample predicts output nnz/fanout/mass, the plan "
+         "returns fast with the exact symbolic join deferred off the "
+         "critical path (SpgemmPlan.ensure_exact -- run by the plan-ahead "
+         "worker or at execute), and the ring schedule balances key slabs "
+         "by predicted MACs; 0 = always build the exact join inline.  "
+         "Bit-identical either way: estimation steers budgets and "
+         "routing, never fold order.",
+         "ops/estimate.py", default="1"),
+    Knob("SPGEMM_TPU_EST_SAMPLE_ROWS", "int",
+         "Estimator row-sample budget: distinct A tile-rows sampled "
+         "(evenly spaced, deterministic); structures with this many rows "
+         "or fewer skip estimation -- the sample would be the population, "
+         "so the exact join runs instead.",
+         "ops/estimate.py", default="48", minimum=1),
+    Knob("SPGEMM_TPU_EST_CONFIDENCE", "float",
+         "Estimator confidence threshold: an estimate whose confidence "
+         "(1 - relative standard error of the sampled per-row pair mass) "
+         "falls below this takes the exact-join fallback inline "
+         "(join_fallback phase, est_fallbacks counter); above 1 forces "
+         "the fallback everywhere.",
+         "ops/estimate.py", default="0.5", minimum=0),
     Knob("SPGEMM_TPU_HYBRID_GATE", "enum",
          "Hybrid speed-gate policy: auto = measured per-shape crossover, "
          "proof = route on the exactness proof alone (unset: auto on TPU, "

@@ -37,6 +37,16 @@ def bad_serve_knob_reads():
     return sock, cap, deadline, grace
 
 
+def bad_estimator_knob_reads():
+    # the sampled-estimator knobs are registry knobs like any other: raw
+    # reads are KNB findings (registered in utils/knobs.py, read via
+    # knobs.get in ops/estimate.py)
+    on = os.environ.get("SPGEMM_TPU_PLAN_ESTIMATE", "1")  # seeded KNB
+    rows = os.getenv("SPGEMM_TPU_EST_SAMPLE_ROWS")  # seeded KNB
+    conf = environ["SPGEMM_TPU_EST_CONFIDENCE"]  # seeded KNB
+    return on, rows, conf
+
+
 def legal_non_knob_reads():
     # non-SPGEMM_TPU names are not knobs: raw access stays legal
     return os.environ.get("JAX_PLATFORMS", ""), os.getenv("HOME")

@@ -1,0 +1,12 @@
+"""spgemm-lint FLD fixture: ops/estimate.py is in the numeric-lint scope.
+
+The estimator's predictions steer budgets and routing on the numeric path,
+and its sizing sums carry fld-proof escapes in the real module -- a
+`jnp.sum` smuggled into an estimator helper without one must be a finding.
+Never imported."""
+
+import jax.numpy as jnp
+
+
+def smuggled_mass_total(row_mass):
+    return jnp.sum(row_mass)  # seeded FLD: unordered reduction

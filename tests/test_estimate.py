@@ -1,0 +1,312 @@
+"""Estimation-based planning (PR 8 tentpole): the sampled structure
+estimator (ops/estimate), the deferred-exact plan route
+(SpgemmPlan.ensure_exact), and the skew-aware ring mass balancing.
+
+The standing contracts:
+  * estimator on/off is a bit-identical whole-engine A/B on EVERY
+    structure (estimation steers budgets and routing, never fold order);
+  * confidence below SPGEMM_TPU_EST_CONFIDENCE always takes the exact-join
+    fallback inline -- a deferred plan only ever exists behind a
+    confident estimate;
+  * an estimated plan-cache entry is promoted IN PLACE when the exact
+    join lands, so later hits serve the exact plan;
+  * the estimator is deterministic (no RNG -- same structure, same
+    estimate) and host-pure (safe on plan-ahead worker threads).
+"""
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.ops import estimate, plancache
+from spgemm_tpu.ops.spgemm import execute, plan, spgemm
+from spgemm_tpu.ops.symbolic import JoinResult, symbolic_join
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import (powerlaw_block_sparse, random_block_sparse,
+                                  random_chain, random_values)
+from spgemm_tpu.utils.semantics import chain_oracle, spgemm_oracle
+from spgemm_tpu.utils.timers import ENGINE
+
+
+def _oracle(a, b):
+    return BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    plancache.clear()
+    estimate.clear()
+    yield
+    plancache.clear()
+    estimate.clear()
+
+
+# ------------------------------------------------- structure constructors
+
+
+def _adversarial_skew():
+    """Power-law row degrees (webbase-like) with wrap-corner values: the
+    structure the confidence gate exists for."""
+    rng = np.random.default_rng(81)
+    a = powerlaw_block_sparse(32, 2, 3.0, rng, "adversarial")
+    b = powerlaw_block_sparse(32, 2, 3.0, rng, "adversarial")
+    return a, b
+
+
+def _empty_operand():
+    rng = np.random.default_rng(82)
+    a = random_block_sparse(16, 16, 2, 0.4, rng, "adversarial")
+    b = BlockSparseMatrix(rows=a.cols, cols=a.cols, k=2,
+                          coords=np.zeros((0, 2), np.int64),
+                          tiles=np.zeros((0, 2, 2), np.uint64))
+    return a, b
+
+
+def _single_key():
+    coords = np.array([[0, 0]], np.int64)
+    rng = np.random.default_rng(83)
+    a = BlockSparseMatrix(rows=2, cols=2, k=2, coords=coords,
+                          tiles=random_values((1, 2, 2), rng, "adversarial"))
+    b = BlockSparseMatrix(rows=2, cols=2, k=2, coords=coords,
+                          tiles=random_values((1, 2, 2), rng, "adversarial"))
+    return a, b
+
+
+def _uniform():
+    """Near-constant row mass: the estimator's high-confidence regime."""
+    rng = np.random.default_rng(84)
+    a = random_block_sparse(32, 32, 2, 0.3, rng, "adversarial")
+    b = random_block_sparse(32, 32, 2, 0.3, rng, "adversarial")
+    return a, b
+
+
+# ---------------------------------------------- (a) bit-identical on/off
+
+
+@pytest.mark.parametrize("mk", [_adversarial_skew, _empty_operand,
+                                _single_key, _uniform])
+def test_estimator_on_off_bytes_identical(mk, monkeypatch):
+    """The tentpole A/B: SPGEMM_TPU_PLAN_ESTIMATE=1 vs 0 on adversarial
+    skew / empty-operand / single-key / uniform structures -- output
+    BYTES identical, and both match the oracle."""
+    a, b = mk()
+    monkeypatch.setenv("SPGEMM_TPU_EST_SAMPLE_ROWS", "4")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "1")
+    on = spgemm(a, b)
+    plancache.clear()
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "0")
+    off = spgemm(a, b)
+    assert np.array_equal(on.coords, off.coords)
+    assert on.tiles.tobytes() == off.tiles.tobytes()
+    assert on == off == _oracle(a, b)
+
+
+def test_estimator_chain_plan_ahead_bit_identical(monkeypatch):
+    """The serving shape: a chain under the plan-ahead worker (which runs
+    ensure_exact off the critical path) -- estimator on/off bit-identical
+    and oracle-exact."""
+    rng = np.random.default_rng(85)
+    mats = random_chain(4, 18, 2, 0.4, rng, "adversarial")
+    monkeypatch.setenv("SPGEMM_TPU_EST_SAMPLE_ROWS", "4")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "2")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "1")
+    on = chain_product(mats)
+    plancache.clear()
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "0")
+    off = chain_product(mats)
+    want = chain_oracle([m.to_dict() for m in mats], 2)
+    want_m = BlockSparseMatrix.from_dict(mats[0].rows, mats[-1].cols, 2, want)
+    assert on.tiles.tobytes() == off.tiles.tobytes()
+    assert on == off == want_m
+
+
+# ------------------------------------------- (b) confidence gate fallback
+
+
+def test_low_confidence_always_takes_exact_fallback(monkeypatch):
+    """A threshold above any reachable confidence forces the inline
+    exact-join fallback: the plan is never deferred, the route says
+    'exact', and the fallback counters fire (never the hit counters)."""
+    a, b = _uniform()
+    monkeypatch.setenv("SPGEMM_TPU_EST_SAMPLE_ROWS", "4")
+    monkeypatch.setenv("SPGEMM_TPU_EST_CONFIDENCE", "1.01")
+    ENGINE.reset()
+    p = plan(a, b, backend="xla", platform="cpu")
+    assert p.plan_route == "exact" and not p.is_deferred
+    assert p.join is not None and p.rounds is not None
+    st = estimate.stats()
+    assert st["fallbacks"] >= 1 and st["hits"] == 0
+    counters = ENGINE.counter_snapshot()
+    assert counters.get("est_fallbacks", 0) >= 1
+    assert counters.get("est_hits", 0) == 0
+    # the fallback is visible as a phase, and the result is still exact
+    assert "join_fallback" in ENGINE.snapshot()
+    assert execute(p, a, b).to_host() == _oracle(a, b)
+
+
+def test_skewed_sample_confidence_below_uniform():
+    """The gate's discriminator: a power-law structure earns strictly
+    lower confidence than a near-uniform one at the same sample budget."""
+    a_u, b_u = _uniform()
+    a_s, _ = _adversarial_skew()
+    est_u = estimate.maybe_estimate(a_u.coords, b_u.coords, sample_rows=8)
+    est_s = estimate.maybe_estimate(a_s.coords, b_u.coords, sample_rows=8)
+    assert est_u is not None and est_s is not None
+    assert est_s.confidence < est_u.confidence
+    assert est_s.skew > est_u.skew
+
+
+# ------------------------------------- (c) estimated plans promote in place
+
+
+def test_estimated_plan_promotes_in_cache(monkeypatch):
+    """An estimated (deferred) plan caches under the structure
+    fingerprint; forcing the exact join promotes the SAME object, so the
+    next cache hit serves the exact plan with no second planner run."""
+    a, b = _uniform()
+    monkeypatch.setenv("SPGEMM_TPU_EST_SAMPLE_ROWS", "4")
+    monkeypatch.setenv("SPGEMM_TPU_EST_CONFIDENCE", "0")
+    ENGINE.reset()
+    p1 = plan(a, b, backend="xla", platform="cpu")
+    assert p1.plan_route == "estimated" and p1.is_deferred
+    assert p1.rounds is None and p1.join is None
+    assert p1.estimate is not None and p1.estimate.confidence >= 0
+    assert ENGINE.counter_snapshot().get("est_hits", 0) == 1
+    # executing forces ensure_exact: the cached entry is promoted in place
+    got = execute(p1, a, b).to_host()
+    assert not p1.is_deferred and p1.join is not None
+    assert got == _oracle(a, b)
+    p2 = plan(a, b, backend="xla", platform="cpu")
+    assert p2 is p1 and not p2.is_deferred  # the promoted exact plan
+    assert estimate.stats()["hits"] == 1    # no second estimator run
+    # re-forcing is an idempotent no-op
+    assert p2.ensure_exact() is p2
+
+
+def test_deferred_plan_rounds_match_inline(monkeypatch):
+    """ensure_exact() lands EXACTLY the rounds the inline path builds:
+    same key partitions, same padded index arrays, byte for byte."""
+    a, b = _uniform()
+    monkeypatch.setenv("SPGEMM_TPU_EST_SAMPLE_ROWS", "4")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "1")
+    deferred = plan(a, b, backend="xla", platform="cpu").ensure_exact()
+    plancache.clear()
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "0")
+    inline = plan(a, b, backend="xla", platform="cpu")
+    assert np.array_equal(deferred.join.keys, inline.join.keys)
+    assert len(deferred.rounds) == len(inline.rounds)
+    for rd, ri in zip(deferred.rounds, inline.rounds):
+        assert np.array_equal(rd.key_index, ri.key_index)
+        assert rd.pa.tobytes() == ri.pa.tobytes()
+        assert rd.pb.tobytes() == ri.pb.tobytes()
+
+
+# --------------------------------------------------- estimator mechanics
+
+
+def test_estimator_deterministic_and_scaled_sanely():
+    """No RNG: identical estimates on repeated calls; scaled key/pair
+    predictions land within a small factor of the exact join on a
+    near-uniform structure."""
+    a, b = _uniform()
+    e1 = estimate.maybe_estimate(a.coords, b.coords, sample_rows=8)
+    e2 = estimate.maybe_estimate(a.coords, b.coords, sample_rows=8)
+    assert e1 is not e2
+    assert e1.est_keys == e2.est_keys and e1.est_pairs == e2.est_pairs
+    assert e1.confidence == e2.confidence
+    join = symbolic_join(a.coords, b.coords)
+    pairs = int(join.pair_ptr[-1])
+    assert 0.5 * join.num_keys <= e1.est_keys <= 2.0 * join.num_keys
+    assert 0.5 * pairs <= e1.est_pairs <= 2.0 * pairs
+
+
+def test_estimator_skips_small_and_empty_populations():
+    """Populations no bigger than the sample budget (and empty operands)
+    return None -- the exact join is the right tool there."""
+    a, b = _uniform()
+    n_rows = len(np.unique(a.coords[:, 0]))
+    assert estimate.maybe_estimate(a.coords, b.coords,
+                                   sample_rows=n_rows) is None
+    empty = np.zeros((0, 2), np.int64)
+    assert estimate.maybe_estimate(empty, b.coords, sample_rows=4) is None
+    assert estimate.maybe_estimate(a.coords, empty, sample_rows=4) is None
+
+
+def test_fanouts_memoized_on_join_result():
+    """The plan_rounds micro-fix: JoinResult.fanouts is computed once and
+    reused (same array object on every access)."""
+    a, b = _uniform()
+    join = symbolic_join(a.coords, b.coords)
+    assert join.fanouts is join.fanouts
+    assert np.array_equal(join.fanouts, np.diff(join.pair_ptr))
+
+
+# ------------------------------------------------ ring mass balancing
+
+
+def _skewed_join(n_keys=64, deep=40):
+    """A join whose first key carries `deep` pairs and the rest one each
+    -- the equal-count split's worst case."""
+    fan = np.ones(n_keys, np.int64)
+    fan[0] = deep
+    pair_ptr = np.concatenate(([0], np.cumsum(fan)))
+    total = int(pair_ptr[-1])
+    side = int(np.ceil(np.sqrt(n_keys)))
+    keys = np.stack(np.divmod(np.arange(n_keys, dtype=np.int64), side),
+                    axis=1)
+    rng = np.random.default_rng(9)
+    pair = rng.integers(0, 64, size=total).astype(np.int32)
+    return JoinResult(keys=keys, pair_ptr=pair_ptr, pair_a=pair,
+                      pair_b=pair.copy())
+
+
+def test_plan_ring_mass_balanced_bounds():
+    """Mass balancing assigns key slabs by cumulative pair mass: the
+    per-device mass spread tightens vs the equal-key-count split, and the
+    chunks still form a contiguous partition of the key space."""
+    from spgemm_tpu.parallel.ring import plan_ring
+
+    join = _skewed_join()
+    n_dev = 4
+
+    def dev_mass(chunks):
+        fan = join.fanouts
+        return [int(fan[c].sum()) for c in chunks]
+
+    legacy, *_ = plan_ring(join, 64, n_dev, mass_balance=False)
+    balanced, *_ = plan_ring(join, 64, n_dev, mass_balance=True)
+    cat = np.concatenate([c for c in balanced])
+    assert np.array_equal(cat, np.arange(join.num_keys))  # still a partition
+    assert max(dev_mass(balanced)) < max(dev_mass(legacy))
+
+
+def test_ring_schedule_memo_distinguishes_mass_balance(monkeypatch):
+    """Review regression: the plan's memoized ring schedule keys on the
+    resolved mass-balance flag -- an in-process knob A/B must never be
+    served the other leg's schedule."""
+    a, b = _uniform()
+    p = plan(a, b, backend="xla", platform="cpu")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "1")
+    s_on = p.ring_schedule(b.nnzb, 4)
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "0")
+    s_off = p.ring_schedule(b.nnzb, 4)
+    assert s_on is not s_off
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "1")
+    assert p.ring_schedule(b.nnzb, 4) is s_on  # still memoized per leg
+
+
+def test_ring_mass_balance_result_unchanged(monkeypatch):
+    """The balance knob is pure load placement: ring results are
+    identical (and oracle-exact) with it on and off."""
+    from spgemm_tpu.parallel.ring import spgemm_ring
+
+    rng = np.random.default_rng(86)
+    a = powerlaw_block_sparse(24, 2, 3.0, rng, "small")
+    b = powerlaw_block_sparse(24, 2, 3.0, rng, "small")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "1")
+    on = spgemm_ring(a, b)
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "0")
+    off = spgemm_ring(a, b)
+    assert on.tiles.tobytes() == off.tiles.tobytes()
+    assert on == off == _oracle(a, b)

@@ -80,20 +80,38 @@ def test_fld_scope_is_path_based(tmp_path):
     assert [f.rule for f in lint_file(str(p), numeric=True)] == ["FLD"]
 
 
+def test_fld_estimator_module_in_numeric_scope():
+    """ops/estimate.py (the sampled planner estimator) is in the
+    numeric-lint scope: a jnp.sum smuggled into an estimator helper is a
+    finding -- and the LIVE module self-lints clean (its sizing sums carry
+    reasoned fld-proof escapes)."""
+    assert core.is_numeric_module("spgemm_tpu/ops/estimate.py")
+    findings = lint_file(os.path.join(FIXTURES, "ops", "estimate.py"))
+    assert [f.rule for f in findings] == ["FLD"]
+    assert "jnp.sum" in findings[0].message
+    live = lint_file(os.path.join(REPO, "spgemm_tpu", "ops", "estimate.py"))
+    assert live == [], "\n".join(
+        f"{f.file}:{f.line}: [{f.rule}] {f.message}" for f in live)
+
+
 # ------------------------------------------------------------- KNB rule --
 def test_knb_fixture_each_violation_caught():
     """Every READ spelling is a finding (the three classic ones plus the
-    seeded planner- and serve-knob reads); the write/del in the same
-    fixture (how harnesses and tests drive knob values) must NOT be."""
+    seeded planner-, serve-, and estimator-knob reads); the write/del in
+    the same fixture (how harnesses and tests drive knob values) must NOT
+    be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 9
+    assert [f.rule for f in findings] == ["KNB"] * 12
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
                    "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
                    "SPGEMM_TPU_PLAN_CACHE_CAP", "SPGEMM_TPU_SERVE_SOCKET",
                    "SPGEMM_TPU_SERVE_QUEUE_CAP",
                    "SPGEMM_TPU_SERVE_JOB_TIMEOUT",
-                   "SPGEMM_TPU_SERVE_WEDGE_GRACE_S"):
+                   "SPGEMM_TPU_SERVE_WEDGE_GRACE_S",
+                   "SPGEMM_TPU_PLAN_ESTIMATE",
+                   "SPGEMM_TPU_EST_SAMPLE_ROWS",
+                   "SPGEMM_TPU_EST_CONFIDENCE"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -220,10 +238,11 @@ def test_met_registry_covers_live_call_sites():
 
     for name in ("plan", "plan_wait", "numeric_dispatch", "assembly",
                  "ring_fold", "dcn_exchange", "serve_execute",
-                 "serve_queue_wait"):
+                 "serve_queue_wait", "estimate", "join_fallback"):
         assert name in ENGINE_PHASES
     for name in ("dispatches", "plan_cache_hits", "plan_cache_misses",
-                 "ring_steps", "serve_reaps", "serve_degrades"):
+                 "ring_steps", "serve_reaps", "serve_degrades",
+                 "est_hits", "est_fallbacks"):
         assert name in ENGINE_COUNTERS
 
 
@@ -504,12 +523,13 @@ def test_json_report_fixture_run():
     assert rc.returncode == 1, rc.stderr[-2000:]
     report = json.loads(rc.stdout)
     assert report["clean"] is False
-    # badknob: 3 classic + 2 planner-knob + 4 serve-knob reads;
-    # badbackend: 3 import-time touches; badplanner: 2 @host_only-body
-    # touches; FLD: 5 per-module + 2 interprocedural (callchain);
+    # badknob: 3 classic + 2 planner-knob + 4 serve-knob + 3
+    # estimator-knob reads; badbackend: 3 import-time touches;
+    # badplanner: 2 @host_only-body touches; FLD: 5 per-module + 2
+    # interprocedural (callchain) + 1 ops/estimate numeric-scope;
     # badthread/badexcept/stalesup: 3 each; badmetric: undeclared phase +
     # undeclared counter + computed name
-    assert report["counts"] == {"FLD": 7, "KNB": 9, "BKD": 5, "THR": 3,
+    assert report["counts"] == {"FLD": 8, "KNB": 12, "BKD": 5, "THR": 3,
                                 "EXC": 3, "MET": 3, "DOC": 1, "SUP": 3,
                                 "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
