@@ -64,7 +64,9 @@ bench:
 	$(PY) bench.py
 
 # spgemmd end-to-end proof on CPU: daemon up on a temp socket, two submits
-# of the same input (second must hit the warm plan cache), results
+# of the same input (second must hit the warm plan cache), then a third
+# submit with a handful of mutated tiles (must take the delta-recompute
+# path: 0 < delta_rows < total_rows in its status detail), all results
 # bit-exact vs the oracle, clean shutdown; exits nonzero on any step.
 serve-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -7,6 +7,8 @@ required to stay vectorized -- no per-key Python.  Target: < 1 s wall at
 1e5 keys x 8 devices.
 
 Pure host-side numpy -- no jax backend is touched, safe to run anywhere.
+Exception: `--delta` runs the REAL engine on the pinned CPU backend (it
+times end-to-end multiplies, which no host-only harness can).
 
 Usage: python benchmarks/planner_bench.py [--keys 100000] [--devices 8]
 Prints one JSON line: {"metric": "plan_ring_wall", "value": ..., ...}
@@ -127,6 +129,109 @@ def _cold_structure_detail(args) -> dict:
     }}
 
 
+def _delta_detail(args) -> dict:
+    """--delta: end-to-end incremental-recompute A/B (ops/delta) at the
+    --keys scale.  One banded operand pair executes on the CPU backend;
+    per dirty fraction, successive submits mutate that fraction of A's
+    tile-rows (values only -- structure untouched) and the delta-path
+    wall (digest diff + row-sliced sub-execute + splice) is timed against
+    the SPGEMM_TPU_DELTA=0 full-recompute wall of the same mutated
+    multiply.  Bit-exactness is tier-1's job (tests/test_delta.py); this
+    mode measures the win and reports the recomputed-row counts so the
+    sub-linear scaling is auditable in the JSON line."""
+    from spgemm_tpu.utils.backend_probe import pin
+
+    pin("cpu")
+    from spgemm_tpu.ops import delta, plancache
+    from spgemm_tpu.ops.spgemm import spgemm_device
+    from spgemm_tpu.utils import knobs
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+    from spgemm_tpu.utils.gen import banded_block_sparse
+
+    k = args.delta_k
+    rng = np.random.default_rng(7)
+    # band 2 -> ~5 blocks/row, product band 4 -> ~9 keys/row: block_dim
+    # sized so the product carries ~args.keys output keys
+    block_dim = max(8, args.keys // 9)
+    a = banded_block_sparse(block_dim, k, 2, rng, "small")
+    b = banded_block_sparse(block_dim, k, 2, rng, "small")
+    n_rows = len(np.unique(a.coords[:, 0]))
+
+    def mutate(m, frac: float, seed: int) -> BlockSparseMatrix:
+        """Bump one element in every tile of `frac` of m's tile-rows --
+        values change, structure (and so the plan fingerprint) does
+        not."""
+        rng2 = np.random.default_rng(seed)
+        rows = np.unique(m.coords[:, 0])
+        n_dirty = max(1, int(round(frac * len(rows))))
+        dirty = rng2.choice(rows, size=n_dirty, replace=False)
+        tiles = m.tiles.copy()
+        mask = np.isin(m.coords[:, 0], dirty)
+        tiles[mask, 0, 0] += np.uint64(1)
+        return BlockSparseMatrix(rows=m.rows, cols=m.cols, k=m.k,
+                                 coords=m.coords, tiles=tiles)
+
+    def timed(mat) -> float:
+        t0 = time.perf_counter()
+        spgemm_device(mat, b).block_until_ready()
+        return time.perf_counter() - t0
+
+    prev = (None if knobs.source("SPGEMM_TPU_DELTA") != "env"
+            else "1" if knobs.get("SPGEMM_TPU_DELTA") else "0")
+    fractions = []
+    try:
+        # full-recompute leg: delta off; the first run warms jit + plan
+        # cache so the timed best-of measures the serving-path numeric
+        # wall, fraction-independent
+        os.environ["SPGEMM_TPU_DELTA"] = "0"
+        plancache.clear()
+        delta.clear()
+        timed(a)  # warm compile + plan
+        full_s = float("inf")
+        for i in range(args.repeats):
+            full_s = min(full_s, timed(mutate(a, 0.1, 100 + i)))
+
+        # delta leg: per fraction, seed the entry with a full first
+        # contact, then mutate CUMULATIVELY (each submit dirties exactly
+        # its fraction relative to the previous one) and time the
+        # delta-path submits
+        os.environ["SPGEMM_TPU_DELTA"] = "1"
+        for frac in (0.01, 0.10, 0.50):
+            delta.clear()
+            cur = a
+            timed(cur)  # first contact: full path, seeds the entry
+            best, best_rows, best_total = float("inf"), 0, 0
+            for i in range(args.repeats):
+                cur = mutate(cur, frac, 1000 + 31 * i + int(frac * 1e4))
+                before = delta.stats()
+                wall = timed(cur)
+                after = delta.stats()
+                if wall < best:
+                    best = wall
+                    best_rows = (after["rows_recomputed"]
+                                 - before["rows_recomputed"])
+                    best_total = after["rows_total"] - before["rows_total"]
+            fractions.append({
+                "dirty_frac": frac,
+                "delta_wall_s": round(best, 6),
+                "full_wall_s": round(full_s, 6),
+                "speedup": round(full_s / best, 2) if best > 0 else None,
+                "rows_recomputed": int(best_rows),
+                "total_rows": int(best_total),
+            })
+    finally:
+        if prev is None:
+            try:
+                del os.environ["SPGEMM_TPU_DELTA"]
+            except KeyError:
+                pass
+        else:
+            os.environ["SPGEMM_TPU_DELTA"] = prev
+    return {"delta": {"keys": args.keys, "k": k, "rows": int(n_rows),
+                      "fractions": fractions,
+                      "store": delta.stats()}}
+
+
 def _repeat_structure_detail(args) -> dict:
     """--repeat-structure: time the structure-keyed plan cache's hit path
     (ops/plancache) against the cold plan, on a synthetic pair sized by
@@ -175,6 +280,18 @@ def main() -> int:
                         "estimator (SPGEMM_TPU_PLAN_ESTIMATE) on vs off -- "
                         "emits the detail.cold_plan block with the speedup "
                         "ratio")
+    p.add_argument("--delta", action="store_true",
+                   help="end-to-end delta-recompute A/B (ops/delta) on the "
+                        "CPU backend: delta-path wall vs full recompute "
+                        "across dirty fractions 1%%/10%%/50%% at the "
+                        "--keys scale -- emits the detail.delta block with "
+                        "per-fraction speedups and recomputed-row counts "
+                        "(the one mode of this bench that touches jax)")
+    p.add_argument("--delta-k", type=int, default=8,
+                   help="tile edge for the --delta mode's operands "
+                        "(default 8: heavy enough numeric work that the "
+                        "fold dominates the wall, CPU-tractable at the "
+                        "20k-key acceptance config)")
     args = p.parse_args()
     if args.repeats < 1:
         p.error("--repeats must be >= 1 (best-of timing needs a sample; "
@@ -200,6 +317,8 @@ def main() -> int:
         detail.update(_repeat_structure_detail(args))
     if args.cold_structure:
         detail.update(_cold_structure_detail(args))
+    if args.delta:
+        detail.update(_delta_detail(args))
     print(json.dumps({
         "metric": "plan_ring_wall", "value": round(ring_s, 4), "unit": "s",
         "vs_baseline": None,

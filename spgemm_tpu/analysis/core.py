@@ -32,6 +32,7 @@ NUMERIC_SUFFIXES = (
     "/ops/spgemm.py",
     "/ops/mxu_spgemm.py",
     "/ops/estimate.py",
+    "/ops/delta.py",
     "/parallel/ring.py",
     "/parallel/rowshard.py",
 )

@@ -123,7 +123,17 @@ def _make_planner(multiply, kwargs):
         # thread, so the join's cost overlaps device execution instead of
         # landing on the dispatch critical path (host-pure numpy -- the
         # @host_only contract holds)
-        return p.ensure_exact()
+        p.ensure_exact()
+        # delta planning rides the same worker: the per-tile-row content
+        # digests (ops/delta -- the diff's hash cost on host-reachable
+        # operands) are memoized on the operand objects here, so the
+        # dispatch-side diff is a lookup, not a hash pass (hashlib+numpy,
+        # host-pure like the rest of the planner)
+        from spgemm_tpu.ops import delta  # noqa: PLC0415
+        if delta.enabled():
+            delta.stash_digests(a)
+            delta.stash_digests(b)
+        return p
 
     return planner
 

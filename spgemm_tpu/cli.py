@@ -138,24 +138,25 @@ def run_knobs(argv: list[str]) -> int:
                                 "current value, default, and source")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable: {knobs: [one object per knob], "
-                        "plan_cache: live hit/miss/capacity stats, "
+                        "plan_cache: live hit/miss/eviction stats, "
                         "estimator: live est_hits/est_fallbacks routing "
-                        "stats}")
+                        "stats, delta: live incremental-recompute stats}")
     args = p.parse_args(argv)
     rows = knobs_registry.snapshot()
-    # live plan-cache + estimator state next to the knob rows (jax-free
-    # imports): the whole-engine A/B pairs (SPGEMM_TPU_PLAN_AHEAD=0|2,
-    # SPGEMM_TPU_PLAN_ESTIMATE=0|1) and the routing health (estimated vs
-    # exact-fallback plans) are inspectable together without a bench run
-    # or a metrics scrape
-    from spgemm_tpu.ops import estimate, plancache  # noqa: PLC0415
+    # live plan-cache + estimator + delta state next to the knob rows
+    # (jax-free imports): the whole-engine A/B pairs
+    # (SPGEMM_TPU_PLAN_AHEAD=0|2, SPGEMM_TPU_PLAN_ESTIMATE=0|1,
+    # SPGEMM_TPU_DELTA=0|1) and the routing health (estimated vs
+    # exact-fallback plans, delta-served vs full-fallback multiplies) are
+    # inspectable together without a bench run or a metrics scrape
+    from spgemm_tpu.ops import delta, estimate, plancache  # noqa: PLC0415
 
     try:
         cache = plancache.stats()
     except ValueError as e:
         # an INVALID cache-knob value must not abort the listing (the
         # per-knob rows above already carry the error); report it in place
-        cache = {"hits": 0, "misses": 0, "entries": 0,
+        cache = {"hits": 0, "misses": 0, "evictions": 0, "entries": 0,
                  "capacity": "?", "enabled": "?", "error": str(e)}
     try:
         est = estimate.stats()
@@ -163,11 +164,17 @@ def run_knobs(argv: list[str]) -> int:
         est = {"hits": 0, "fallbacks": 0, "enabled": "?",
                "sample_rows": "?", "confidence_threshold": "?",
                "error": str(e)}
+    try:
+        dlt = delta.stats()
+    except ValueError as e:
+        dlt = {"hits": 0, "full_fallbacks": 0, "evictions": 0,
+               "rows_recomputed": 0, "rows_total": 0, "entries": 0,
+               "capacity": "?", "enabled": "?", "error": str(e)}
     if args.as_json:
         import json  # noqa: PLC0415
 
         print(json.dumps({"knobs": rows, "plan_cache": cache,
-                          "estimator": est}, indent=2))
+                          "estimator": est, "delta": dlt}, indent=2))
         return 0
     name_w = max(len(r["name"]) for r in rows)
     val_w = max(len(r["value"]) for r in rows)
@@ -181,6 +188,7 @@ def run_knobs(argv: list[str]) -> int:
             print(f"{'':<{name_w}}  {r['doc']}  [{r['module']}]")
         enabled = cache["enabled"]
         print(f"plan cache: hits={cache['hits']} misses={cache['misses']} "
+              f"evictions={cache.get('evictions', 0)} "
               f"entries={cache['entries']}/{cache['capacity']} "
               f"enabled={enabled if enabled == '?' else int(enabled)}"
               "  [ops/plancache.py]")
@@ -195,6 +203,15 @@ def run_knobs(argv: list[str]) -> int:
               "  [ops/estimate.py]")
         if est.get("error"):
             print(f"  !! {est['error']}")
+        d_on = dlt["enabled"]
+        print(f"delta:      hits={dlt['hits']} "
+              f"full_fallbacks={dlt['full_fallbacks']} "
+              f"rows={dlt['rows_recomputed']}/{dlt['rows_total']} "
+              f"entries={dlt['entries']}/{dlt['capacity']} "
+              f"enabled={d_on if d_on == '?' else int(d_on)}"
+              "  [ops/delta.py]")
+        if dlt.get("error"):
+            print(f"  !! {dlt['error']}")
     except BrokenPipeError:
         # `spgemm_tpu knobs | head` closing the pipe is not an error for a
         # listing; swap in devnull so the interpreter's exit flush of
@@ -251,6 +268,22 @@ def run(argv: list[str] | None = None) -> int:
         return _subcommands()[argv[0]](argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
+    # delta retention (ops/delta) pays off only when the process outlives
+    # the submit (spgemmd keeps it warm across jobs); a run-once
+    # invocation would pay the per-multiply digest + result-retention
+    # cost for a store it throws away at exit -- pin it off unless the
+    # operator exported the knob explicitly, restore-scoped so
+    # in-process callers (tests) never leak the pin
+    restore = knobs_registry.pin_unless_exported("SPGEMM_TPU_DELTA", "0")
+    try:
+        return _run_chain(args)
+    finally:
+        restore()
+
+
+def _run_chain(args) -> int:
+    """The reference-contract chain run (see run()); split out so the
+    delta-knob pin above can wrap it in one try/finally."""
     if (args.stream or args.out_of_core) and args.shard in ("keys", "inner", "ring"):
         print(f"--shard {args.shard} already keeps chain partials host-"
               "resident; --out-of-core per-round staging does not apply to "

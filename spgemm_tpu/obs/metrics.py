@@ -41,6 +41,10 @@ ENGINE_PHASES: dict[str, str] = {
     "ring_hop": "one-hop ring wire probe",
     "ring_fold": "per-slab ring fold",
     "dcn_exchange": "multihost partial exchange over DCN",
+    "delta_diff": "delta path: row digests + content diff + join "
+                  "reachability (ops/delta)",
+    "delta_splice": "delta path: recomputed-row splice into the retained "
+                    "previous result",
     "serve_queue_wait": "spgemmd: submit-to-execution queue wait",
     "serve_execute": "spgemmd: one job's executor span",
 }
@@ -54,6 +58,17 @@ ENGINE_COUNTERS: dict[str, str] = {
     "dcn_chunks": "bounded DCN exchange chunks shipped",
     "plan_cache_hits": "structure-keyed plan cache hits",
     "plan_cache_misses": "structure-keyed plan cache misses",
+    "plan_cache_evictions": "structure-keyed plan cache LRU evictions "
+                            "(capacity pressure -- invisible before "
+                            "delta retention made it matter)",
+    "delta_rows_recomputed": "output tile-rows re-folded by "
+                             "delta-enabled multiplies (a full "
+                             "fallback counts every row)",
+    "delta_rows_total": "total output tile-rows seen by delta-enabled "
+                        "multiplies (the recompute ratio's denominator)",
+    "delta_full_fallbacks": "delta-enabled multiplies that took the full "
+                            "path (first contact, provenance mismatch, "
+                            "store eviction)",
     "est_hits": "estimator-routed plans (exact join deferred off the "
                 "critical path)",
     "est_fallbacks": "estimator fallbacks to the inline exact join "
@@ -99,6 +114,10 @@ _METRICS = (
            "ops/plancache.py"),
     Metric("spgemm_plan_cache_misses_total", "counter",
            "Structure-keyed plan cache misses since process start.",
+           "ops/plancache.py"),
+    Metric("spgemm_plan_cache_evictions_total", "counter",
+           "Structure-keyed plan cache LRU evictions since process "
+           "start.",
            "ops/plancache.py"),
     Metric("spgemm_plan_cache_entries", "gauge",
            "Plans currently retained in the LRU.",
@@ -263,6 +282,8 @@ def collect_engine() -> list[tuple]:
         samples += [
             ("spgemm_plan_cache_hits_total", {}, cache["hits"]),
             ("spgemm_plan_cache_misses_total", {}, cache["misses"]),
+            ("spgemm_plan_cache_evictions_total", {},
+             cache.get("evictions", 0)),
             ("spgemm_plan_cache_entries", {}, cache["entries"]),
             ("spgemm_plan_cache_capacity", {}, cache["capacity"]),
         ]

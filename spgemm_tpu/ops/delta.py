@@ -1,0 +1,450 @@
+"""Delta SpGEMM: row-granular incremental recompute for evolving inputs.
+
+The serving scenario that actually carries heavy traffic (ROADMAP north
+star) is repeated chain submits where one operand changes a FEW tiles
+between jobs -- graph updates.  The structure-keyed plan cache
+(ops/plancache, KokkosKernels-style symbolic reuse) already skips the
+planner on such repeats, but the NUMERIC phase still re-folded every
+output row from scratch.  This module closes that gap: the plan-cache
+content fingerprint, factored down to per-tile-row granularity
+(`row_digests`, hashing through the same `plancache.hash_update` step the
+whole-structure fingerprint uses), identifies WHICH input tile-rows
+changed, the cached exact join's pair lists identify which output
+tile-rows those can reach (`diff` -> reachability), and ops/spgemm then
+re-executes only the dirty output-row subset (a row-sliced sub-plan
+through the round-batched dispatch) and splices it into the retained
+previous result.
+
+Bit-exactness is by construction: the wrap-then-mod fold order
+(SURVEY.md 2.9) is a per-output-row property -- an output key's bytes are
+a pure function of the tiles its pair list touches, in j-ascending order.
+Untouched rows therefore keep their exact bytes, and dirty rows re-fold
+IN FULL with the exact same per-key pair lists the full plan would use
+(ops/symbolic.slice_join copies them whole).  `SPGEMM_TPU_DELTA=0|1`
+(default 1) is the whole-engine A/B: bit-identical either way, pinned by
+tests/test_delta.py and the hypothesis property test.
+
+Dirty-set provenance, per operand of a retained multiply:
+
+  * host-reachable tiles ("digest" source): per-tile-row sha256 content
+    digests, diffed against the previous submit's -- the LEAF operands of
+    a chain (the files a job re-reads every submit);
+  * a tagged partial ("tag" source): a multiply this module already
+    serves tags its result with (entry key, version, dirty output rows),
+    so the NEXT multiply in the chain consumes dirtiness analytically --
+    no D2H, no hashing -- as long as the version lineage matches;
+  * anything else ("opaque"): no way to prove what changed.
+
+ANY ambiguity -- first contact, changed structure (a different
+fingerprint never reaches the same entry), version lineage mismatch, an
+evicted entry, an opaque operand -- falls back LOUDLY to the full path
+(`delta_full_fallbacks` counter) and re-seeds the entry so the next
+same-structure multiply can go incremental.
+
+Host-only and jax-free: the retained result and the per-entry state are
+opaque objects here (ops/spgemm owns the device arrays and the splice);
+digesting runs on the chain plan-ahead worker when one exists
+(`stash_digests` -- the diff's hash cost overlaps device execution), and
+the module is in the numeric-lint FLD scope like the rest of the planner.
+
+Knobs (central registry, utils/knobs.py):
+  SPGEMM_TPU_DELTA        0|1 (default 1) -- 1 = same-structure repeats
+    recompute only reached output rows; 0 = always full recompute.
+  SPGEMM_TPU_DELTA_RETAIN int >= 1 (default 16) -- retained entries
+    (LRU); each pins one previous result's device planes, so the cap
+    bounds retention memory on the serving device.
+
+Live stats (`stats()`) ride next to the plan-cache/estimator rows in
+`spgemm_tpu.cli knobs [--json]` and in spgemmd `stats`; the engine
+mirrors the per-multiply accounting into the ENGINE registry
+(`delta_rows_recomputed`/`delta_rows_total`/`delta_full_fallbacks`
+counters, `delta_diff`/`delta_splice` phases).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+
+import numpy as np
+
+from spgemm_tpu.ops import plancache
+from spgemm_tpu.utils import knobs
+
+_LOCK = threading.Lock()
+_STORE: "OrderedDict[str, DeltaEntry]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
+_STATS = {"hits": 0, "full_fallbacks": 0, "evictions": 0,
+          "rows_recomputed": 0, "rows_total": 0}  # spgemm-lint: guarded-by(_LOCK)
+# Monotonic tag-version source, process-wide and NEVER reset (clear()
+# included): per-entry version counters would repeat after a store
+# eviction re-seeded an entry at version 1, and a consumer still holding
+# provenance for the OLD version 1 would then read an empty dirty set
+# from a tag that actually describes different bytes.  Unique-forever
+# versions make any lineage gap a (counted, correct) full fallback.
+_VERSION = 0  # spgemm-lint: guarded-by(_LOCK)
+
+
+def enabled() -> bool:
+    """SPGEMM_TPU_DELTA=0|1 (default 1)."""
+    return knobs.get("SPGEMM_TPU_DELTA")
+
+
+def capacity() -> int:
+    """SPGEMM_TPU_DELTA_RETAIN (default 16): retained entries (LRU).
+    Each entry pins one multiply's previous result (device arrays, via
+    the opaque `result` reference) plus the operand provenance, so the
+    cap bounds retained-result memory on the serving device; an evicted
+    entry just means the next same-structure multiply is a counted full
+    fallback.  Re-read per store so harnesses may resize mid-process."""
+    return knobs.get("SPGEMM_TPU_DELTA_RETAIN")
+
+
+def _next_version() -> int:
+    global _VERSION
+    with _LOCK:
+        _VERSION += 1
+        return _VERSION
+
+
+@dataclass
+class DeltaTag:
+    """Provenance a delta-served multiply attaches to its RESULT
+    (`_delta_tag` attribute): "this matrix is version `version` of entry
+    `key`, and differs from version `prev_version` exactly in the output
+    tile-rows `dirty_rows`".  The next multiply in the chain consumes it
+    as an analytic dirty set -- partials need no host tiles and no
+    hashing -- provided its stored lineage matches `prev_version`."""
+
+    key: str
+    version: int
+    prev_version: int
+    dirty_rows: np.ndarray
+
+
+@dataclass
+class DeltaEntry:
+    """Retained state of one multiply, keyed by its plan fingerprint
+    (structure + plan params -- ops/plancache).  Mutated only by the
+    executing thread (ops/spgemm.execute's single-thread contract), so
+    fields carry no lock; the store map itself is _LOCK-guarded."""
+
+    key: str
+    version: int
+    a_src: tuple   # ("digest", rows, digests) | ("tag", key, version) | ("opaque",)
+    b_src: tuple
+    result: object  # previous result (opaque: ops/spgemm owns its type)
+    out_rows: int   # distinct output tile-rows of this multiply
+
+
+@dataclass
+class DeltaDiff:
+    """One diff's verdict: which join keys must re-fold (`key_mask`, the
+    dirty output tile-rows expanded back over the key list), the dirty
+    output-row ids, and the refreshed operand provenance to store on
+    commit."""
+
+    key_mask: np.ndarray
+    dirty_rows: np.ndarray
+    new_a_src: tuple
+    new_b_src: tuple
+
+
+# -------------------------------------------------------- row digesting --
+def row_digests(coords: np.ndarray,
+                tiles: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-tile-row structure+content digests of one operand.
+
+    (row_ids, digests): one sha256 per distinct tile-row over that row's
+    coordinate slice and tile bytes, hashed through the SAME
+    `plancache.hash_update` step as the whole-structure fingerprint --
+    the two surfaces cannot drift on what "content" means.  Rows of equal
+    digest are byte-identical rows; a digest mismatch is the dirty set.
+    Coords must be lex-sorted by (row, col) -- the BlockSparseMatrix
+    invariant -- so each row is one contiguous slice."""
+    coords = np.ascontiguousarray(coords)
+    n = len(coords)
+    if n == 0:
+        return np.zeros(0, np.int64), np.zeros(0, dtype="S32")
+    rows = coords[:, 0]
+    row_ids, starts = np.unique(rows, return_index=True)
+    ends = np.append(starts[1:], n)
+    tiles = np.ascontiguousarray(tiles)
+    # schema header through the shared hash_update step (array dtypes +
+    # per-block shape, over zero-length prototypes); each row's digest is
+    # then a COPY of that state updated with the row's raw byte slices --
+    # one sha256 state copy + two buffer updates per row keeps the loop
+    # at hashing speed (the naive per-row ascontiguousarray/repr/tobytes
+    # round-trip was ~10x slower and showed up on the diff critical path)
+    base = hashlib.sha256()
+    plancache.hash_update(base, coords[:0])
+    plancache.hash_update(base, tiles[:0])
+    # zero-copy byte views (both arrays are contiguous by now): tobytes()
+    # would duplicate multi-GB operands on the diff critical path
+    cbuf = memoryview(coords).cast("B")
+    tbuf = memoryview(tiles).cast("B")
+    cs = len(cbuf) // n
+    ts = len(tbuf) // n
+    out = [b""] * len(row_ids)
+    for i, (s, e) in enumerate(zip(starts.tolist(), ends.tolist())):
+        h = base.copy()
+        h.update(cbuf[s * cs:e * cs])
+        h.update(tbuf[s * ts:e * ts])
+        out[i] = h.digest()
+    return row_ids, np.array(out, dtype="S32")
+
+
+def _host_view(m):
+    """(coords, tiles) of an operand's host-reachable content, or None.
+    A BlockSparseMatrix carries tiles directly; a DeviceBlockMatrix only
+    qualifies through its `_host` cache -- digesting must NEVER force a
+    D2H (partials without host copies use the tag channel instead)."""
+    tiles = getattr(m, "tiles", None)
+    if tiles is not None:
+        return m.coords, tiles
+    host = getattr(m, "_host", None)
+    if host is not None:
+        return host.coords, host.tiles
+    return None
+
+
+def _memo_target(m):
+    """The object the digest memo lives on: the HOST matrix when one is
+    reachable (a DeviceBlockMatrix is a fresh wrapper per upload, so a
+    memo on it would never be found again -- the chain plan-ahead worker
+    stashes on the host operand and dispatch later sees the wrapper)."""
+    if getattr(m, "tiles", None) is not None:
+        return m
+    return getattr(m, "_host", None) or m
+
+
+def stash_digests(m) -> None:
+    """Precompute an operand's row digests and stash them on the
+    host-bearing object (`_delta_digests`).  Called by the chain
+    plan-ahead worker so the diff's hash cost runs off the dispatch
+    critical path; host-pure (the @host_only worker contract), a no-op
+    for device-only partials.  The stash is SINGLE-USE: the multiply
+    that consumes it pops it (current_digests), so a long-lived operand
+    object whose tiles are later mutated IN PLACE can never be diffed
+    against a stale cached digest -- absent a stash, digests are always
+    computed fresh from the live tile bytes."""
+    view = _host_view(m)
+    if view is None:
+        return
+    try:
+        _memo_target(m)._delta_digests = row_digests(*view)
+    except AttributeError:
+        pass  # exotic operand types without a __dict__: just don't stash
+
+
+def current_digests(m):
+    """The operand's (row_ids, digests): the plan-ahead worker's stash if
+    one is pending (consumed -- see stash_digests), else computed fresh;
+    None when the tiles are not host-reachable."""
+    target = _memo_target(m)
+    memo = getattr(target, "_delta_digests", None)
+    if memo is not None:
+        try:
+            del target._delta_digests
+        except AttributeError:
+            pass
+        return memo
+    view = _host_view(m)
+    if view is None:
+        return None
+    return row_digests(*view)
+
+
+# ------------------------------------------------------------- the store --
+def lookup(key: str):
+    """Retained entry for a plan fingerprint, or None; a hit bumps MRU."""
+    with _LOCK:
+        entry = _STORE.get(key)
+        if entry is not None:
+            _STORE.move_to_end(key)
+        return entry
+
+
+def clear() -> None:
+    """Drop every entry and zero the stats (tests, A/B harnesses, bench
+    iterations -- a retained result would otherwise answer a re-run)."""
+    with _LOCK:
+        _STORE.clear()
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def stats() -> dict:
+    """Live per-process delta state for `spgemm_tpu.cli knobs [--json]`
+    and spgemmd stats: delta-served multiplies vs counted full fallbacks,
+    the cumulative recomputed/total output-row split, and store health."""
+    cap = capacity()
+    with _LOCK:
+        return {
+            "hits": _STATS["hits"],
+            "full_fallbacks": _STATS["full_fallbacks"],
+            "evictions": _STATS["evictions"],
+            "rows_recomputed": _STATS["rows_recomputed"],
+            "rows_total": _STATS["rows_total"],
+            "entries": len(_STORE),
+            "capacity": cap,
+            "enabled": enabled(),
+        }
+
+
+def _store_entry(entry: DeltaEntry) -> None:
+    cap = capacity()
+    with _LOCK:
+        _STORE[entry.key] = entry
+        _STORE.move_to_end(entry.key)
+        while len(_STORE) > cap:
+            _STORE.popitem(last=False)
+            _STATS["evictions"] += 1
+
+
+# ---------------------------------------------------------------- diffing --
+def _operand_dirty(src: tuple, m):
+    """Dirty tile-row set of operand m against its stored provenance, or
+    None when the lineage cannot be proven (-> full fallback).  Returns
+    (dirty_row_ids, refreshed_src)."""
+    if src[0] == "digest":
+        cur = current_digests(m)
+        if cur is None:
+            return None
+        row_ids, digs = cur
+        if not np.array_equal(src[1], row_ids):
+            return None  # defensive: same fingerprint implies same rows
+        return row_ids[src[2] != digs], ("digest", row_ids, digs)
+    if src[0] == "tag":
+        tag = getattr(m, "_delta_tag", None)
+        if tag is None or tag.key != src[1]:
+            return None
+        if tag.prev_version == src[2]:
+            dirty = np.asarray(tag.dirty_rows, np.int64)
+        elif tag.version == src[2]:
+            # the exact version this entry already consumed (a repeated
+            # call with the same partial object): nothing changed
+            dirty = np.zeros(0, np.int64)
+        else:
+            return None  # lineage gap (e.g. a run this entry missed)
+        return dirty, ("tag", tag.key, tag.version)
+    return None  # opaque: stored with no provable provenance
+
+
+def operand_src(m) -> tuple:
+    """Fresh provenance for storing an operand on the full path: prefer
+    the analytic tag (free), else content digests (host tiles), else
+    opaque -- which forces (counted) full recompute until a tag shows
+    up."""
+    tag = getattr(m, "_delta_tag", None)
+    if tag is not None:
+        return ("tag", tag.key, tag.version)
+    cur = current_digests(m)
+    if cur is not None:
+        return ("digest", *cur)
+    return ("opaque",)
+
+
+def reach(join_keys: np.ndarray, pair_ptr: np.ndarray, pair_a: np.ndarray,
+          pair_b: np.ndarray, a_coords: np.ndarray, b_coords: np.ndarray,
+          dirty_a_rows: np.ndarray,
+          dirty_b_rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Propagate input-row dirtiness through the exact join: a pair is
+    dirty iff its A tile or B tile lives in a dirty input tile-row; a key
+    is dirty iff any of its pairs is; and the recompute set rounds up to
+    whole OUTPUT tile-rows (the granularity the fold-order argument and
+    the reporting both use).  Returns (key_mask, dirty_output_rows)."""
+    num_keys = len(join_keys)
+    if num_keys == 0:
+        return np.zeros(0, bool), np.zeros(0, np.int64)
+    dirty_blk_a = np.isin(a_coords[:, 0], dirty_a_rows)
+    dirty_blk_b = np.isin(b_coords[:, 0], dirty_b_rows)
+    pair_dirty = dirty_blk_a[pair_a] | dirty_blk_b[pair_b]
+    hit = np.flatnonzero(pair_dirty)
+    key_dirty = np.zeros(num_keys, bool)
+    key_dirty[np.searchsorted(pair_ptr, hit, side="right") - 1] = True
+    dirty_rows = np.unique(join_keys[key_dirty, 0])
+    return np.isin(join_keys[:, 0], dirty_rows), dirty_rows
+
+
+def diff(entry: DeltaEntry, a, b, join, a_coords: np.ndarray,
+         b_coords: np.ndarray) -> DeltaDiff | None:
+    """Diff both operands against the entry's provenance and propagate
+    through the join; None on any lineage ambiguity (full fallback)."""
+    got_a = _operand_dirty(entry.a_src, a)
+    if got_a is None:
+        return None
+    got_b = _operand_dirty(entry.b_src, b)
+    if got_b is None:
+        return None
+    dirty_a, new_a_src = got_a
+    dirty_b, new_b_src = got_b
+    key_mask, dirty_rows = reach(join.keys, join.pair_ptr, join.pair_a,
+                                 join.pair_b, a_coords, b_coords,
+                                 dirty_a, dirty_b)
+    return DeltaDiff(key_mask=key_mask, dirty_rows=dirty_rows,
+                     new_a_src=new_a_src, new_b_src=new_b_src)
+
+
+# ---------------------------------------------------------------- commits --
+def _tag(result, key: str, version: int, prev_version: int,
+         dirty_rows: np.ndarray) -> None:
+    try:
+        result._delta_tag = DeltaTag(key=key, version=version,
+                                     prev_version=prev_version,
+                                     dirty_rows=dirty_rows)
+    except AttributeError:
+        pass  # a result type without a __dict__: downstream just falls back
+
+
+def commit(entry: DeltaEntry, result, d: DeltaDiff, out_rows: int) -> None:
+    """Land a delta-served multiply: refresh the entry in place (fresh
+    global version, new provenance, retained result) and tag the result
+    for the next multiply in the chain."""
+    prev_version = entry.version
+    entry.version = _next_version()
+    entry.a_src, entry.b_src = d.new_a_src, d.new_b_src
+    entry.result = result
+    entry.out_rows = out_rows
+    _store_entry(entry)
+    _tag(result, entry.key, entry.version, prev_version,
+         np.asarray(d.dirty_rows, np.int64))
+    with _LOCK:
+        _STATS["hits"] += 1
+        _STATS["rows_recomputed"] += len(d.dirty_rows)
+        _STATS["rows_total"] += out_rows
+
+
+def store_full(key: str, a, b, result, out_rows: int,
+               out_row_ids: np.ndarray) -> None:
+    """Land a full-path multiply (first contact / fallback): (re)seed the
+    entry so the NEXT same-structure multiply can go incremental, and tag
+    the result all-dirty against the previous version (a consumer holding
+    that version correctly re-folds everything this result may have
+    changed; any other lineage is a full fallback).
+
+    An operand with OPAQUE provenance (a device partial produced outside
+    the delta layer -- no tag, no host tiles) makes the entry undiffable
+    forever: nothing is stored and the result is NOT tagged, so the
+    retention can never pin a result it cannot serve, and downstream
+    multiplies honestly fall back instead of trusting a tag with no
+    verifiable lineage."""
+    with _LOCK:
+        prev = _STORE.get(key)
+        prev_version = prev.version if prev is not None else 0
+        _STATS["full_fallbacks"] += 1
+        _STATS["rows_recomputed"] += out_rows
+        _STATS["rows_total"] += out_rows
+    a_src = operand_src(a)
+    if a_src[0] == "opaque":
+        return
+    b_src = operand_src(b)
+    if b_src[0] == "opaque":
+        return
+    version = _next_version()
+    entry = DeltaEntry(key=key, version=version, a_src=a_src, b_src=b_src,
+                       result=result, out_rows=out_rows)
+    _store_entry(entry)
+    _tag(result, key, version, prev_version,
+         np.asarray(out_row_ids, np.int64))

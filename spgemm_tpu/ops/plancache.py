@@ -43,7 +43,7 @@ from spgemm_tpu.utils import knobs
 
 _LOCK = threading.Lock()
 _CACHE: "OrderedDict[str, object]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
-_STATS = {"hits": 0, "misses": 0}  # spgemm-lint: guarded-by(_LOCK)
+_STATS = {"hits": 0, "misses": 0, "evictions": 0}  # spgemm-lint: guarded-by(_LOCK)
 
 
 def enabled() -> bool:
@@ -57,22 +57,31 @@ def capacity() -> int:
     return knobs.get("SPGEMM_TPU_PLAN_CACHE_CAP")
 
 
+def hash_update(h, arr: np.ndarray) -> None:
+    """Feed one array (shape + dtype + raw bytes) into an open hashlib
+    digest -- THE shared content-hashing step: the whole-structure
+    fingerprint below and ops/delta's per-tile-row digests both hash
+    through this function, so the two surfaces can never drift on what
+    "content" means (shape + dtype ride along so two different-shape
+    arrays never collide through tobytes())."""
+    arr = np.ascontiguousarray(arr)
+    h.update(repr((arr.shape, str(arr.dtype))).encode())
+    h.update(arr.tobytes())
+    h.update(b"|")
+
+
 def fingerprint(a_coords: np.ndarray, b_coords: np.ndarray,
                 meta: tuple) -> str:
     """Content fingerprint of (operand structures, plan parameters).
 
-    Hashes the raw coordinate bytes (shape + dtype included -- two
-    different-shape arrays must never collide through tobytes()) plus the
-    repr of the caller's parameter tuple (k, sentinels, backend, platform,
+    Hashes the raw coordinate bytes (via hash_update) plus the repr of
+    the caller's parameter tuple (k, sentinels, backend, platform,
     round_size, batch flag, hybrid split threshold, jit-static knob
     vector).  sha256 over a few MB of coords is ~ms -- orders of magnitude
     under the join it saves."""
     h = hashlib.sha256()
     for arr in (a_coords, b_coords):
-        arr = np.ascontiguousarray(arr)
-        h.update(repr((arr.shape, str(arr.dtype))).encode())
-        h.update(arr.tobytes())
-        h.update(b"|")
+        hash_update(h, arr)
     h.update(repr(meta).encode())
     return h.hexdigest()
 
@@ -89,14 +98,22 @@ def lookup(key: str):
         return plan
 
 
-def store(key: str, plan) -> None:
-    """Insert (or refresh) a plan; evicts LRU entries past the cap."""
+def store(key: str, plan) -> int:
+    """Insert (or refresh) a plan; evicts LRU entries past the cap.
+    Returns the number of entries evicted -- the caller (ops/spgemm)
+    mirrors it into the ENGINE `plan_cache_evictions` counter, the same
+    split as the hit/miss pair (eviction pressure was invisible before
+    delta fingerprint retention made it matter)."""
     cap = capacity()
+    evicted = 0
     with _LOCK:
         _CACHE[key] = plan
         _CACHE.move_to_end(key)
         while len(_CACHE) > cap:
             _CACHE.popitem(last=False)
+            evicted += 1
+        _STATS["evictions"] += evicted
+    return evicted
 
 
 def stats() -> dict:
@@ -107,6 +124,7 @@ def stats() -> dict:
         return {
             "hits": _STATS["hits"],
             "misses": _STATS["misses"],
+            "evictions": _STATS["evictions"],
             "entries": len(_CACHE),
             "capacity": capacity(),
             "enabled": enabled(),
@@ -117,4 +135,4 @@ def clear() -> None:
     """Drop every entry and zero the stats (tests, A/B harnesses)."""
     with _LOCK:
         _CACHE.clear()
-        _STATS["hits"] = _STATS["misses"] = 0
+        _STATS["hits"] = _STATS["misses"] = _STATS["evictions"] = 0

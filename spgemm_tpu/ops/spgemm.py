@@ -31,7 +31,7 @@ from spgemm_tpu.ops import estimate, plancache, u64
 from spgemm_tpu.utils import knobs
 from spgemm_tpu.ops.symbolic import (SpgemmPlan, accept_round_stack,
                                      assembly_permutation, plan_rounds,
-                                     symbolic_join)
+                                     slice_join, symbolic_join)
 from spgemm_tpu.utils.backend_probe import host_only
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
 
@@ -529,7 +529,12 @@ def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
             build_exact(p, build_split=split)
         p.plan_s = time.perf_counter() - t0
         if key is not None:
-            plancache.store(key, p)
+            evicted = plancache.store(key, p)
+            if evicted:
+                # LRU pops were invisible before delta fingerprint
+                # retention made eviction pressure matter: mirror them
+                # into the engine registry like the hit/miss pair
+                timers.incr("plan_cache_evictions", evicted)
         return p
 
 
@@ -639,6 +644,169 @@ def execute(plan: SpgemmPlan, a, b):
                              val_bound=min(out_bound, (1 << 64) - 2))
 
 
+def subplan(parent: SpgemmPlan,
+            keep: np.ndarray) -> tuple[SpgemmPlan, np.ndarray]:
+    """Row-sliced sub-plan: the delta path's restriction of a cached plan
+    to the dirty output-key subset (boolean mask over the join's keys).
+
+    The sub-join copies each kept key's pair list whole and in order
+    (ops/symbolic.slice_join), and the rounds rebuild under the parent's
+    EXACT budgets and hybrid proof partition -- so a kept key folds
+    byte-identically to the full plan through the same round-batched
+    dispatch, only over fewer keys.  Host-pure; never cached (the dirty
+    subset changes per submit).  Returns (sub_plan, kept_key_indices) --
+    the indices are the splice scatter back into the full key list."""
+    from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
+
+    parent.ensure_exact()
+    sub_join, kept = slice_join(parent.join, keep)
+    max_entries, default_rs = _plan_budgets(parent.backend, parent.platform)
+    if parent.batch:
+        rounds = plan_rounds(sub_join, a_sentinel=parent.a_nnzb,
+                             b_sentinel=parent.b_nnzb,
+                             round_size=parent.round_size,
+                             max_entries=max_entries, batch=True,
+                             batch_entries=_batch_entries(parent.k),
+                             split_fanout=parent.split_fanout)
+        take = assembly_permutation(rounds, sub_join.num_keys)
+        # pad the assembly permutation to a 3/4-pow-2 rung: the dirty-key
+        # count drifts per submit, and an exact-length take would compile
+        # a fresh _assemble gather every time (the padding rows read the
+        # appended zero row -- take's sentinel slot -- and only the first
+        # num_keys rows of the output planes are ever consumed)
+        pad = _shape_class(len(take)) - len(take)
+        if pad:
+            take = np.concatenate([take, np.full(pad, take[-1], take.dtype)])
+    else:
+        rs = default_rs if parent.round_size is None else parent.round_size
+        rounds = plan_rounds(sub_join, a_sentinel=parent.a_nnzb,
+                             b_sentinel=parent.b_nnzb, round_size=rs,
+                             max_entries=max_entries)
+        take = None
+    sub = SpgemmPlan(backend=parent.backend, platform=parent.platform,
+                     k=parent.k, a_nnzb=parent.a_nnzb,
+                     b_nnzb=parent.b_nnzb, join=sub_join, rounds=rounds,
+                     take=take, batch=parent.batch,
+                     round_size=parent.round_size,
+                     split_fanout=parent.split_fanout,
+                     _a_coords=parent._a_coords,
+                     _b_coords=parent._b_coords)
+    return sub, kept
+
+
+@jax.jit
+def _splice(prev_hi, prev_lo, idx, take, sub_hi, sub_lo):
+    """Delta splice: scatter the recomputed rows (gathered through
+    `take`) into the retained previous planes at `idx`.  One fused
+    executable; idx/take are ladder-padded by the caller (pad slots
+    scatter the sub result's zero row onto the retained sentinel row --
+    zeros onto zeros), so the compiled-shape count stays logarithmic as
+    the dirty-key count drifts across submits."""
+    return prev_hi.at[idx].set(sub_hi[take]), prev_lo.at[idx].set(sub_lo[take])
+
+
+def _delta_key(plan: SpgemmPlan, a, b) -> str:
+    """The delta store key: the plan's structure fingerprint QUALIFIED by
+    both operands' device placements.  The fingerprint alone is placement
+    blind, and an in-process multi-device scheduler (parallel/chainpart
+    runs one same-structure chain per rank) would otherwise be served a
+    retained result living on ANOTHER rank's device -- the next multiply
+    then dies on a mixed-device dispatch.  Per-placement keys keep each
+    rank's delta stream independent (and each rank gets the win)."""
+    ids_a = sorted(d.id for d in a.hi.devices())
+    ids_b = sorted(d.id for d in b.hi.devices())
+    return f"{plan.fingerprint}|dev{ids_a}x{ids_b}"
+
+
+def _delta_execute(plan: SpgemmPlan, a, b):
+    """Delta SpGEMM (ops/delta): incremental execute for a plan whose
+    structure fingerprint has been seen before.
+
+    diff -> reach -> slice -> splice: per-tile-row content digests (or
+    the producer's analytic dirty tag) identify the changed input rows,
+    the cached exact join propagates them to the reachable OUTPUT
+    tile-rows, a row-sliced sub-plan re-executes exactly those through
+    the normal dispatch, and the recomputed rows splice into the retained
+    previous result on device.  Untouched rows keep their previous bytes
+    -- bit-exact because an output key's fold is a pure function of its
+    pair list's tiles in j-ascending order, which slice_join preserves.
+
+    Every ambiguity (first contact, provenance mismatch, store eviction)
+    is a counted full fallback that re-seeds the retained entry."""
+    from spgemm_tpu.ops import delta  # noqa: PLC0415
+    from spgemm_tpu.ops.device import DeviceBlockMatrix  # noqa: PLC0415
+    from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
+
+    plan.ensure_exact()
+    join = plan.join
+    key = _delta_key(plan, a, b)
+    entry = delta.lookup(key)
+    d = None
+    if entry is not None:
+        with timers.phase("delta_diff"):
+            d = delta.diff(entry, a, b, join, plan._a_coords,
+                           plan._b_coords)
+    if d is None:
+        # first contact / provenance mismatch / store eviction: the full
+        # path, loudly counted, and the entry (re)seeded so the next
+        # same-structure multiply can go incremental
+        out_row_ids = np.unique(join.keys[:, 0]) if join.num_keys \
+            else np.zeros(0, np.int64)
+        total_rows = len(out_row_ids)
+        timers.incr("delta_full_fallbacks")
+        timers.incr("delta_rows_recomputed", total_rows)
+        timers.incr("delta_rows_total", total_rows)
+        result = execute(plan, a, b)
+        with timers.phase("delta_diff"):
+            delta.store_full(key, a, b, result, total_rows, out_row_ids)
+        return result
+    # diffed against a live entry: its out_rows IS this join's distinct
+    # output-row count (same fingerprint, same structure) -- no per-call
+    # np.unique on the hot path
+    total_rows = entry.out_rows
+    n_dirty = len(d.dirty_rows)
+    timers.incr("delta_rows_recomputed", n_dirty)
+    timers.incr("delta_rows_total", total_rows)
+    if n_dirty == 0:
+        # empty diff: the retained result IS this multiply's result (the
+        # digests/tags prove both operands byte-identical to last time)
+        result = entry.result
+    elif n_dirty >= total_rows:
+        # all-dirty degenerates to the full path (no slicing overhead)
+        result = execute(plan, a, b)
+    else:
+        from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
+
+        sub_plan, kept = subplan(plan, d.key_mask)
+        sub = execute(sub_plan, a, b)
+        with timers.phase("delta_splice"):
+            prev = entry.result
+            n_sub = len(kept)
+            # ladder-pad the scatter like the sub-plan's assembly: pad
+            # slots write the sub result's zero row (index n_sub) onto
+            # the retained sentinel row (index num_keys) -- zeros onto
+            # zeros -- so the jitted splice compiles per rung, not per
+            # dirty-key count
+            rung = _shape_class(n_sub)
+            idx = np.full(rung, join.num_keys, np.int64)
+            idx[:n_sub] = kept
+            gather = np.full(rung, n_sub, np.int64)
+            gather[:n_sub] = np.arange(n_sub)
+            out_hi, out_lo = _splice(prev.hi, prev.lo, jnp.asarray(idx),
+                                     jnp.asarray(gather), sub.hi, sub.lo)
+            cap = (1 << 64) - 2
+            vb = max(prev.val_bound if prev.val_bound is not None else cap,
+                     sub.val_bound if sub.val_bound is not None else cap)
+            result = DeviceBlockMatrix(rows=a.rows, cols=b.cols, k=plan.k,
+                                       coords=join.keys, hi=out_hi,
+                                       lo=out_lo, val_bound=min(vb, cap))
+        log.info("spgemm[delta]: recomputed %d/%d output rows "
+                 "(%d/%d keys)", n_dirty, total_rows, n_sub,
+                 join.num_keys)
+    delta.commit(entry, result, d, total_rows)
+    return result
+
+
 _plan = plan  # module-level alias: spgemm_device's `plan` kwarg shadows it
 
 
@@ -668,6 +836,13 @@ def spgemm_device(a, b, *, round_size: int | None = None,
     if plan is None:
         with timers.phase("plan_wait"):
             plan = _plan(a, b, round_size=round_size, backend=backend)
+    # delta recompute (ops/delta): a fingerprinted plan whose structure
+    # was multiplied before re-executes only the output rows the changed
+    # input rows can reach, splicing into the retained previous result --
+    # bit-identical to the full path (SPGEMM_TPU_DELTA=0 is the A/B)
+    from spgemm_tpu.ops import delta  # noqa: PLC0415
+    if delta.enabled() and plan.fingerprint is not None:
+        return _delta_execute(plan, a, b)
     return execute(plan, a, b)
 
 

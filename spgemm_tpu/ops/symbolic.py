@@ -180,6 +180,29 @@ def symbolic_join(a_coords: np.ndarray, b_coords: np.ndarray) -> JoinResult:
                       pair_a=a_slot.astype(np.int32), pair_b=b_slot.astype(np.int32))
 
 
+def slice_join(join: JoinResult,
+               keep: np.ndarray) -> tuple[JoinResult, np.ndarray]:
+    """Row-sliced sub-join: restrict to the keys selected by the boolean
+    mask `keep`, copying each kept key's pair list WHOLE and in order.
+
+    The delta-recompute path (ops/delta) re-executes only the dirty
+    output rows; its bit-exactness rests on this function preserving the
+    reference's per-key j-ascending pair order exactly -- a kept key folds
+    identically under the sliced plan and the full plan, because its pair
+    list is byte-identical.  Returns (sub_join, kept_key_indices), the
+    indices mapping sub-join rows back into the full key list (the splice
+    scatter)."""
+    kept = np.flatnonzero(keep)
+    lens = join.fanouts[kept]
+    ptr = np.zeros(len(kept) + 1, np.int64)
+    np.cumsum(lens, out=ptr[1:])
+    _, offs = _segment_expand(lens)
+    src = np.repeat(join.pair_ptr[kept], lens) + offs
+    return JoinResult(keys=join.keys[kept], pair_ptr=ptr,
+                      pair_a=join.pair_a[src],
+                      pair_b=join.pair_b[src]), kept
+
+
 @dataclass
 class Round:
     """One fixed-shape numeric launch: <= round_size keys, all padded to the

@@ -438,8 +438,16 @@ class Daemon:
         return {"phases_s": scope.snapshot(), "degraded": degraded,
                 "plan_cache_hits": counters.get("plan_cache_hits", 0),
                 "plan_cache_misses": counters.get("plan_cache_misses", 0),
+                # the delta-recompute ratio (ops/delta): output tile-rows
+                # this job actually re-folded vs carried over from the
+                # retained previous results -- a second submit of a
+                # mostly-unchanged input reports delta_rows << total_rows
+                "delta_rows": counters.get("delta_rows_recomputed", 0),
+                "total_rows": counters.get("delta_rows_total", 0),
                 **{k: v for k, v in counters.items()
-                   if k not in ("plan_cache_hits", "plan_cache_misses")}}
+                   if k not in ("plan_cache_hits", "plan_cache_misses",
+                                "delta_rows_recomputed",
+                                "delta_rows_total")}}
 
     def _reap_detail(self, job: Job) -> dict | None:
         """Best-effort per-job detail for a watchdog-reaped job, from the
@@ -811,12 +819,16 @@ class Daemon:
                 "bytes": size, "compactions": compactions}
 
     def _op_stats(self) -> dict:
-        from spgemm_tpu.ops import plancache  # noqa: PLC0415
+        from spgemm_tpu.ops import delta, plancache  # noqa: PLC0415
 
         try:
             cache = plancache.stats()
         except ValueError as e:
             cache = {"error": str(e)}
+        try:
+            delta_stats = delta.stats()
+        except ValueError as e:
+            delta_stats = {"error": str(e)}
         with self._lock:
             degraded = self.degraded
             degrade_reason = self.degrade_reason
@@ -840,6 +852,7 @@ class Daemon:
             trace=obs_trace.RECORDER.stats(),
             flight_dir=self.flight_dir,
             plan_cache=cache,
+            delta=delta_stats,
             socket=self.socket_path,
         )
 

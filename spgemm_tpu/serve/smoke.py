@@ -1,12 +1,17 @@
 """`make serve-smoke`: end-to-end spgemmd proof on the CPU backend.
 
 Starts a real daemon subprocess on a temp socket with `--device cpu`,
-submits the SAME tiny chain twice, and asserts the serving contract:
+submits the SAME tiny chain twice, then a THIRD submit with a handful of
+tiles mutated in one operand, and asserts the serving contract:
 
-  * both results are byte-exact against the host-only oracle multiply;
+  * all results are byte-exact against the host-only oracle multiply
+    (job 3 against the oracle of the MUTATED chain);
   * the second job's status detail reports `plan_cache_hits >= 1` -- the
     warm-across-jobs proof the daemon exists for (a run-once CLI would
     re-plan from scratch);
+  * the third job's status detail reports `0 < delta_rows < total_rows`
+    -- the delta-recompute proof (ops/delta): a mostly-unchanged submit
+    re-folds only the output rows the dirty tiles reach;
   * stats reports a healthy (non-degraded) daemon;
   * shutdown is clean (daemon exits 0, socket unlinked).
 
@@ -87,6 +92,38 @@ def main() -> int:
             return _fail(proc, "second submit reported plan_cache_hits="
                                f"{hits}; the daemon's plan cache is cold "
                                "across jobs")
+
+        # third submit: mutate a handful of tiles in ONE operand (values
+        # only -- structure untouched), recompute the oracle, and prove
+        # the delta path engaged: bit-exact output with only the reached
+        # output rows re-folded (ops/delta)
+        m0 = mats[0]
+        tiles = m0.tiles.copy()
+        tiles[0] = tiles[0] + np.uint64(1)  # one tile-row goes dirty
+        mats[0] = BlockSparseMatrix(rows=m0.rows, cols=m0.cols, k=k,
+                                    coords=m0.coords, tiles=tiles)
+        io_text.write_matrix(os.path.join(folder, "matrix1"), mats[0])
+        want3 = chain_oracle([m.to_dict() for m in mats], k)
+        want3_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+            mats[0].rows, mats[-1].cols, k, want3).prune_zeros())
+        out3 = os.path.join(tmp, "matrix.3")
+        resp = client.submit(folder, sock, {"output": out3})
+        resp = client.wait(resp["id"], sock, timeout=300)
+        job3 = resp["job"]
+        if job3["state"] != "done":
+            return _fail(proc, f"job 3 ended {job3['state']}: "
+                               f"{job3['error']}")
+        if open(out3, "rb").read() != want3_bytes:
+            return _fail(proc, "job 3 (mutated input) output does not "
+                               "match the oracle bytes")
+        delta_rows = job3["detail"].get("delta_rows", 0)
+        total_rows = job3["detail"].get("total_rows", 0)
+        if not 0 < delta_rows < total_rows:
+            return _fail(proc, "third submit did not take the delta "
+                               f"path: delta_rows={delta_rows} "
+                               f"total_rows={total_rows} (want "
+                               "0 < delta_rows < total_rows)")
+
         st = client.stats(sock)
         if st.get("degraded"):
             return _fail(proc, f"daemon reports degraded: "
@@ -104,8 +141,8 @@ def main() -> int:
     finally:
         if proc.poll() is None:
             proc.kill()
-    print(f"serve-smoke: OK (2 jobs bit-exact vs oracle, warm hits={hits}, "
-          "clean shutdown)")
+    print(f"serve-smoke: OK (3 jobs bit-exact vs oracle, warm hits={hits}, "
+          f"delta rows {delta_rows}/{total_rows}, clean shutdown)")
     return 0
 
 

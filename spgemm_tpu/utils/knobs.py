@@ -97,6 +97,31 @@ _KNOBS = (
          "Plan-cache LRU capacity (plans retained per process; a plan "
          "holds its padded pa/pb index arrays, ~8 bytes per tile pair).",
          "ops/plancache.py", default="32", minimum=1),
+    Knob("SPGEMM_TPU_DELTA", "bool01",
+         "Delta SpGEMM (row-granular incremental recompute): 1 = a "
+         "multiply whose structure fingerprint was seen before diffs "
+         "per-tile-row content digests (or the producer's dirty tag) "
+         "against the previous submit, re-executes only the output "
+         "tile-rows the changed input rows reach, and splices them into "
+         "the retained previous result (device memory for up to "
+         "SPGEMM_TPU_DELTA_RETAIN retained results); 0 = always full "
+         "recompute "
+         "-- the whole-engine A/B, bit-identical either way (untouched "
+         "rows keep their exact bytes; dirty rows re-fold in full).  "
+         "Ambiguity (first contact, structure change, store eviction) "
+         "falls back loudly to the full path.  The run-once CLI, "
+         "bench.py, and benchmarks/run.py pin it off unless exported: "
+         "retention only pays where the process outlives the submit "
+         "(spgemmd).",
+         "ops/delta.py", default="1"),
+    Knob("SPGEMM_TPU_DELTA_RETAIN", "int",
+         "Delta store capacity in ENTRIES (LRU, one per multiply "
+         "structure): each entry pins its previous result's device "
+         "planes, so retention memory is bounded by this count TIMES the "
+         "largest result -- size the cap (or set SPGEMM_TPU_DELTA=0) for "
+         "the deployment's result scale; an evicted entry makes the next "
+         "same-structure multiply a counted full fallback.",
+         "ops/delta.py", default="16", minimum=1),
     Knob("SPGEMM_TPU_PLAN_ESTIMATE", "bool01",
          "Sampled structure estimator for first-contact plans: 1 = a "
          "bounded row sample predicts output nnz/fanout/mass, the plan "
@@ -277,6 +302,30 @@ def get(name: str):
         if raw is None:
             return None
     return _parse(kb, raw)
+
+
+def pin_unless_exported(name: str, value: str):
+    """Write-through-environ harness pin: set registered knob `name` to
+    `value` UNLESS the operator exported it (an explicit env value always
+    wins).  Returns a zero-arg restore callable (a no-op when nothing was
+    pinned) -- in-process callers (the run-once CLI, tests) wrap their
+    work in try/finally so the pin never leaks; process-scoped harnesses
+    (bench.py, benchmarks/run.py) may discard it.  THE one definition of
+    the idiom: env writes are the blessed harness channel (KNB lints
+    reads only), and the exported-or-not check goes through the
+    registry."""
+    kb = REGISTRY[name]  # registering is the price of pinning
+    assert kb.kind != "flag", "flag knobs have no pinnable value form"
+    if source(name) == "env":
+        return lambda: None
+    os.environ[name] = value
+
+    def restore() -> None:
+        try:
+            del os.environ[name]
+        except KeyError:
+            pass
+    return restore
 
 
 def source(name: str) -> str:

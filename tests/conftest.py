@@ -24,6 +24,23 @@ jax.config.update("jax_platforms", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_delta_store():
+    """The delta-recompute store (ops/delta) retains previous results
+    keyed by structure fingerprint, process-wide: without a per-test
+    clear, a test re-running a structure another test already multiplied
+    would be answered from the retained result (content digests are
+    value-exact, so results stay CORRECT -- but dispatch-count and
+    phase assertions would observe the delta path instead of the engine
+    under test)."""
+    from spgemm_tpu.ops import delta
+
+    delta.clear()
+    yield
+
 
 def run_repo_script(args, timeout=240, **env_overrides):
     """Subprocess runner shared by tests that drive repo entry points

@@ -47,6 +47,15 @@ def bad_estimator_knob_reads():
     return on, rows, conf
 
 
+def bad_delta_knob_reads():
+    # the delta-recompute knobs are registry knobs like any other: raw
+    # reads are KNB findings (registered in utils/knobs.py, read via
+    # knobs.get in ops/delta.py)
+    on = os.getenv("SPGEMM_TPU_DELTA", "1")  # seeded KNB
+    cap = os.environ.get("SPGEMM_TPU_DELTA_RETAIN")  # seeded KNB
+    return on, cap
+
+
 def legal_non_knob_reads():
     # non-SPGEMM_TPU names are not knobs: raw access stays legal
     return os.environ.get("JAX_PLATFORMS", ""), os.getenv("HOME")

@@ -87,6 +87,34 @@ def test_planner_bench_repeat_structure_contract():
     assert detail["plan_cache"]["hits"] >= 1
 
 
+def test_planner_bench_delta_contract():
+    """benchmarks/planner_bench.py --delta: the same one-JSON-line
+    contract, with a detail.delta block whose recomputed-row counts scale
+    with the dirty fraction (tiny CPU config; the 20k-key acceptance run
+    is manual)."""
+    rc = _run([os.path.join("benchmarks", "planner_bench.py"),
+               "--keys", "500", "--repeats", "2", "--delta",
+               "--delta-k", "4"],
+              SPGEMM_TPU_DELTA="")  # the mode manages the knob itself
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads(rc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "plan_ring_wall"
+    d = row["detail"]["delta"]
+    assert d["keys"] == 500 and d["rows"] > 0
+    fr = d["fractions"]
+    assert [f["dirty_frac"] for f in fr] == [0.01, 0.10, 0.50]
+    for f in fr:
+        assert f["delta_wall_s"] > 0 and f["full_wall_s"] > 0
+        assert f["speedup"] is not None
+        assert 0 < f["rows_recomputed"] <= f["total_rows"]
+    # recompute volume tracks the dirty fraction (sub-linear scaling's
+    # audit trail), and the small fractions genuinely recompute a subset
+    assert (fr[0]["rows_recomputed"] <= fr[1]["rows_recomputed"]
+            <= fr[2]["rows_recomputed"])
+    assert fr[0]["rows_recomputed"] < fr[0]["total_rows"]
+    assert fr[1]["rows_recomputed"] < fr[1]["total_rows"]
+
+
 def test_bench_single_chain_no_crash():
     rc = _run(["bench.py", "--chain", "1", "--block-dim", "8",
                "--bandwidth", "1", "--k", "8", "--iters", "1",

@@ -1,0 +1,313 @@
+"""Delta SpGEMM (PR 9 tentpole, ops/delta): row-granular incremental
+recompute for evolving inputs.
+
+The standing contracts:
+  * delta on/off is a bit-identical whole-engine A/B: untouched output
+    rows keep their exact bytes, dirty rows re-fold in full
+    (SPGEMM_TPU_DELTA=0|1);
+  * the empty diff executes NOTHING (zero dispatches) and the all-dirty
+    diff degenerates to the full path -- both byte-exact;
+  * recompute volume tracks the dirty fraction (the delta_rows_* ENGINE
+    counters are the audit trail);
+  * every ambiguity -- first contact, store eviction, provenance
+    mismatch -- is a counted full fallback, never a wrong answer;
+  * dirtiness propagates through a chain analytically (the producer's
+    tag), so pass >= 1 partials need neither host tiles nor hashing.
+"""
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.chain import chain_product
+from spgemm_tpu.ops import delta, plancache
+from spgemm_tpu.ops.spgemm import plan, spgemm, spgemm_device, subplan
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_block_sparse, random_chain
+from spgemm_tpu.utils.semantics import chain_oracle, spgemm_oracle
+from spgemm_tpu.utils.timers import ENGINE
+
+
+def _oracle(a, b):
+    return BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+
+
+def _mutate_rows(m: BlockSparseMatrix, rows) -> BlockSparseMatrix:
+    """Same structure, new VALUES in the given tile-rows (every tile of
+    those rows gets one element bumped)."""
+    tiles = m.tiles.copy()
+    mask = np.isin(m.coords[:, 0], np.asarray(list(rows), np.int64))
+    tiles[mask, 0, 0] += np.uint64(1)
+    return BlockSparseMatrix(rows=m.rows, cols=m.cols, k=m.k,
+                             coords=m.coords, tiles=tiles)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    plancache.clear()
+    delta.clear()
+    yield
+    plancache.clear()
+    delta.clear()
+
+
+# ------------------------------------------------------------ row digests
+
+
+def test_row_digests_change_exactly_on_mutated_rows():
+    rng = np.random.default_rng(201)
+    a = random_block_sparse(8, 8, 2, 0.6, rng, "full")
+    rows = np.unique(a.coords[:, 0])
+    dirty = rows[:2]
+    a2 = _mutate_rows(a, dirty)
+    ids1, d1 = delta.row_digests(a.coords, a.tiles)
+    ids2, d2 = delta.row_digests(a2.coords, a2.tiles)
+    assert np.array_equal(ids1, ids2)
+    changed = ids1[d1 != d2]
+    assert np.array_equal(np.sort(changed), np.sort(dirty))
+
+
+def test_row_digests_empty_operand():
+    ids, digs = delta.row_digests(np.zeros((0, 2), np.int64),
+                                  np.zeros((0, 2, 2), np.uint64))
+    assert len(ids) == 0 and len(digs) == 0
+
+
+# ----------------------------------------------------- sub-plan machinery
+
+
+def test_subplan_rows_match_full_execution():
+    """A row-sliced sub-plan's keys compute byte-identically to the same
+    keys of the full plan (the splice's correctness core)."""
+    from spgemm_tpu.ops.spgemm import execute
+
+    rng = np.random.default_rng(202)
+    a = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    b = random_block_sparse(8, 8, 4, 0.5, rng, "adversarial")
+    p = plan(a, b, backend="xla", platform="cpu")
+    full = execute(p, a, b)
+    keep = p.join.keys[:, 0] % 2 == 0  # every even output tile-row
+    assert 0 < int(np.count_nonzero(keep)) < p.join.num_keys
+    sub_p, kept = subplan(p, keep)
+    sub = execute(sub_p, a, b)
+    assert np.array_equal(sub_p.join.keys, p.join.keys[kept])
+    np.testing.assert_array_equal(np.asarray(sub.hi[: len(kept)]),
+                                  np.asarray(full.hi)[kept])
+    np.testing.assert_array_equal(np.asarray(sub.lo[: len(kept)]),
+                                  np.asarray(full.lo)[kept])
+
+
+# -------------------------------------------------- single-multiply delta
+
+
+def test_delta_bit_exact_vs_full_on_partial_mutation(monkeypatch):
+    """The tentpole A/B on adversarial (fold-order-sensitive) values: a
+    mutated re-submit through the delta path is byte-identical to the
+    full recompute and the oracle, recomputed fewer rows than total --
+    for an A-side dirty row (reaches only its own output row) AND then a
+    B-side dirty row (reaches every output row whose pair lists touch
+    it, the direction that actually fans out)."""
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    rng = np.random.default_rng(203)
+    a = random_block_sparse(8, 8, 4, 0.6, rng, "adversarial")
+    b = random_block_sparse(8, 8, 4, 0.6, rng, "adversarial")
+    first = spgemm(a, b, backend="xla")
+    assert first == _oracle(a, b)
+    a2 = _mutate_rows(a, np.unique(a.coords[:, 0])[:1])
+    ENGINE.reset()
+    got = spgemm(a2, b, backend="xla")
+    counters = ENGINE.counter_snapshot()
+    assert 0 < counters["delta_rows_recomputed"] \
+        < counters["delta_rows_total"]
+    assert counters.get("delta_full_fallbacks", 0) == 0
+    # B-side mutation against the refreshed entry (a2 retained now)
+    b2 = _mutate_rows(b, np.unique(b.coords[:, 0])[:1])
+    got_b = spgemm(a2, b2, backend="xla")
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "0")
+    want = spgemm(a2, b, backend="xla")
+    assert got == want == _oracle(a2, b)
+    assert got_b == spgemm(a2, b2, backend="xla") == _oracle(a2, b2)
+
+
+def test_empty_diff_executes_nothing(monkeypatch):
+    """Zero dirty rows -> zero recompute: the retained result is the
+    answer and no numeric launch happens."""
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    rng = np.random.default_rng(205)
+    a = random_block_sparse(8, 8, 2, 0.5, rng, "adversarial")
+    b = random_block_sparse(8, 8, 2, 0.5, rng, "adversarial")
+    first = spgemm(a, b, backend="xla")
+    ENGINE.reset()
+    second = spgemm(a, b, backend="xla")
+    counters = ENGINE.counter_snapshot()
+    assert counters.get("dispatches", 0) == 0
+    assert counters["delta_rows_recomputed"] == 0
+    assert counters["delta_rows_total"] > 0
+    assert second == first == _oracle(a, b)
+
+
+def test_all_dirty_degenerates_to_full_path(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    rng = np.random.default_rng(206)
+    a = random_block_sparse(6, 6, 2, 0.7, rng, "adversarial")
+    b = random_block_sparse(6, 6, 2, 0.7, rng, "adversarial")
+    spgemm(a, b, backend="xla")
+    a2 = _mutate_rows(a, np.unique(a.coords[:, 0]))  # every row dirty
+    ENGINE.reset()
+    got = spgemm(a2, b, backend="xla")
+    counters = ENGINE.counter_snapshot()
+    assert counters["delta_rows_recomputed"] == counters["delta_rows_total"]
+    assert counters.get("delta_full_fallbacks", 0) == 0  # a diff, not a miss
+    assert got == _oracle(a2, b)
+
+
+def test_delta_disabled_is_legacy(monkeypatch):
+    """SPGEMM_TPU_DELTA=0: no retention, no tags, identical dispatch
+    counts on a repeat -- the legacy engine exactly."""
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "0")
+    rng = np.random.default_rng(207)
+    a = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    b = random_block_sparse(8, 8, 2, 0.5, rng, "full")
+    ENGINE.reset()
+    first = spgemm(a, b, backend="xla")
+    d1 = ENGINE.counter_snapshot()["dispatches"]
+    ENGINE.reset()
+    second = spgemm(a, b, backend="xla")
+    counters = ENGINE.counter_snapshot()
+    assert counters["dispatches"] == d1 > 0
+    assert "delta_rows_total" not in counters
+    assert delta.stats()["entries"] == 0
+    assert second == first
+
+
+def test_store_eviction_is_counted_full_fallback(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    monkeypatch.setenv("SPGEMM_TPU_DELTA_RETAIN", "1")
+    rng = np.random.default_rng(208)
+    a = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    b = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    c = random_block_sparse(6, 6, 2, 0.9, rng, "full")
+    spgemm(a, b, backend="xla")      # entry 1
+    spgemm(a, c, backend="xla")      # entry 2 evicts entry 1 at cap 1
+    st = delta.stats()
+    assert st["entries"] == 1 and st["evictions"] == 1
+    ENGINE.reset()
+    got = spgemm(a, b, backend="xla")  # evicted: full fallback, correct
+    assert ENGINE.counter_snapshot()["delta_full_fallbacks"] == 1
+    assert got == _oracle(a, b)
+
+
+def test_plan_cache_off_bypasses_delta(monkeypatch):
+    """No fingerprint -> no delta keying: the engine runs the plain full
+    path and retains nothing."""
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_CACHE", "0")
+    rng = np.random.default_rng(209)
+    a = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    assert spgemm(a, a, backend="xla") == _oracle(a, a)
+    assert delta.stats()["entries"] == 0
+
+
+# -------------------------------------------------------- chain propagation
+
+
+@pytest.mark.parametrize("ahead", ["0", "2"])
+def test_chain_delta_propagates_and_stays_bit_exact(monkeypatch, ahead):
+    """A re-submitted chain with one mutated leaf re-folds only reached
+    rows at EVERY pass (pass >= 1 partials propagate dirtiness via the
+    producer tag -- no host tiles needed) and matches the mutated chain's
+    oracle byte-for-byte, under both plan-ahead modes."""
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", ahead)
+    rng = np.random.default_rng(210)
+    mats = random_chain(4, 6, 2, 0.5, rng, "adversarial")
+    chain_product(mats)  # submit 1: first contact everywhere
+    mats2 = list(mats)
+    mats2[0] = _mutate_rows(mats[0], np.unique(mats[0].coords[:, 0])[:1])
+    ENGINE.reset()
+    got = chain_product(mats2)  # submit 2: the delta path, all passes
+    counters = ENGINE.counter_snapshot()
+    assert counters.get("delta_full_fallbacks", 0) == 0
+    assert 0 < counters["delta_rows_recomputed"] \
+        < counters["delta_rows_total"]
+    want = chain_oracle([m.to_dict() for m in mats2], 2)
+    want_m = BlockSparseMatrix.from_dict(mats2[0].rows, mats2[-1].cols, 2,
+                                         want)
+    assert got == want_m
+
+
+def test_chain_identical_resubmit_recomputes_nothing(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    rng = np.random.default_rng(211)
+    mats = random_chain(4, 4, 2, 0.5, rng, "full")
+    first = chain_product(mats)
+    ENGINE.reset()
+    second = chain_product(mats)
+    counters = ENGINE.counter_snapshot()
+    assert counters.get("dispatches", 0) == 0
+    assert counters["delta_rows_recomputed"] == 0
+    assert second == first
+
+
+def test_tag_lineage_gap_falls_back_full(monkeypatch):
+    """A consumer whose stored producer version is neither the tag's
+    prev_version nor its version (a run the entry missed) must take the
+    counted full fallback, never a stale splice."""
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    rng = np.random.default_rng(212)
+    a = random_block_sparse(6, 6, 2, 0.6, rng, "full")
+    b = random_block_sparse(6, 6, 2, 0.6, rng, "full")
+    da = spgemm_device(a, b)            # producer: entry v1, tag v1
+    c = random_block_sparse(6, 6, 2, 0.6, rng, "full")
+    spgemm_device(da, c)                # consumer stores ("tag", key, 1)
+    # two producer re-runs the consumer never sees: v1 -> v2 -> v3
+    a2 = _mutate_rows(a, np.unique(a.coords[:, 0])[:1])
+    da2 = spgemm_device(a2, b)
+    a3 = _mutate_rows(a2, np.unique(a.coords[:, 0])[1:2])
+    da3 = spgemm_device(a3, b)
+    ENGINE.reset()
+    got = spgemm_device(da3, c)         # stored v1, tag prev=2: gap
+    assert ENGINE.counter_snapshot()["delta_full_fallbacks"] == 1
+    assert got.to_host() == _oracle(da3.to_host(), c)
+
+
+# ------------------------------------------------------- stats + surfaces
+
+
+def test_delta_stats_and_knobs_listing(monkeypatch, capsys):
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    rng = np.random.default_rng(213)
+    a = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    spgemm(a, a, backend="xla")
+    spgemm(a, a, backend="xla")
+    st = delta.stats()
+    assert st["full_fallbacks"] == 1 and st["hits"] == 1
+    assert st["entries"] == 1 and st["enabled"] is True
+    assert st["rows_total"] >= st["rows_recomputed"] > 0
+    from spgemm_tpu.cli import run_knobs
+
+    assert run_knobs([]) == 0
+    out = capsys.readouterr().out
+    assert "delta:" in out and "full_fallbacks=1" in out
+    import json
+
+    assert run_knobs(["--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["delta"]["hits"] == 1
+    assert payload["plan_cache"]["evictions"] == 0
+
+
+def test_plan_cache_eviction_counter(monkeypatch):
+    """The plan cache's LRU pops are no longer invisible: stats() and the
+    ENGINE counter both move on an eviction."""
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_CACHE_CAP", "1")
+    rng = np.random.default_rng(214)
+    a = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    b = random_block_sparse(6, 6, 2, 0.5, rng, "full")
+    c = random_block_sparse(6, 6, 2, 0.9, rng, "full")
+    ENGINE.reset()
+    plan(a, b, backend="xla", platform="cpu")
+    assert plancache.stats()["evictions"] == 0
+    plan(a, c, backend="xla", platform="cpu")  # evicts at cap 1
+    assert plancache.stats()["evictions"] == 1
+    assert ENGINE.counter_snapshot()["plan_cache_evictions"] == 1

@@ -187,3 +187,22 @@ def test_consumers_read_through_registry(monkeypatch):
     assert round_batch_enabled() is True
     monkeypatch.setenv("SPGEMM_TPU_RING_OVERLAP", "0")
     assert overlap_enabled() is False
+
+
+def test_pin_unless_exported(monkeypatch):
+    """The one harness-pin idiom (cli.run / bench.py / benchmarks/run.py):
+    an exported value always wins; otherwise the pin lands and restore()
+    removes it cleanly (and is safe to call twice)."""
+    monkeypatch.delenv("SPGEMM_TPU_DELTA", raising=False)
+    restore = knobs.pin_unless_exported("SPGEMM_TPU_DELTA", "0")
+    assert knobs.get("SPGEMM_TPU_DELTA") is False
+    assert knobs.source("SPGEMM_TPU_DELTA") == "env"
+    restore()
+    restore()  # idempotent
+    assert knobs.source("SPGEMM_TPU_DELTA") == "default"
+    assert knobs.get("SPGEMM_TPU_DELTA") is True
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    noop = knobs.pin_unless_exported("SPGEMM_TPU_DELTA", "0")
+    assert knobs.get("SPGEMM_TPU_DELTA") is True  # exported value wins
+    noop()
+    assert knobs.get("SPGEMM_TPU_DELTA") is True

@@ -80,6 +80,20 @@ def test_fld_scope_is_path_based(tmp_path):
     assert [f.rule for f in lint_file(str(p), numeric=True)] == ["FLD"]
 
 
+def test_fld_delta_module_in_numeric_scope():
+    """ops/delta.py (incremental recompute) is in the numeric-lint scope:
+    its reachability masks gate which output rows re-fold, so a smuggled
+    unordered reduction is a finding -- and the LIVE module self-lints
+    clean."""
+    assert core.is_numeric_module("spgemm_tpu/ops/delta.py")
+    findings = lint_file(os.path.join(FIXTURES, "ops", "delta.py"))
+    assert [f.rule for f in findings] == ["FLD"]
+    assert "jnp.sum" in findings[0].message
+    live = lint_file(os.path.join(REPO, "spgemm_tpu", "ops", "delta.py"))
+    assert live == [], "\n".join(
+        f"{f.file}:{f.line}: [{f.rule}] {f.message}" for f in live)
+
+
 def test_fld_estimator_module_in_numeric_scope():
     """ops/estimate.py (the sampled planner estimator) is in the
     numeric-lint scope: a jnp.sum smuggled into an estimator helper is a
@@ -101,7 +115,7 @@ def test_knb_fixture_each_violation_caught():
     the same fixture (how harnesses and tests drive knob values) must NOT
     be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 12
+    assert [f.rule for f in findings] == ["KNB"] * 14
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
                    "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
@@ -111,7 +125,8 @@ def test_knb_fixture_each_violation_caught():
                    "SPGEMM_TPU_SERVE_WEDGE_GRACE_S",
                    "SPGEMM_TPU_PLAN_ESTIMATE",
                    "SPGEMM_TPU_EST_SAMPLE_ROWS",
-                   "SPGEMM_TPU_EST_CONFIDENCE"):
+                   "SPGEMM_TPU_EST_CONFIDENCE",
+                   "SPGEMM_TPU_DELTA", "SPGEMM_TPU_DELTA_RETAIN"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -238,11 +253,14 @@ def test_met_registry_covers_live_call_sites():
 
     for name in ("plan", "plan_wait", "numeric_dispatch", "assembly",
                  "ring_fold", "dcn_exchange", "serve_execute",
-                 "serve_queue_wait", "estimate", "join_fallback"):
+                 "serve_queue_wait", "estimate", "join_fallback",
+                 "delta_diff", "delta_splice"):
         assert name in ENGINE_PHASES
     for name in ("dispatches", "plan_cache_hits", "plan_cache_misses",
-                 "ring_steps", "serve_reaps", "serve_degrades",
-                 "est_hits", "est_fallbacks"):
+                 "plan_cache_evictions", "ring_steps", "serve_reaps",
+                 "serve_degrades", "est_hits", "est_fallbacks",
+                 "delta_rows_recomputed", "delta_rows_total",
+                 "delta_full_fallbacks"):
         assert name in ENGINE_COUNTERS
 
 
@@ -524,12 +542,12 @@ def test_json_report_fixture_run():
     report = json.loads(rc.stdout)
     assert report["clean"] is False
     # badknob: 3 classic + 2 planner-knob + 4 serve-knob + 3
-    # estimator-knob reads; badbackend: 3 import-time touches;
-    # badplanner: 2 @host_only-body touches; FLD: 5 per-module + 2
-    # interprocedural (callchain) + 1 ops/estimate numeric-scope;
-    # badthread/badexcept/stalesup: 3 each; badmetric: undeclared phase +
-    # undeclared counter + computed name
-    assert report["counts"] == {"FLD": 8, "KNB": 12, "BKD": 5, "THR": 3,
+    # estimator-knob + 2 delta-knob reads; badbackend: 3 import-time
+    # touches; badplanner: 2 @host_only-body touches; FLD: 5 per-module
+    # + 2 interprocedural (callchain) + 1 ops/estimate + 1 ops/delta
+    # numeric-scope; badthread/badexcept/stalesup: 3 each; badmetric:
+    # undeclared phase + undeclared counter + computed name
+    assert report["counts"] == {"FLD": 9, "KNB": 14, "BKD": 5, "THR": 3,
                                 "EXC": 3, "MET": 3, "DOC": 1, "SUP": 3,
                                 "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)

@@ -136,10 +136,15 @@ def test_hybrid_perf_gate_routes_to_measured_winner(tmp_path, monkeypatch,
     measured crossover (ops/crossover.py): it must run the exact kernel
     when that measures faster, the MXU kernel when that wins -- and produce
     the reference-bit-exact result either way (VERDICT r3 #4: 'hybrid'
-    never slower than the exact backend)."""
+    never slower than the exact backend).  (Delta recompute pinned OFF:
+    the repeated same-value multiply below must RE-DISPATCH so its
+    routing log line exists -- the zero-diff shortcut is test_delta's
+    subject.)"""
     import logging
 
     from spgemm_tpu.ops import crossover
+
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "0")
 
     rng = np.random.default_rng(9)
     a = random_block_sparse(8, 8, 8, 0.5, rng, "small")

@@ -207,6 +207,8 @@ def test_chain_plan_ahead_bit_identical_and_same_dispatch(n, monkeypatch):
     identical bits, identical dispatch counts (planning is deterministic
     and dispatch order unchanged), and the pipeline actually overlapped
     (plan_wait recorded alongside plan)."""
+    from spgemm_tpu.ops import delta
+
     rng = np.random.default_rng(120 + n)
     mats = random_chain(n, 4, 2, 0.6, rng, "adversarial")
     monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "0")
@@ -216,6 +218,7 @@ def test_chain_plan_ahead_bit_identical_and_same_dispatch(n, monkeypatch):
     serial_dispatches = ENGINE.counter_snapshot()["dispatches"]
     monkeypatch.setenv("SPGEMM_TPU_PLAN_AHEAD", "2")
     plancache.clear()
+    delta.clear()  # the piped leg must re-EXECUTE, not serve retained rows
     ENGINE.reset()
     piped = chain_product(mats)
     snap = ENGINE.snapshot()

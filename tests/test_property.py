@@ -214,6 +214,54 @@ def test_chain_vs_oracle(mats):
     assert got == want
 
 
+@settings(max_examples=10, deadline=None)
+@given(ab=matrix_pairs(), data=st.data())
+def test_delta_recompute_byte_identical(ab, data):
+    """Delta SpGEMM (ops/delta) vs full recompute across RANDOM dirty
+    tile-row sets, including the empty diff (zero dirty rows -> zero
+    recompute) and the all-dirty edge (degenerates to the full path):
+    the delta path's bytes must equal the full path's for every drawn
+    mutation, on edge-heavy values."""
+    import os
+
+    from spgemm_tpu.ops import delta, plancache
+    from spgemm_tpu.utils.timers import ENGINE
+
+    a, b = ab
+    rows = np.unique(a.coords[:, 0]).tolist() if a.nnzb else []
+    dirty = data.draw(st.lists(st.sampled_from(rows), unique=True,
+                               max_size=len(rows))) if rows else []
+    tiles = a.tiles.copy()
+    if dirty:
+        mask = np.isin(a.coords[:, 0], np.array(dirty, np.int64))
+        tiles[mask, 0, 0] += np.uint64(1)  # wraps at 2^64: still a change
+    a2 = BlockSparseMatrix(rows=a.rows, cols=a.cols, k=a.k,
+                           coords=a.coords, tiles=tiles)
+    prev = os.environ.get("SPGEMM_TPU_DELTA")
+    delta.clear()
+    plancache.clear()
+    try:
+        os.environ["SPGEMM_TPU_DELTA"] = "1"
+        spgemm(a, b, backend="xla")       # seeds the retained entry
+        ENGINE.reset()
+        got = spgemm(a2, b, backend="xla")  # the delta path
+        counters = ENGINE.counter_snapshot()
+        if not dirty:
+            assert counters.get("delta_rows_recomputed", 0) == 0
+        os.environ["SPGEMM_TPU_DELTA"] = "0"
+        want = spgemm(a2, b, backend="xla")  # the full path
+    finally:
+        if prev is None:
+            os.environ.pop("SPGEMM_TPU_DELTA", None)
+        else:
+            os.environ["SPGEMM_TPU_DELTA"] = prev
+        delta.clear()
+    assert got == want
+    oracle = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a2.to_dict(), b.to_dict(), a.k))
+    assert want == oracle
+
+
 @settings(max_examples=25, deadline=None)
 @given(m=block_matrices())
 def test_text_format_roundtrip(m, tmp_path_factory):

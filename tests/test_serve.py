@@ -332,15 +332,25 @@ def test_second_identical_submit_hits_warm_plan_cache(tmp_path,
     assert details[0]["plan_cache_misses"] >= 1  # cold first job
     assert details[1]["plan_cache_hits"] >= 1    # warm second job
     assert details[1]["degraded"] is False
+    # the identical second submit is also the delta path's zero-diff
+    # case: every output row carries over from the retained results
+    assert details[0]["delta_rows"] == details[0]["total_rows"] > 0
+    assert details[1]["delta_rows"] == 0
+    assert details[1]["total_rows"] == details[0]["total_rows"]
 
 
-def test_job_detail_phases_are_scoped_per_job(tmp_path, make_daemon):
+def test_job_detail_phases_are_scoped_per_job(tmp_path, make_daemon,
+                                              monkeypatch):
     """utils/timers accumulates process-wide; the daemon's PhaseScope diff
     must give each job its OWN phases and counters -- two sequential jobs
     of the same shape report (near-)equal dispatch counts, not cumulative
-    ones, and the second job shows zero fresh planner misses."""
+    ones, and the second job shows zero fresh planner misses.  (Delta
+    recompute is pinned OFF: it would legitimately answer job 2 from the
+    retained result with zero dispatches, which is tests/test_delta.py's
+    subject, not this scoping contract's.)"""
     from spgemm_tpu.ops import plancache
 
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "0")
     folder, _ = _chain_folder(tmp_path, n=3, k=2, seed=11, name="scoped_in")
     plancache.clear()
     d = make_daemon()
