@@ -73,9 +73,13 @@ serve-smoke:
 		$(PY) -m spgemm_tpu.serve.smoke
 
 # observability end-to-end proof on CPU: daemon up, one submit, Prometheus
-# `metrics` scrape (phase + plan-cache series must move), trace dumped and
-# validated through the real `cli trace-dump`, clean shutdown; exits
-# nonzero on any step.
+# `metrics` scrape (phase + plan-cache series must move, and the deep-
+# profiling families -- compile accounting with nonzero cost, span-fed
+# phase histograms, estimator/delta prediction accuracy, event-log
+# counters -- must appear and move), `cli profile --json` reports a
+# compile record with nonzero FLOPs, `cli events --tail` returns the
+# submit's lifecycle records, trace dumped and validated through the real
+# `cli trace-dump`, clean shutdown; exits nonzero on any step.
 obs-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m spgemm_tpu.serve.obs_smoke

@@ -461,6 +461,11 @@ def _run(args) -> int:
         except OSError as e:
             print(f"trace dump failed: {e!r}", file=sys.stderr)
 
+    # deep-profiling digest for the emitted row (jax-free read; the
+    # compile records accumulated across the warm + timed passes above)
+    from spgemm_tpu.obs import profile as obs_profile
+    profile_summary = obs_profile.summary()
+
     # reference Table 1 scales (BASELINE.md): tiles -> total multiply time.
     # Only claim a baseline ratio when the measured workload matches a
     # published scale (within ~25%); otherwise vs_baseline is null.
@@ -501,6 +506,12 @@ def _run(args) -> int:
             "est_hits": est_hits,
             "est_fallbacks": est_fallbacks,
             "trace_path": trace_path,
+            # deep-profiling digest (obs/profile): the cold-jit tax this
+            # run paid (compile count + wall + cost-model FLOPs), the HBM
+            # watermark when the backend reports one, and the prediction-
+            # accuracy means -- the accountability row a captured bench
+            # JSON carries without a daemon scrape
+            "profile": profile_summary,
             **({"fallback": {
                 "reason": f"{args.cpu_fallback}; CPU with clamped workload",
                 "standing_evidence": "see the newest BENCH_r*.json with a "

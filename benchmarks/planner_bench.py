@@ -83,6 +83,7 @@ def _cold_structure_detail(args) -> dict:
     The estimator-on figure is what a caller blocks on (the exact join is
     deferred into SpgemmPlan.ensure_exact); ensure_exact() is then forced
     OUTSIDE the timed span, as the chain plan-ahead worker would."""
+    from spgemm_tpu.obs import profile as obs_profile
     from spgemm_tpu.ops import estimate, plancache
     from spgemm_tpu.ops.spgemm import plan as plan_spgemm
     from spgemm_tpu.utils import knobs
@@ -102,6 +103,7 @@ def _cold_structure_detail(args) -> dict:
     on_s = off_s = float("inf")
     routes = []
     estimate.clear()
+    obs_profile.clear()  # a fresh accuracy account for this run's estimates
     try:
         for i in range(args.repeats):
             plancache.clear()
@@ -126,6 +128,11 @@ def _cold_structure_detail(args) -> dict:
         "speedup": round(off_s / on_s, 2) if on_s > 0 else None,
         "plan_routes": routes,
         "estimator": estimate.stats(),
+        # prediction accountability (obs/profile): every estimator-routed
+        # plan above had its deferred exact join forced, so the accuracy
+        # account must carry one observation per estimated plan -- the
+        # acceptance gate for the relative-error series
+        "est_accuracy": obs_profile.est_stats(),
     }}
 
 

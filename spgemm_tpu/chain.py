@@ -246,6 +246,11 @@ def chain_product(matrices: list[BlockSparseMatrix], multiply=None,
             # dir it cannot belong to a previous unrelated run)
             log.warning("multiply failed (%r); failing over to the host "
                         "oracle from pass %d", e, pass_idx)
+            # the event log's view of the same transition (job/trace tags
+            # ride along automatically under spgemmd)
+            from spgemm_tpu.obs import events  # noqa: PLC0415
+            events.emit("chain_failover", error=repr(e),
+                        pass_idx=pass_idx)
             # copy, not alias: the retry pass Nones out consumed entries of
             # its working list, which must never corrupt the snapshot
             arr = list(arr_host)

@@ -28,7 +28,11 @@ resident daemon owning the device whose warm jit/plan/crossover caches are
 reused across jobs, vs this run-once entrypoint paying them per
 invocation.  `metrics` scrapes the daemon's Prometheus text-format
 surface and `trace-dump` serializes its span flight recorder as
-Perfetto/Chrome trace_event JSON (spgemm_tpu/obs/).
+Perfetto/Chrome trace_event JSON (spgemm_tpu/obs/).  `profile` reports
+the daemon's deep-profiling accounts (jit compile wall + cost/memory
+analyses per engine site, HBM watermarks, estimator/delta prediction
+accuracy) and `events` tails its structured event log (obs/events.py
+JSONL: job lifecycle, watchdog transitions, fallbacks with reasons).
 """
 
 from __future__ import annotations
@@ -170,12 +174,30 @@ def run_knobs(argv: list[str]) -> int:
         dlt = {"hits": 0, "full_fallbacks": 0, "evictions": 0,
                "rows_recomputed": 0, "rows_total": 0, "entries": 0,
                "capacity": "?", "enabled": "?", "error": str(e)}
+    # deep-profiling digest (obs/profile, jax-free): compile count/wall +
+    # prediction-accuracy means ride next to the routing stats, so an
+    # estimator drifting off its predictions is visible in the same
+    # listing that shows the knobs steering it.  Same degrade-to-error-
+    # row contract as the cache/estimator/delta blocks above: an invalid
+    # obs knob must not abort the listing
+    from spgemm_tpu.obs import profile as obs_profile  # noqa: PLC0415
     if args.as_json:
         import json  # noqa: PLC0415
 
+        try:
+            prof_report = obs_profile.report()
+        except ValueError as e:
+            prof_report = {"error": str(e)}
         print(json.dumps({"knobs": rows, "plan_cache": cache,
-                          "estimator": est, "delta": dlt}, indent=2))
+                          "estimator": est, "delta": dlt,
+                          "profile": prof_report}, indent=2))
         return 0
+    try:
+        prof = obs_profile.summary()
+    except ValueError as e:
+        prof = {"compiles": 0, "compile_s": 0, "est_mean_rel_error": {},
+                "delta_mean_dirty_fraction": None, "hbm_peak_bytes": None,
+                "error": str(e)}
     name_w = max(len(r["name"]) for r in rows)
     val_w = max(len(r["value"]) for r in rows)
     try:
@@ -212,6 +234,14 @@ def run_knobs(argv: list[str]) -> int:
               "  [ops/delta.py]")
         if dlt.get("error"):
             print(f"  !! {dlt['error']}")
+        print(f"profile:    compiles={prof['compiles']} "
+              f"({prof['compile_s']}s) "
+              f"est_err={prof['est_mean_rel_error'] or None} "
+              f"delta_dirty_frac={prof['delta_mean_dirty_fraction']} "
+              f"hbm_peak={prof['hbm_peak_bytes']}"
+              "  [obs/profile.py]")
+        if prof.get("error"):
+            print(f"  !! {prof['error']}")
     except BrokenPipeError:
         # `spgemm_tpu knobs | head` closing the pipe is not an error for a
         # listing; swap in devnull so the interpreter's exit flush of
@@ -247,9 +277,18 @@ def _subcommands() -> dict:
         from spgemm_tpu.serve import client  # noqa: PLC0415
         return client.main_trace_dump(argv)
 
+    def profile(argv: list[str]) -> int:
+        from spgemm_tpu.serve import client  # noqa: PLC0415
+        return client.main_profile(argv)
+
+    def events(argv: list[str]) -> int:
+        from spgemm_tpu.serve import client  # noqa: PLC0415
+        return client.main_events(argv)
+
     return {"knobs": run_knobs, "serve": serve,
             "submit": submit, "status": status,
-            "metrics": metrics, "trace-dump": trace_dump}
+            "metrics": metrics, "trace-dump": trace_dump,
+            "profile": profile, "events": events}
 
 
 def run(argv: list[str] | None = None) -> int:
@@ -263,7 +302,7 @@ def run(argv: list[str] | None = None) -> int:
     # `./knobs` matrix folder keeps its old meaning, while an unrelated
     # scratch dir does not swallow the subcommand
     if (argv and argv[0] in ("knobs", "serve", "submit", "status",
-                             "metrics", "trace-dump")
+                             "metrics", "trace-dump", "profile", "events")
             and not os.path.exists(os.path.join(argv[0], "size"))):
         return _subcommands()[argv[0]](argv[1:])
     parser = build_parser()

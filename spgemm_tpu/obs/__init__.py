@@ -1,9 +1,10 @@
-"""L5 observability: span tracing, metrics, trace export.
+"""L5 observability: span tracing, metrics, deep profiling, event log.
 
 Host-side and jax-free BY CONSTRUCTION (pinned by a subprocess test,
-mirroring the linter's jax-free contract): the flight recorder and the
-metrics registry are scraped/dumped from client processes and watchdog
-threads that must never touch -- or hang on -- a backend.
+mirroring the linter's jax-free contract): the flight recorder, the
+metrics registry, the profiling accounts, and the event log are
+scraped/dumped from client processes and watchdog threads that must
+never touch -- or hang on -- a backend.
 
   * obs/trace.py   -- the span flight recorder: every PhaseTimers phase
     enter/exit emits a span (monotonic ts, duration, parent, job/trace
@@ -12,4 +13,16 @@ threads that must never touch -- or hang on -- a backend.
   * obs/metrics.py -- the metrics registry (knobs.py-style single source
     of truth: name, type, help) + Prometheus text-format 0.0.4 renderer
     behind spgemmd's `metrics` op and `spgemm_tpu.cli metrics`.
+  * obs/profile.py -- the deep-profiling layer: jit compile/cost/memory
+    accounting (ProfiledJit over the engine's AOT surface), device
+    memory watermarks (pushed by the jax-side engine; gracefully absent
+    on backends without memory_stats), estimator and delta prediction
+    accountability (predicted vs realized), and per-phase latency
+    histograms fed from completed spans.  Inert under
+    SPGEMM_TPU_OBS_TRACE=0 -- the same master A/B knob as the recorder.
+  * obs/events.py  -- the structured event log: bounded in-process ring
+    + rotating JSONL next to the spgemmd journal (job lifecycle,
+    watchdog reap/wedge/degrade, est/delta fallbacks with reasons,
+    compile records), auto-correlated with span job/trace tags;
+    SPGEMM_TPU_OBS_EVENTS / SPGEMM_TPU_OBS_EVENTS_MAX_KB.
 """

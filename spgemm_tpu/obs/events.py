@@ -1,0 +1,250 @@
+"""Structured event log (L5): bounded, rotating JSONL of engine/daemon
+lifecycle transitions.
+
+The flight recorder (obs/trace.py) answers "what was running when"; this
+log answers "what HAPPENED": job lifecycle (submit/start/done/failed),
+watchdog reap/wedge/degrade, estimator and delta fallbacks WITH their
+reasons, chain failover, and jit compile records.  One JSON object per
+line, each carrying a monotonically increasing `seq`, wall-clock `ts`,
+a `mono_us` timestamp on the flight recorder's span origin (so an event
+lines up against the Perfetto timeline), and the emitting thread's
+active job/trace tags (auto-correlation: an event emitted inside a
+tagged job span carries that job's id without the call site passing it).
+
+Two sinks, both bounded:
+
+  * an in-process ring (`RING_RETAIN` newest records) -- what the
+    daemon's `events` op and `spgemm_tpu.cli events --tail N` read;
+  * optionally a JSONL file (`configure()`; spgemmd points it next to
+    the journal at `<socket>.events.jsonl`), rotated to `<path>.1` when
+    it grows past SPGEMM_TPU_OBS_EVENTS_MAX_KB -- worst-case disk is
+    ~2x the cap, never unbounded under a resident daemon.
+
+`SPGEMM_TPU_OBS_EVENTS=0` disables emission entirely (both sinks).
+Writes are best-effort AND asynchronous: emit() only appends to the
+ring and a bounded pending queue; a single daemonized writer thread
+does every file syscall, so a stalling filesystem (NFS hang, full
+disk) can never block an emitting thread -- in particular never the
+spgemmd watchdog, whose reap/degrade emits sit on the recovery path.
+Write errors are counted, a pending queue past its bound drops the
+OLDEST lines (counted) -- the ring keeps the newest records either
+way.  `flush()` waits for the pending queue to drain (tests, daemon
+shutdown).
+
+jax-free by construction, like the rest of obs/ (subprocess-pinned in
+tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from spgemm_tpu.obs import trace
+from spgemm_tpu.utils import knobs
+
+
+def enabled() -> bool:
+    """SPGEMM_TPU_OBS_EVENTS=0|1 (default 1)."""
+    return knobs.get("SPGEMM_TPU_OBS_EVENTS")
+
+
+def cap_bytes() -> int:
+    """SPGEMM_TPU_OBS_EVENTS_MAX_KB (default 256) in bytes."""
+    return knobs.get("SPGEMM_TPU_OBS_EVENTS_MAX_KB") * 1024
+
+
+class EventLog:
+    """The process-wide event emitter: bounded ring + async rotating
+    file sink (one daemonized writer thread owns every file syscall)."""
+
+    # in-process records retained for tail()/the daemon `events` op
+    RING_RETAIN = 512
+    # encoded lines awaiting the writer thread: past this the OLDEST
+    # pending lines drop (counted) -- a stalled disk bounds memory, and
+    # the ring above still holds the newest records
+    PENDING_RETAIN = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring: deque = deque()   # spgemm-lint: guarded-by(_lock)
+        self._pending: deque = deque()  # spgemm-lint: guarded-by(_lock)
+        self._seq = 0                 # spgemm-lint: guarded-by(_lock)
+        self._emitted = 0             # spgemm-lint: guarded-by(_lock)
+        self._dropped = 0             # spgemm-lint: guarded-by(_lock)
+        self._io_dropped = 0          # spgemm-lint: guarded-by(_lock)
+        self._rotations = 0           # spgemm-lint: guarded-by(_lock)
+        self._write_errors = 0        # spgemm-lint: guarded-by(_lock)
+        self._path = None             # spgemm-lint: guarded-by(_lock)
+        self._size = 0                # spgemm-lint: guarded-by(_lock)
+        self._writer = None           # spgemm-lint: guarded-by(_lock)
+        self._wake = threading.Event()
+
+    def configure(self, path: str | None) -> None:
+        """Point the file sink at `path` (None detaches it) and start
+        the writer thread on first attach.  An existing file is appended
+        to -- its current size seeds the rotation budget, so a daemon
+        restart cannot grow it past ~2x the cap."""
+        with self._lock:
+            self._path = path
+            self._size = 0
+            if path is not None:
+                try:
+                    self._size = os.path.getsize(path)
+                except OSError:
+                    self._size = 0
+                if self._writer is None or not self._writer.is_alive():
+                    self._writer = threading.Thread(
+                        target=self._writer_loop, name="obs-events-writer",
+                        daemon=True)
+                    self._writer.start()
+        self._wake.set()
+
+    def emit(self, kind: str, **fields) -> None:
+        """One event.  None-valued fields are dropped; the emitting
+        thread's flight-recorder tags (job_id/trace_id) merge in under
+        the explicit fields.  NO file I/O happens here -- the line is
+        queued for the writer thread, so a stalling disk never blocks
+        an emitter (the spgemmd watchdog emits on its recovery path)."""
+        if not enabled():
+            return
+        rec = {"ts": round(time.time(), 6),
+               "mono_us": round((time.perf_counter() - trace._BASE) * 1e6,
+                                3),
+               "kind": kind}
+        rec.update(trace.RECORDER.current_tags())
+        rec.update({k: v for k, v in fields.items() if v is not None})
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, **rec}
+            self._ring.append(rec)
+            self._emitted += 1
+            while len(self._ring) > self.RING_RETAIN:
+                self._ring.popleft()
+                self._dropped += 1
+            if self._path is None:
+                return
+            # encode here (cheap, no syscall): the rotation budget is in
+            # BYTES, so the queued unit is the utf-8 line, not the str
+            self._pending.append(
+                (json.dumps(rec, separators=(",", ":"), default=str)
+                 + "\n").encode("utf-8"))
+            while len(self._pending) > self.PENDING_RETAIN:
+                self._pending.popleft()
+                self._io_dropped += 1
+        self._wake.set()
+
+    # ------------------------------------------------- the writer thread --
+    def _writer_loop(self) -> None:
+        while True:
+            self._wake.wait(0.5)
+            self._wake.clear()
+            self._drain_once()
+
+    def _drain_once(self) -> None:
+        """Write queued lines until the pending queue is empty.  Every
+        syscall happens here, on the writer thread, outside _lock --
+        a blocked write stalls only this thread and the (bounded,
+        oldest-dropped) pending queue."""
+        while True:
+            with self._lock:
+                if self._path is None:
+                    self._pending.clear()
+                    return
+                if not self._pending:
+                    return
+                data = self._pending.popleft()
+                path = self._path
+                size = self._size
+            cap = cap_bytes()
+            rotated = False
+            try:
+                if size + len(data) > cap and size > 0:
+                    # one rotation generation: the previous .1 is the
+                    # price of boundedness
+                    os.replace(path, path + ".1")
+                    size = 0
+                    rotated = True
+                with open(path, "ab") as f:
+                    f.write(data)
+            except OSError:
+                # best-effort sink: a full disk loses log lines, never
+                # the device owner.  Re-stat the file so the tracked
+                # size resyncs with reality -- a vanished file (an
+                # operator logrotate/cleaner) must not leave a stale
+                # over-cap _size that makes every later rotation attempt
+                # fail forever; the next append simply recreates it.
+                with self._lock:
+                    self._write_errors += 1
+                    if self._path == path:
+                        if rotated:
+                            self._rotations += 1
+                        try:
+                            self._size = os.path.getsize(path)
+                        except OSError:
+                            self._size = 0
+                continue
+            with self._lock:
+                if self._path == path:  # configure() may have moved it
+                    self._size = size + len(data)
+                    if rotated:
+                        self._rotations += 1
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Wait for the pending queue to drain (tests, daemon
+        shutdown); True when it drained within `timeout`."""
+        self._wake.set()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending or self._path is None:
+                    return True
+                writer = self._writer
+            if writer is None or not writer.is_alive():
+                return False
+            time.sleep(0.01)
+        return False
+
+    def tail(self, n: int = 50) -> list[dict]:
+        """The newest n records, oldest first (copies)."""
+        n = max(0, int(n))
+        with self._lock:
+            items = list(self._ring)
+        return [dict(r) for r in items[len(items) - n:]]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"enabled": enabled(), "ring": len(self._ring),
+                    "emitted": self._emitted, "dropped": self._dropped,
+                    "pending": len(self._pending),
+                    "io_dropped": self._io_dropped,
+                    "rotations": self._rotations,
+                    "write_errors": self._write_errors,
+                    "path": self._path, "bytes": self._size}
+
+    def clear(self) -> None:
+        """Drop the ring/pending lines and zero the counters; the file
+        sink detaches (tests, harnesses).  The writer thread stays up
+        for the next configure()."""
+        with self._lock:
+            self._ring.clear()
+            self._pending.clear()
+            self._seq = 0
+            self._emitted = self._dropped = self._rotations = 0
+            self._io_dropped = 0
+            self._write_errors = 0
+            self._path = None
+            self._size = 0
+
+
+# The process-wide log: the engine emits here, spgemmd configures the
+# file sink and serves the `events` op from the ring.
+LOG = EventLog()
+
+
+def emit(kind: str, **fields) -> None:
+    """Module-level convenience: LOG.emit."""
+    LOG.emit(kind, **fields)

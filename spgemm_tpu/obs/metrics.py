@@ -73,6 +73,9 @@ ENGINE_COUNTERS: dict[str, str] = {
                 "critical path)",
     "est_fallbacks": "estimator fallbacks to the inline exact join "
                      "(confidence below SPGEMM_TPU_EST_CONFIDENCE)",
+    "compiles": "engine jit compiles recorded by the deep-profiling "
+                "layer (obs/profile.ProfiledJit) -- per-job attribution "
+                "of the cold-jit tax",
     "serve_reaps": "spgemmd watchdog job reaps (deadline exceeded)",
     "serve_degrades": "spgemmd degrade transitions to the CPU path",
 }
@@ -170,6 +173,84 @@ _METRICS = (
     Metric("spgemmd_job_wall_seconds", "histogram",
            "Per-job wall time start-to-terminal (reaped jobs included).",
            "serve/daemon.py"),
+    # ---- deep profiling layer (obs/profile.py, obs/events.py) ----
+    Metric("spgemm_compiles_total", "counter",
+           "Engine jit compiles recorded per site (obs/profile.ProfiledJit "
+           "wraps the XLA numeric round, assembly gather, delta splice, "
+           "ring/rowshard entrypoints) -- the cold-jit tax the "
+           "persistent-warm-start roadmap item targets.",
+           "obs/profile.py", labels=("site",)),
+    Metric("spgemm_compile_seconds", "histogram",
+           "Compile wall per recorded engine jit compile (lower + "
+           "backend compile, per site).",
+           "obs/profile.py", labels=("site",)),
+    Metric("spgemm_compile_flops_total", "counter",
+           "Cumulative XLA cost_analysis FLOPs of the executables "
+           "compiled per site.",
+           "obs/profile.py", labels=("site",)),
+    Metric("spgemm_compile_bytes_total", "counter",
+           "Cumulative XLA cost_analysis bytes-accessed of the "
+           "executables compiled per site.",
+           "obs/profile.py", labels=("site",)),
+    Metric("spgemm_compile_temp_bytes", "gauge",
+           "Largest memory_analysis temp-buffer footprint among the "
+           "executables compiled per site.",
+           "obs/profile.py", labels=("site",)),
+    Metric("spgemm_phase_seconds", "histogram",
+           "Per-entry engine phase latency distribution, fed from "
+           "completed flight-recorder spans (phase names declared in "
+           "obs/metrics.ENGINE_PHASES) -- scrape-side phase latency "
+           "without a trace dump.",
+           "obs/profile.py", labels=("phase",)),
+    Metric("spgemm_est_rel_error", "histogram",
+           "Sampled-estimator relative error, scored when the deferred "
+           "exact join lands (SpgemmPlan.ensure_exact): |predicted - "
+           "exact| / exact per quantity (keys, pairs, fanout).  A "
+           "drifting estimator is an alert here, not a silent mis-plan.",
+           "obs/profile.py", labels=("quantity",)),
+    Metric("spgemm_delta_dirty_fraction", "histogram",
+           "Predicted-dirty fraction per delta-enabled multiply "
+           "(dirty output rows / total rows; a counted full fallback "
+           "observes 1.0) -- the per-multiply distribution behind the "
+           "aggregate delta_rows_* counters: how incremental the "
+           "submit stream actually is.",
+           "obs/profile.py"),
+    Metric("spgemm_delta_mispredictions_total", "counter",
+           "Delta multiplies whose executed row count diverged from "
+           "the predicted dirty set (the engine executes exactly what "
+           "it predicts, so any nonzero here is an engine bug -- "
+           "alert, don't graph).",
+           "obs/profile.py"),
+    Metric("spgemm_hbm_bytes_in_use", "gauge",
+           "Device bytes in use at the newest engine memory_stats() "
+           "sample (dispatch/assembly boundaries; omitted on backends "
+           "without the API, e.g. CPU).",
+           "obs/profile.py"),
+    Metric("spgemm_hbm_peak_bytes", "gauge",
+           "Peak device bytes in use over all engine memory_stats() "
+           "samples since process start -- the observable form of "
+           "SPGEMM_TPU_DELTA_RETAIN's entries-not-bytes retention bound.",
+           "obs/profile.py"),
+    Metric("spgemm_hbm_samples_total", "counter",
+           "Engine memory_stats() samples recorded (0 and omitted "
+           "gauges = backend never reported).",
+           "obs/profile.py"),
+    Metric("spgemm_events_emitted_total", "counter",
+           "Structured events emitted into the event log "
+           "(obs/events.py: job lifecycle, watchdog transitions, "
+           "est/delta fallbacks, compile records).",
+           "obs/events.py"),
+    Metric("spgemm_events_dropped_total", "counter",
+           "Events evicted from the bounded in-process event ring.",
+           "obs/events.py"),
+    Metric("spgemm_events_rotations_total", "counter",
+           "On-disk event-log rotations (file grew past "
+           "SPGEMM_TPU_OBS_EVENTS_MAX_KB and rolled to <path>.1).",
+           "obs/events.py"),
+    Metric("spgemm_events_bytes", "gauge",
+           "Current on-disk size of the active event-log file (0 when "
+           "no file sink is configured).",
+           "obs/events.py"),
 )
 
 REGISTRY: dict[str, Metric] = {m.name: m for m in _METRICS}
@@ -292,6 +373,56 @@ def collect_engine() -> list[tuple]:
         ("spgemm_trace_spans", {}, ring["spans"]),
         ("spgemm_trace_spans_emitted_total", {}, ring["emitted"]),
         ("spgemm_trace_spans_dropped_total", {}, ring["dropped"]),
+    ]
+    samples += _collect_profile()
+    return samples
+
+
+def _collect_profile() -> list[tuple]:
+    """Deep-profiling samples (obs/profile.py + obs/events.py): compile
+    accounting per site, phase latency histograms, prediction
+    accountability, memory watermarks (omitted when the backend never
+    reported -- the CPU graceful-omission contract), event-log health.
+    jax-free like the rest of the scrape path."""
+    from spgemm_tpu.obs import events, profile  # noqa: PLC0415
+
+    samples: list[tuple] = []
+    for site, agg in profile.compile_stats().items():
+        labels = {"site": site}
+        samples += [
+            ("spgemm_compiles_total", labels, agg["count"]),
+            ("spgemm_compile_seconds", labels, agg["seconds"]),
+            ("spgemm_compile_flops_total", labels, agg["flops_total"]),
+            ("spgemm_compile_bytes_total", labels, agg["bytes_total"]),
+            ("spgemm_compile_temp_bytes", labels, agg["temp_bytes_max"]),
+        ]
+    for phase, hist in profile.phase_stats().items():
+        samples.append(("spgemm_phase_seconds", {"phase": phase}, hist))
+    est = profile.est_stats()
+    for quantity, hist in est["rel_error"].items():
+        samples.append(("spgemm_est_rel_error", {"quantity": quantity},
+                        hist))
+    # rendered unconditionally (zero-count histogram / zero counter), so
+    # an alert rule never has to distinguish "absent" from "zero" -- the
+    # same contract spgemm_hbm_samples_total keeps
+    dlt = profile.delta_stats()
+    samples.append(("spgemm_delta_dirty_fraction", {},
+                    dlt["dirty_fraction"]))
+    samples.append(("spgemm_delta_mispredictions_total", {},
+                    dlt["mispredictions"]))
+    mem = profile.memory_stats()
+    samples.append(("spgemm_hbm_samples_total", {}, mem["samples"]))
+    if mem["available"]:
+        samples += [
+            ("spgemm_hbm_bytes_in_use", {}, mem["bytes_in_use"]),
+            ("spgemm_hbm_peak_bytes", {}, mem["peak_bytes"]),
+        ]
+    ev = events.LOG.stats()
+    samples += [
+        ("spgemm_events_emitted_total", {}, ev["emitted"]),
+        ("spgemm_events_dropped_total", {}, ev["dropped"]),
+        ("spgemm_events_rotations_total", {}, ev["rotations"]),
+        ("spgemm_events_bytes", {}, ev["bytes"]),
     ]
     return samples
 

@@ -1,0 +1,502 @@
+"""Deep profiling layer (L5): what the engine COST and whether it was RIGHT.
+
+PR 7's flight recorder answers *when* each engine phase ran; this module
+answers what it cost the machine and whether the engine's predictions
+held, in four accounts:
+
+  * **Compile & cost accounting** -- every engine jit compile (the XLA
+    numeric round, the fused assembly gather, the delta splice, the
+    ring/rowshard shard_map entrypoints) is recorded via `ProfiledJit`:
+    compile wall, the jit-static knob vector it was compiled under, the
+    compiled executable's `cost_analysis()` FLOPs / bytes-accessed and
+    `memory_analysis()` argument/output/temp bytes.  This is the number
+    the persistent-warm-start roadmap item will claim to remove (and the
+    JITSPMM amortization argument, PAPERS.md, made measurable).
+  * **Memory watermark telemetry** -- the engine samples
+    `device.memory_stats()` at its dispatch/assembly boundaries and
+    pushes the readings here (`observe_memory`); backends without the
+    API (e.g. CPU) report nothing and every gauge is gracefully omitted.
+    Finally makes SPGEMM_TPU_DELTA_RETAIN's entries-not-bytes bound
+    observable on a serving device.
+  * **Prediction accountability** -- when a deferred exact join lands
+    (SpgemmPlan.ensure_exact), the sampled estimate's keys/pairs/fanout
+    are scored against the exact join (`observe_estimate`, relative-error
+    histograms); every delta-enabled multiply scores predicted-dirty vs
+    actually-executed output rows (`observe_delta`).  A drifting
+    estimator becomes an alertable series, not a silent mis-plan.
+  * **Phase latency histograms** -- every completed flight-recorder span
+    feeds a per-phase histogram (`observe_phase`), so scrape-side phase
+    latency exists without pulling a trace dump.
+
+The whole layer is keyed off `SPGEMM_TPU_OBS_TRACE` (the L5 master A/B
+knob): at 0 nothing records, `ProfiledJit` degrades to the plain jit
+call, and every series stays flat -- inert by construction, pinned in
+tests/test_profile.py.
+
+jax-free BY CONSTRUCTION like the rest of obs/ (the subprocess pin in
+tests/test_obs.py covers it): `ProfiledJit` drives the AOT surface of
+whatever jit-wrapped callable it is handed purely by duck typing
+(`.lower(...).compile()`), and the memory/prediction accounts only
+receive plain numbers the jax-side engine pushes in.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+
+from spgemm_tpu.utils import knobs
+
+log = logging.getLogger("spgemm_tpu.profile")
+
+
+def enabled() -> bool:
+    """The L5 master knob (SPGEMM_TPU_OBS_TRACE): the deep-profiling
+    layer records only while span emission is on -- one A/B flag prices
+    the whole observability stack."""
+    return knobs.get("SPGEMM_TPU_OBS_TRACE")
+
+
+def static_knob_vector() -> tuple:
+    """Every jit-static knob's current value -- the compile record's
+    provenance: two records for one site with different vectors are two
+    different executables by the registry's own staticity contract."""
+    return tuple((kb.name, str(knobs.get(kb.name)))
+                 for kb in knobs.REGISTRY.values() if kb.jit_static)
+
+
+# ------------------------------------------------------------ histograms --
+COMPILE_BUCKETS = (0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0)
+REL_ERR_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0)
+FRACTION_BUCKETS = (0.0, 0.01, 0.05, 0.1, 0.25, 0.5, 0.9)
+PHASE_BUCKETS = (0.0001, 0.001, 0.01, 0.1, 1.0, 10.0, 60.0)
+
+
+class Hist:
+    """Fixed-bucket histogram in the Prometheus sample shape the metrics
+    renderer consumes ({"buckets": {le: cumulative}, "sum", "count"}).
+    NOT self-locked: every instance below is mutated under the module
+    _LOCK (one lock, acquired once per observation batch)."""
+
+    def __init__(self, buckets: tuple):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe_locked(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                self.counts[i] += 1
+
+    def snapshot_locked(self) -> dict:
+        # counts[i] is ALREADY cumulative (observe bumps every bucket
+        # whose bound admits the value -- the Prometheus bucket shape)
+        return {"buckets": dict(zip(self.buckets, self.counts)),
+                "sum": round(self.sum, 6), "count": self.count}
+
+
+# --------------------------------------------------------------- the book --
+# compile records retained for `cli profile` (aggregates are unbounded
+# counters; the per-record list is ring-bounded like every other resident
+# buffer in L5)
+COMPILE_RETAIN = 256
+
+_LOCK = threading.Lock()
+_COMPILES: list[dict] = []          # spgemm-lint: guarded-by(_LOCK)
+_COMPILE_DROPPED = 0                # spgemm-lint: guarded-by(_LOCK)
+_SITES: dict[str, dict] = {}        # spgemm-lint: guarded-by(_LOCK)
+_MEM = {"available": False, "samples": 0, "bytes_in_use": 0,
+        "peak_bytes": 0}            # spgemm-lint: guarded-by(_LOCK)
+# per-job HBM high-water marks, keyed by the emitting thread's span
+# job_id tag (LRU-bounded).  Keyed -- NOT one global window -- so a
+# wedged executor's late samples land in ITS job's window, never the
+# replacement executor's (the same cross-job attribution contract
+# PhaseScope enforces for phases).
+_MEM_JOBS: "OrderedDict[str, int]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
+MEM_JOB_RETAIN = 64
+_EST: dict[str, Hist] = {}          # spgemm-lint: guarded-by(_LOCK)
+_EST_COUNT = 0                      # spgemm-lint: guarded-by(_LOCK)
+_DELTA = {"hist": Hist(FRACTION_BUCKETS), "predicted": 0,
+          "executed": 0,
+          "mispredictions": 0}      # spgemm-lint: guarded-by(_LOCK)
+_PHASES: dict[str, Hist] = {}       # spgemm-lint: guarded-by(_LOCK)
+
+
+def clear() -> None:
+    """Zero every account (tests, A/B harnesses, bench iterations)."""
+    global _COMPILE_DROPPED, _EST_COUNT
+    with _LOCK:
+        _COMPILES.clear()
+        _COMPILE_DROPPED = 0
+        _SITES.clear()
+        _MEM.update(available=False, samples=0, bytes_in_use=0,
+                    peak_bytes=0)
+        _MEM_JOBS.clear()
+        _EST.clear()
+        _EST_COUNT = 0
+        _DELTA["hist"] = Hist(FRACTION_BUCKETS)
+        _DELTA["predicted"] = _DELTA["executed"] = 0
+        _DELTA["mispredictions"] = 0
+        _PHASES.clear()
+
+
+# ----------------------------------------------------- compile accounting --
+def record_compile(site: str, wall_s: float, signature,
+                   cost: dict, memory: dict) -> None:
+    """Land one compile record: per-record entry (bounded), per-site
+    aggregates, the `compiles` engine counter (per-job attribution: a
+    job's status detail shows which job paid the cold-jit tax), and a
+    structured event."""
+    global _COMPILE_DROPPED
+    rec = {
+        "site": site,
+        "wall_s": round(wall_s, 6),
+        "signature": repr(signature),
+        "static_knobs": dict(static_knob_vector()),
+        "flops": cost.get("flops", 0.0),
+        "bytes_accessed": cost.get("bytes accessed", 0.0),
+        **{k: memory.get(k, 0) for k in ("argument_bytes", "output_bytes",
+                                         "temp_bytes", "code_bytes")},
+        "ts": round(time.time(), 3),
+    }
+    with _LOCK:
+        _COMPILES.append(rec)
+        while len(_COMPILES) > COMPILE_RETAIN:
+            _COMPILES.pop(0)
+            _COMPILE_DROPPED += 1
+        agg = _SITES.get(site)
+        if agg is None:
+            agg = _SITES[site] = {"count": 0, "seconds": Hist(COMPILE_BUCKETS),
+                                  "flops_total": 0.0, "bytes_total": 0.0,
+                                  "temp_bytes_max": 0}
+        agg["count"] += 1
+        agg["seconds"].observe_locked(wall_s)
+        agg["flops_total"] += rec["flops"]
+        agg["bytes_total"] += rec["bytes_accessed"]
+        agg["temp_bytes_max"] = max(agg["temp_bytes_max"], rec["temp_bytes"])
+    # per-job attribution + MET-declared counter (lazy import: timers ->
+    # trace -> profile is the load chain, so importing timers at module
+    # scope here would be a cycle)
+    from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+    ENGINE.incr("compiles")
+    from spgemm_tpu.obs import events  # noqa: PLC0415
+    events.emit("compile", site=site, wall_s=rec["wall_s"],
+                flops=rec["flops"], temp_bytes=rec["temp_bytes"])
+
+
+def _cost_dict(compiled) -> dict:
+    """compiled.cost_analysis() across jax versions: a dict, a list of
+    dicts, or unavailable -- always reduced to one plain dict."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 -- accounting must never break dispatch
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost) if isinstance(cost, dict) else {}
+
+
+def _memory_dict(compiled) -> dict:
+    """compiled.memory_analysis() reduced to plain bytes (0 when the
+    backend does not implement it)."""
+    try:
+        mem = compiled.memory_analysis()
+        return {
+            "argument_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+            "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+            "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+            "code_bytes": int(getattr(mem, "generated_code_size_in_bytes",
+                                      0)),
+        }
+    except Exception:  # noqa: BLE001 -- accounting must never break dispatch
+        return {}
+
+
+def _arg_sig(x):
+    """One argument's abstract signature (shape/dtype/placement), pytree
+    lists included -- the key under which one compiled executable is
+    valid.  Placement rides along because an AOT executable is committed
+    to its devices (parallel/chainpart runs one chain per device)."""
+    if isinstance(x, (list, tuple)):
+        return tuple(_arg_sig(e) for e in x)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return repr(x)
+    try:
+        devs = tuple(sorted(d.id for d in x.devices()))
+    except Exception:  # noqa: BLE001 -- placement is best-effort key salt
+        devs = ()
+    return (tuple(shape), str(dtype), devs)
+
+
+class ProfiledJit:
+    """Compile-accounting wrapper over one jit-wrapped callable.
+
+    First contact per abstract signature goes through the AOT surface --
+    `fn.lower(*args, **static_kwargs).compile()` -- timing the compile
+    wall and reading the executable's cost/memory analyses into
+    `record_compile`; the compiled executable is kept and every later
+    same-signature call runs it directly (no double compile: the plain
+    jit dispatch cache is never populated on this path).  Any AOT quirk
+    (an exotic arg pytree, a backend without the surface) permanently
+    degrades THIS wrapper to the uninstrumented jit call -- accounting
+    must never break dispatch.  With the layer disabled
+    (SPGEMM_TPU_OBS_TRACE=0) the wrapper is a plain pass-through.
+
+    Duck-typed on `.lower` (no jax import -- this module stays in the
+    obs jax-free contract); the jax-side modules construct instances.
+    """
+
+    def __init__(self, site: str, fn):
+        self.site = site
+        self._fn = fn
+        self._lock = threading.Lock()
+        self._compiled: dict = {}  # spgemm-lint: guarded-by(_lock)
+        self._broken = not hasattr(fn, "lower")
+
+    def __call__(self, *args, **kwargs):
+        if self._broken or not enabled():
+            return self._fn(*args, **kwargs)
+        try:
+            key = _arg_sig(args)
+            if kwargs:
+                key = (key, tuple(sorted((k, repr(v))
+                                         for k, v in kwargs.items())))
+        except Exception:  # noqa: BLE001 -- accounting must never break dispatch
+            return self._fn(*args, **kwargs)
+        with self._lock:
+            compiled = self._compiled.get(key)
+        if compiled is None:
+            try:
+                t0 = time.perf_counter()
+                compiled = self._fn.lower(*args, **kwargs).compile()
+                wall = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 -- AOT quirk: degrade to plain jit for process lifetime
+                self._broken = True
+                log.warning("compile accounting for %s disabled: AOT "
+                            "lower/compile failed (%r); dispatching the "
+                            "plain jit from here on", self.site, e)
+                return self._fn(*args, **kwargs)
+            record_compile(self.site, wall, key, _cost_dict(compiled),
+                           _memory_dict(compiled))
+            with self._lock:
+                self._compiled[key] = compiled
+        try:
+            # static kwargs are baked into the executable; only the
+            # dynamic args ship
+            return compiled(*args)
+        except Exception as e:  # noqa: BLE001 -- an AOT call mismatch must fall back, not fail the multiply
+            # degrade PERMANENTLY: a persistent call-path mismatch must
+            # not pay a failed dispatch per multiply, and a genuine
+            # runtime error (device OOM) must surface from the plain jit
+            # retry below -- once, not masked forever
+            self._broken = True
+            log.warning("compile-accounted dispatch for %s failed (%r); "
+                        "degrading to the plain jit call", self.site, e)
+            return self._fn(*args, **kwargs)
+
+
+# ------------------------------------------------------- memory watermark --
+def _bump_job_peak_locked(job_id: str, in_use: int) -> None:
+    _MEM_JOBS[job_id] = max(_MEM_JOBS.get(job_id, 0), in_use)
+    _MEM_JOBS.move_to_end(job_id)
+    while len(_MEM_JOBS) > MEM_JOB_RETAIN:
+        _MEM_JOBS.popitem(last=False)
+
+
+def observe_memory(stats: dict | None) -> None:
+    """One device memory_stats() reading, pushed by the jax-side engine
+    at its dispatch/assembly boundaries.  None (the CPU backend, or a
+    raising plugin) leaves every gauge unavailable -- graceful omission,
+    never a crash.  The reading also lands in the per-job window of the
+    emitting thread's span job_id tag (if any) -- a wedged executor's
+    late samples therefore stay attributed to ITS job, never the
+    replacement's."""
+    if not enabled():
+        return
+    if not isinstance(stats, dict) or "bytes_in_use" not in stats:
+        return
+    from spgemm_tpu.obs import trace  # noqa: PLC0415 -- trace lazily imports profile back
+    job_id = trace.RECORDER.current_tags().get("job_id")
+    in_use = int(stats["bytes_in_use"])
+    peak = max(int(stats.get("peak_bytes_in_use", 0)), in_use)
+    with _LOCK:
+        _MEM["available"] = True
+        _MEM["samples"] += 1
+        _MEM["bytes_in_use"] = in_use
+        _MEM["peak_bytes"] = max(_MEM["peak_bytes"], peak)
+        if job_id is not None:
+            _bump_job_peak_locked(str(job_id), in_use)
+
+
+def memory_job_begin(job_id: str) -> None:
+    """Open (or reset) `job_id`'s high-water window, seeded with the
+    newest reading so retained results pinned BEFORE the job count
+    toward its peak.  No-op while the backend has never reported."""
+    with _LOCK:
+        if _MEM["available"]:
+            _MEM_JOBS.pop(str(job_id), None)
+            _bump_job_peak_locked(str(job_id), _MEM["bytes_in_use"])
+
+
+def memory_job_peak(job_id: str | None) -> int | None:
+    """Peak bytes_in_use observed in `job_id`'s window, or None when the
+    backend never reported for it (the detail key is then omitted, not
+    zero).  Non-destructive: a reaped job's detail may be read again at
+    its wedge declaration."""
+    if job_id is None:
+        return None
+    with _LOCK:
+        return _MEM_JOBS.get(str(job_id))
+
+
+def memory_stats() -> dict:
+    with _LOCK:
+        return dict(_MEM)
+
+
+# ------------------------------------------------ prediction accountability --
+def _rel_err(predicted: float, actual: float) -> float:
+    return abs(float(predicted) - float(actual)) / max(float(actual), 1.0)
+
+
+def observe_estimate(est_keys: float, est_pairs: float, est_fanout: float,
+                     actual_keys: float, actual_pairs: float,
+                     actual_fanout: float) -> None:
+    """Score one sampled structure estimate against the exact join it
+    predicted (called when SpgemmPlan.ensure_exact lands the join)."""
+    global _EST_COUNT
+    if not enabled():
+        return
+    errors = {"keys": _rel_err(est_keys, actual_keys),
+              "pairs": _rel_err(est_pairs, actual_pairs),
+              "fanout": _rel_err(est_fanout, actual_fanout)}
+    with _LOCK:
+        _EST_COUNT += 1
+        for quantity, err in errors.items():
+            hist = _EST.get(quantity)
+            if hist is None:
+                hist = _EST[quantity] = Hist(REL_ERR_BUCKETS)
+            hist.observe_locked(err)
+
+
+def observe_delta(predicted_rows: int, executed_rows: int,
+                  total_rows: int) -> None:
+    """Account one delta-enabled multiply.  The histogram records the
+    predicted-dirty FRACTION (predicted rows / total rows; a counted
+    full fallback observes 1.0) -- the per-multiply distribution behind
+    the aggregate delta_rows_* counters, i.e. how incremental the
+    submit stream actually is.  Predicted-vs-executed rows are kept as
+    totals plus a `mispredictions` count: today's engine executes
+    exactly the rows it predicts (the diff's reachability IS the
+    sub-plan), so any divergence is an engine bug worth an alert, not a
+    distribution."""
+    if not enabled():
+        return
+    frac = min(1.0, int(predicted_rows) / max(int(total_rows), 1))
+    with _LOCK:
+        _DELTA["hist"].observe_locked(frac)
+        _DELTA["predicted"] += int(predicted_rows)
+        _DELTA["executed"] += int(executed_rows)
+        if int(executed_rows) != int(predicted_rows):
+            _DELTA["mispredictions"] += 1
+
+
+# -------------------------------------------------- phase latency histogram --
+def observe_phase(name: str, dur_s: float) -> None:
+    """One completed span's duration (fed by the flight recorder on
+    commit -- already gated on the master knob upstream).  Only
+    DECLARED engine phase names are admitted: the recorder also carries
+    spans from ad-hoc PhaseTimers instances (the run-once CLI's local
+    driver phases), which are deliberately outside the MET registry and
+    must not mint undeclared label values on a declared-only family."""
+    from spgemm_tpu.obs.metrics import ENGINE_PHASES  # noqa: PLC0415 -- metrics lazily imports profile back
+    if name not in ENGINE_PHASES:
+        return
+    with _LOCK:
+        hist = _PHASES.get(name)
+        if hist is None:
+            hist = _PHASES[name] = Hist(PHASE_BUCKETS)
+        hist.observe_locked(dur_s)
+
+
+# ------------------------------------------------------------- inspection --
+def compile_stats() -> dict:
+    """Per-site compile aggregates (Prometheus-shaped histograms)."""
+    with _LOCK:
+        return {site: {"count": agg["count"],
+                       "seconds": agg["seconds"].snapshot_locked(),
+                       "flops_total": agg["flops_total"],
+                       "bytes_total": agg["bytes_total"],
+                       "temp_bytes_max": agg["temp_bytes_max"]}
+                for site, agg in sorted(_SITES.items())}
+
+
+def est_stats() -> dict:
+    with _LOCK:
+        return {"count": _EST_COUNT,
+                "rel_error": {q: h.snapshot_locked()
+                              for q, h in sorted(_EST.items())}}
+
+
+def delta_stats() -> dict:
+    with _LOCK:
+        return {"count": _DELTA["hist"].count,
+                "predicted_rows": _DELTA["predicted"],
+                "executed_rows": _DELTA["executed"],
+                "mispredictions": _DELTA["mispredictions"],
+                "dirty_fraction": _DELTA["hist"].snapshot_locked()}
+
+
+def phase_stats() -> dict:
+    with _LOCK:
+        return {name: h.snapshot_locked()
+                for name, h in sorted(_PHASES.items())}
+
+
+def report() -> dict:
+    """The `cli profile [--json]` payload: bounded per-record compile
+    list + every aggregate account.  jax-free (daemon scrape-side)."""
+    from spgemm_tpu.obs import events  # noqa: PLC0415
+    with _LOCK:
+        compiles = [dict(r) for r in _COMPILES]
+        dropped = _COMPILE_DROPPED
+    return {
+        "enabled": enabled(),
+        "compiles": compiles,
+        "compiles_dropped": dropped,
+        "compile_sites": compile_stats(),
+        "memory": memory_stats(),
+        "estimator": est_stats(),
+        "delta": delta_stats(),
+        "events": events.LOG.stats(),
+    }
+
+
+def summary() -> dict:
+    """The one-line accountability digest (`cli knobs`, bench detail):
+    compile count/wall, estimator mean relative errors, delta prediction
+    mean error -- the numbers an operator eyeballs for drift."""
+    with _LOCK:
+        n_compiles = sum(agg["count"] for agg in _SITES.values())
+        compile_s = sum(agg["seconds"].sum for agg in _SITES.values())
+        est = {q: round(h.sum / h.count, 4)
+               for q, h in sorted(_EST.items()) if h.count}
+        est_n = _EST_COUNT
+        d = _DELTA["hist"]
+        delta_frac = round(d.sum / d.count, 4) if d.count else None
+        delta_n = d.count
+        mispredict = _DELTA["mispredictions"]
+        mem = (_MEM["peak_bytes"] if _MEM["available"] else None)
+    return {"compiles": n_compiles, "compile_s": round(compile_s, 4),
+            "est_observations": est_n, "est_mean_rel_error": est,
+            "delta_observations": delta_n,
+            "delta_mean_dirty_fraction": delta_frac,
+            "delta_mispredictions": mispredict,
+            "hbm_peak_bytes": mem}

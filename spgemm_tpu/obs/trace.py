@@ -164,6 +164,12 @@ class FlightRecorder:
         tags = self.current_tags()
         if tags:
             span["tags"] = tags
+        if ph == "X":
+            # scrape-side phase latency: every completed span feeds the
+            # per-phase histogram (obs/profile; already master-knob-gated
+            # -- _commit is only reached while emission is enabled)
+            from spgemm_tpu.obs import profile  # noqa: PLC0415
+            profile.observe_phase(name, dur_s)
         cap = ring_cap()
         with self._lock:
             self._spans.append(span)

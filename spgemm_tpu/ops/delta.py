@@ -77,6 +77,11 @@ _LOCK = threading.Lock()
 _STORE: "OrderedDict[str, DeltaEntry]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
 _STATS = {"hits": 0, "full_fallbacks": 0, "evictions": 0,
           "rows_recomputed": 0, "rows_total": 0}  # spgemm-lint: guarded-by(_LOCK)
+# per-reason fallback split (ops/spgemm passes the reason it diagnosed:
+# "no_entry" = first contact or store eviction, "provenance_mismatch" =
+# a lineage the store could not prove) -- the event log carries the same
+# strings, so a drifting fallback mix is attributable from either surface
+_FALLBACK_REASONS: dict = {}  # spgemm-lint: guarded-by(_LOCK)
 # Monotonic tag-version source, process-wide and NEVER reset (clear()
 # included): per-entry version counters would repeat after a store
 # eviction re-seeded an entry at version 1, and a consumer still holding
@@ -266,6 +271,14 @@ def lookup(key: str):
         return entry
 
 
+def note_fallback_reason(reason: str) -> None:
+    """Count one full fallback under its diagnosed reason (see
+    _FALLBACK_REASONS; called by ops/spgemm next to the
+    delta_full_fallbacks counter bump)."""
+    with _LOCK:
+        _FALLBACK_REASONS[reason] = _FALLBACK_REASONS.get(reason, 0) + 1
+
+
 def clear() -> None:
     """Drop every entry and zero the stats (tests, A/B harnesses, bench
     iterations -- a retained result would otherwise answer a re-run)."""
@@ -273,6 +286,7 @@ def clear() -> None:
         _STORE.clear()
         for k in _STATS:
             _STATS[k] = 0
+        _FALLBACK_REASONS.clear()
 
 
 def stats() -> dict:
@@ -284,6 +298,7 @@ def stats() -> dict:
         return {
             "hits": _STATS["hits"],
             "full_fallbacks": _STATS["full_fallbacks"],
+            "fallback_reasons": dict(_FALLBACK_REASONS),
             "evictions": _STATS["evictions"],
             "rows_recomputed": _STATS["rows_recomputed"],
             "rows_total": _STATS["rows_total"],

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spgemm_tpu.obs import events as obs_events
+from spgemm_tpu.obs import profile as obs_profile
 from spgemm_tpu.ops import estimate, plancache, u64
 from spgemm_tpu.utils import knobs
 from spgemm_tpu.ops.symbolic import (SpgemmPlan, accept_round_stack,
@@ -137,11 +139,15 @@ def numeric_round_impl(a_hi, a_lo, b_hi, b_lo, pa, pb):
     return out_h, out_l
 
 
-_numeric_round = jax.jit(numeric_round_impl)
+# compile-accounted jit (obs/profile): first contact per shape signature
+# goes through the AOT surface so compile wall + cost/memory analyses land
+# in the profiling layer; bit-identical dispatch either way, and a plain
+# jit call under SPGEMM_TPU_OBS_TRACE=0
+_numeric_round = obs_profile.ProfiledJit("numeric_round",
+                                         jax.jit(numeric_round_impl))
 
 
-@jax.jit
-def _assemble(outs_h, outs_l, take):
+def _assemble_impl(outs_h, outs_l, take):
     """Round-batched assembly: pad-concat the (whole, padded) round outputs,
     append one zero row, and gather both planes through the precomputed
     inverse permutation (ops/symbolic.assembly_permutation) -- one executable
@@ -153,6 +159,10 @@ def _assemble(outs_h, outs_l, take):
     cat_h = jnp.concatenate(list(outs_h) + [zero], axis=0)
     cat_l = jnp.concatenate(list(outs_l) + [zero], axis=0)
     return cat_h[take], cat_l[take]
+
+
+_assemble = obs_profile.ProfiledJit("assembly_gather",
+                                    jax.jit(_assemble_impl))
 
 
 def _proof_fanout_cap(a_bound: int, b_bound: int, k: int) -> int | None:
@@ -471,12 +481,25 @@ def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
                 and est.est_max_fanout <= split):
             est_split = None
 
-        def build_exact(p: SpgemmPlan, build_split) -> None:
+        def build_exact(p: SpgemmPlan, build_split,
+                        score_est: bool = False) -> None:
             """Fill join/rounds/take in place from the exact symbolic
             join.  Host-pure (runs on plan-ahead worker threads); phase
             accumulation attributes to whichever thread forced it."""
             with timers.phase("symbolic_join"):
                 join = symbolic_join(a_coords, b_coords)
+            if score_est and est is not None:
+                # prediction accountability (obs/profile): the moment the
+                # exact join exists, the estimate that STEERED this plan
+                # is scored against it -- estimator drift becomes an
+                # alertable series, not a silent mis-plan.  Scored only
+                # on the estimated route: a low-confidence estimate the
+                # engine already rejected (join_fallback) must not bias
+                # the drift alert with errors that never steered anything
+                obs_profile.observe_estimate(
+                    est.est_keys, est.est_pairs, est.est_max_fanout,
+                    join.num_keys, int(join.pair_ptr[-1]),
+                    int(join.fanouts.max()) if join.num_keys else 0)
             with timers.phase("plan_rounds"):
                 if batch:
                     # round-batched dispatch: one mega-round per fanout
@@ -516,13 +539,18 @@ def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
             estimate.note_hit()
             timers.incr("est_hits")
             p.plan_route = "estimated"
-            p._exact_builder = partial(build_exact, build_split=est_split)
+            p._exact_builder = partial(build_exact, build_split=est_split,
+                                       score_est=True)
         elif est is not None:
             # estimator ran but the sample is not trustworthy (skewed
             # mass): take the exact join inline, visibly, with the FULL
             # proof-threshold partition (never the distrusted estimate's)
             estimate.note_fallback()
             timers.incr("est_fallbacks")
+            obs_events.emit("est_fallback", reason="low_confidence",
+                            confidence=round(est.confidence, 4),
+                            sampled_rows=est.sampled_rows,
+                            total_rows=est.total_rows)
             with timers.phase("join_fallback"):
                 build_exact(p, build_split=split)
         else:
@@ -536,6 +564,21 @@ def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
                 # into the engine registry like the hit/miss pair
                 timers.incr("plan_cache_evictions", evicted)
         return p
+
+
+def _observe_memory() -> None:
+    """Sample device memory_stats() into the profiling layer's watermark
+    account (obs/profile.observe_memory).  Backends without the API (the
+    CPU backend returns None; an exotic plugin may raise) leave every
+    HBM gauge gracefully absent -- telemetry must never break dispatch.
+    Main-thread only, like every other backend touch in this module."""
+    if not obs_profile.enabled():
+        return
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 -- telemetry must never break dispatch
+        stats = None
+    obs_profile.observe_memory(stats)
 
 
 def execute(plan: SpgemmPlan, a, b):
@@ -616,6 +659,9 @@ def execute(plan: SpgemmPlan, a, b):
             zero = jnp.zeros((1, k, k), jnp.uint32)
             out_hi = jnp.concatenate(outs_h + [zero], axis=0)[take]
             out_lo = jnp.concatenate(outs_l + [zero], axis=0)[take]
+    # HBM watermark sample at the multiply boundary: dispatch + assembly
+    # are enqueued, so bytes_in_use covers this multiply's working set
+    _observe_memory()
 
     # structured observability (SURVEY.md section 5.5): size, fill-in, work
     total_pairs = int(join.pair_ptr[-1])
@@ -694,8 +740,7 @@ def subplan(parent: SpgemmPlan,
     return sub, kept
 
 
-@jax.jit
-def _splice(prev_hi, prev_lo, idx, take, sub_hi, sub_lo):
+def _splice_impl(prev_hi, prev_lo, idx, take, sub_hi, sub_lo):
     """Delta splice: scatter the recomputed rows (gathered through
     `take`) into the retained previous planes at `idx`.  One fused
     executable; idx/take are ladder-padded by the caller (pad slots
@@ -703,6 +748,9 @@ def _splice(prev_hi, prev_lo, idx, take, sub_hi, sub_lo):
     zeros onto zeros), so the compiled-shape count stays logarithmic as
     the dirty-key count drifts across submits."""
     return prev_hi.at[idx].set(sub_hi[take]), prev_lo.at[idx].set(sub_lo[take])
+
+
+_splice = obs_profile.ProfiledJit("delta_splice", jax.jit(_splice_impl))
 
 
 def _delta_key(plan: SpgemmPlan, a, b) -> str:
@@ -742,10 +790,17 @@ def _delta_execute(plan: SpgemmPlan, a, b):
     key = _delta_key(plan, a, b)
     entry = delta.lookup(key)
     d = None
+    # fallback provenance for the event log / per-reason stats: an absent
+    # entry is first contact OR a store eviction (indistinguishable by
+    # design -- eviction forgets), a failed diff is a lineage the store
+    # could not prove
+    reason = "no_entry" if entry is None else None
     if entry is not None:
         with timers.phase("delta_diff"):
             d = delta.diff(entry, a, b, join, plan._a_coords,
                            plan._b_coords)
+        if d is None:
+            reason = "provenance_mismatch"
     if d is None:
         # first contact / provenance mismatch / store eviction: the full
         # path, loudly counted, and the entry (re)seeded so the next
@@ -756,6 +811,13 @@ def _delta_execute(plan: SpgemmPlan, a, b):
         timers.incr("delta_full_fallbacks")
         timers.incr("delta_rows_recomputed", total_rows)
         timers.incr("delta_rows_total", total_rows)
+        delta.note_fallback_reason(reason)
+        obs_events.emit("delta_fallback", reason=reason,
+                        total_rows=total_rows)
+        # accountability: a full fallback predicted -- and executed --
+        # everything (error 0 by definition, but the observation count
+        # keeps the series honest about how often delta even applies)
+        obs_profile.observe_delta(total_rows, total_rows, total_rows)
         result = execute(plan, a, b)
         with timers.phase("delta_diff"):
             delta.store_full(key, a, b, result, total_rows, out_row_ids)
@@ -767,6 +829,11 @@ def _delta_execute(plan: SpgemmPlan, a, b):
     n_dirty = len(d.dirty_rows)
     timers.incr("delta_rows_recomputed", n_dirty)
     timers.incr("delta_rows_total", total_rows)
+    # accountability: predicted dirty rows vs what actually re-executes
+    # (an all-dirty diff degenerates to the full path and executes every
+    # row; an empty diff executes none)
+    executed = total_rows if n_dirty >= total_rows else n_dirty
+    obs_profile.observe_delta(n_dirty, executed, total_rows)
     if n_dirty == 0:
         # empty diff: the retained result IS this multiply's result (the
         # digests/tags prove both operands byte-identical to last time)
@@ -800,6 +867,8 @@ def _delta_execute(plan: SpgemmPlan, a, b):
             result = DeviceBlockMatrix(rows=a.rows, cols=b.cols, k=plan.k,
                                        coords=join.keys, hi=out_hi,
                                        lo=out_lo, val_bound=min(vb, cap))
+        _observe_memory()  # splice retains prev + sub planes: the delta
+        # path's HBM watermark is exactly what DELTA_RETAIN sizing needs
         log.info("spgemm[delta]: recomputed %d/%d output rows "
                  "(%d/%d keys)", n_dirty, total_rows, n_sub,
                  join.num_keys)
@@ -1080,6 +1149,7 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
             raise prep_err[0]
         if land_err:
             raise land_err[0]
+    _observe_memory()
 
     total_pairs = int(join.pair_ptr[-1])
     tag = backend if choose_numeric is None \

@@ -344,6 +344,15 @@ class SpgemmPlan:
             if builder is not None:
                 builder(self)
                 self._exact_builder = None
+                # event-log breadcrumb: WHERE the deferred join landed
+                # (the plan-ahead worker off the critical path, or a
+                # consumer that had to block) -- the estimator's latency
+                # win is only real when this mostly reads a worker thread
+                from spgemm_tpu.obs import events  # noqa: PLC0415
+                events.emit("plan_exact_landed",
+                            thread=threading.current_thread().name,
+                            fingerprint=(self.fingerprint or "")[:16]
+                            or None)
         return self
 
     def check_operands(self, a, b) -> None:

@@ -103,6 +103,17 @@ def trace(socket_path: str | None = None) -> list[dict]:
     return request({"op": "trace"}, socket_path)["trace_events"]
 
 
+def profile(socket_path: str | None = None) -> dict:
+    """The daemon's deep-profiling report (obs/profile.py): compile/
+    cost/memory accounting + prediction accountability."""
+    return request({"op": "profile"}, socket_path)["profile"]
+
+
+def events(n: int = 50, socket_path: str | None = None) -> list[dict]:
+    """The newest n structured event-log records (obs/events.py)."""
+    return request({"op": "events", "n": n}, socket_path)["events"]
+
+
 def shutdown(socket_path: str | None = None) -> dict:
     return request({"op": "shutdown"}, socket_path)
 
@@ -173,6 +184,93 @@ def main_metrics(argv: list[str] | None = None) -> int:
     except (ServeError, OSError) as e:
         print(f"metrics failed: {e}", file=sys.stderr)
         return 1
+    return 0
+
+
+def main_profile(argv: list[str] | None = None) -> int:
+    """`spgemm_tpu profile [--json]`: the running daemon's deep-profiling
+    report -- compile/cost/memory accounting (compile wall, XLA FLOPs/
+    bytes, temp footprints per jit site), HBM watermarks, and estimator/
+    delta prediction accountability."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu profile",
+        description="report the running spgemmd daemon's deep-profiling "
+                    "accounts: jit compile wall + cost_analysis FLOPs/"
+                    "bytes + memory_analysis footprints per engine site, "
+                    "device memory watermarks, estimator and delta "
+                    "prediction accuracy")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
+                        "or <tmpdir>/spgemmd-<uid>.sock)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="full machine-readable report (per-record compile "
+                        "list + every aggregate account)")
+    args = p.parse_args(argv)
+    try:
+        rep = profile(args.socket)
+    except (ServeError, OSError) as e:
+        print(f"profile failed: {e}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(rep, indent=2))
+        return 0
+    for site, agg in rep.get("compile_sites", {}).items():
+        print(f"compile {site}: x{agg['count']} "
+              f"wall={agg['seconds']['sum']:.3f}s "
+              f"flops={agg['flops_total']:.3g} "
+              f"bytes={agg['bytes_total']:.3g} "
+              f"temp_max={agg['temp_bytes_max']}")
+    if not rep.get("compile_sites"):
+        print("no compile records yet")
+    mem = rep.get("memory", {})
+    if mem.get("available"):
+        print(f"hbm: in_use={mem['bytes_in_use']} "
+              f"peak={mem['peak_bytes']} samples={mem['samples']}")
+    else:
+        print("hbm: backend reports no memory_stats (gauges omitted)")
+    est = rep.get("estimator", {})
+    if est.get("count"):
+        errs = {q: f"{h['sum'] / h['count']:.4f}"
+                for q, h in est["rel_error"].items() if h["count"]}
+        print(f"estimator: x{est['count']} mean_rel_error={errs}")
+    dlt = rep.get("delta", {})
+    if dlt.get("count"):
+        frac = dlt["dirty_fraction"]
+        mean = frac["sum"] / frac["count"] if frac["count"] else 0.0
+        print(f"delta: x{dlt['count']} predicted={dlt['predicted_rows']} "
+              f"executed={dlt['executed_rows']} "
+              f"mispredictions={dlt['mispredictions']} "
+              f"mean_dirty_fraction={mean:.4f}")
+    ev = rep.get("events", {})
+    print(f"events: emitted={ev.get('emitted', 0)} "
+          f"bytes={ev.get('bytes', 0)} path={ev.get('path')}")
+    return 0
+
+
+def main_events(argv: list[str] | None = None) -> int:
+    """`spgemm_tpu events [--tail N]`: the running daemon's newest
+    structured event-log records, one JSON object per line."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu events",
+        description="print the running spgemmd daemon's newest "
+                    "structured event-log records (job lifecycle, "
+                    "watchdog reap/degrade, est/delta fallbacks, compile "
+                    "records) as JSONL")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
+                        "or <tmpdir>/spgemmd-<uid>.sock)")
+    p.add_argument("--tail", type=int, default=50, metavar="N",
+                   help="newest N records (default 50; bounded by the "
+                        "daemon's in-process event ring -- the on-disk "
+                        "<socket>.events.jsonl holds the longer history)")
+    args = p.parse_args(argv)
+    try:
+        recs = events(args.tail, args.socket)
+    except (ServeError, OSError) as e:
+        print(f"events failed: {e}", file=sys.stderr)
+        return 1
+    for rec in recs:
+        print(json.dumps(rec, separators=(",", ":")))
     return 0
 
 

@@ -50,7 +50,9 @@ import sys
 import threading
 import time
 
+from spgemm_tpu.obs import events as obs_events
 from spgemm_tpu.obs import metrics as obs_metrics
+from spgemm_tpu.obs import profile as obs_profile
 from spgemm_tpu.obs import trace as obs_trace
 from spgemm_tpu.serve import protocol
 from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
@@ -162,6 +164,9 @@ class Daemon:
         # postmortem flight dumps (watchdog reap / wedge / degrade) land
         # here, next to the journal: <socket>.flight/<job>.trace.json
         self.flight_dir = self.socket_path + ".flight"
+        # structured event log (obs/events.py): JSONL next to the journal,
+        # rotated at SPGEMM_TPU_OBS_EVENTS_MAX_KB
+        self.events_path = self.socket_path + ".events.jsonl"
         self._runner = runner or run_chain_job
         self._probe = probe
         self._cap = queue_cap if queue_cap is not None \
@@ -313,6 +318,8 @@ class Daemon:
                 peer.close()
                 raise RuntimeError(
                     f"a daemon is already serving on {self.socket_path}")
+        obs_events.LOG.configure(self.events_path)
+        obs_events.emit("daemon_start", socket=self.socket_path)
         self._journal_replay()
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
@@ -351,6 +358,9 @@ class Daemon:
         ex = self._executor
         if ex is not None:
             ex.join(timeout=5.0)  # wedged executor: daemon flag covers it
+        # drain the async event-log writer so a clean shutdown leaves the
+        # JSONL complete (best-effort, like the sink itself)
+        obs_events.LOG.flush(timeout=2.0)
         try:
             os.unlink(self.socket_path)
         except OSError:
@@ -393,6 +403,12 @@ class Daemon:
                 # first per-job phase so a scraper sees admission latency
                 with obs_trace.RECORDER.tagged(job_id=job.id,
                                                trace_id=job.id):
+                    obs_events.emit("job_start", degraded=degraded,
+                                    folder=job.folder)
+                    # open this job's HBM watermark window (keyed by job
+                    # id: a wedged predecessor's late samples land in
+                    # ITS window, never this job's)
+                    obs_profile.memory_job_begin(job.id)
                     ENGINE.record("serve_queue_wait",
                                   max(0.0, (job.started_at
                                             or job.submitted_at)
@@ -408,16 +424,19 @@ class Daemon:
                 log.warning("job %s failed: %r", job.id, e)
                 if job.finish("failed", error={
                         "code": protocol.E_JOB_ERROR, "message": repr(e)},
-                        detail=self._job_detail(scope, degraded),
+                        detail=self._job_detail(scope, degraded, job.id),
                         on_commit=lambda: self._journal_append(
                             {"event": "failed", "id": job.id})):
                     self._observe_terminal(job, "error")
+                    obs_events.emit("job_failed", job_id=job.id,
+                                    error=repr(e))
             else:
                 if job.finish("done",
-                              detail=self._job_detail(scope, degraded),
+                              detail=self._job_detail(scope, degraded, job.id),
                               on_commit=lambda: self._journal_append(
                                   {"event": "done", "id": job.id})):
                     self._observe_terminal(job, "done")
+                    obs_events.emit("job_done", job_id=job.id)
             finally:
                 # detach the per-job collector: a wedged executor that
                 # unwedges hours later closes the OLD job's scope here --
@@ -431,11 +450,17 @@ class Daemon:
                     self._current = None
 
     @staticmethod
-    def _job_detail(scope, degraded: bool) -> dict:
+    def _job_detail(scope, degraded: bool, job_id: str | None = None) -> dict:
         """The per-job status detail: the same phases_s + engine counters
         bench.py emits, scoped to this job alone (PhaseScope diff)."""
         counters = scope.counter_snapshot()
+        # per-job HBM high-water mark (obs/profile window keyed by job
+        # id); None on backends without memory_stats -> key omitted,
+        # never a zero that reads as "no memory used"
+        hbm_peak = obs_profile.memory_job_peak(job_id)
         return {"phases_s": scope.snapshot(), "degraded": degraded,
+                **({"hbm_peak_bytes": hbm_peak}
+                   if hbm_peak is not None else {}),
                 "plan_cache_hits": counters.get("plan_cache_hits", 0),
                 "plan_cache_misses": counters.get("plan_cache_misses", 0),
                 # the delta-recompute ratio (ops/delta): output tile-rows
@@ -457,7 +482,7 @@ class Daemon:
         scope = job.scope
         if scope is None:
             return None
-        return self._job_detail(scope, job.scope_degraded)
+        return self._job_detail(scope, job.scope_degraded, job.id)
 
     # ------------------------------------------------------ observability --
     def _observe_terminal(self, job: Job, outcome: str) -> None:
@@ -569,6 +594,8 @@ class Daemon:
                     ENGINE.incr("serve_reaps")
                     obs_trace.RECORDER.instant("serve_reap",
                                                job_id=job.id)
+                    obs_events.emit("watchdog_reap", job_id=job.id,
+                                    timeout_s=job.timeout_s)
                     self._observe_terminal(job, "timeout")
                     self._flight_dump(job.id)
             reaped = self._reaped
@@ -583,6 +610,8 @@ class Daemon:
                 elif time.time() - self._reaped_at > self._wedge_grace_s:
                     self._reaped = None
                     self._flight_dump(f"{reaped.id}.wedged")
+                    obs_events.emit("watchdog_wedge", job_id=reaped.id,
+                                    grace_s=self._wedge_grace_s)
                     self._degrade(f"executor wedged on reaped job "
                                   f"{reaped.id}")
             elif reaped is not None and self._current is not reaped:
@@ -611,6 +640,7 @@ class Daemon:
         from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
         ENGINE.incr("serve_degrades")
         obs_trace.RECORDER.instant("serve_degrade", job_id=None)
+        obs_events.emit("daemon_degrade", reason=reason)
         self._flight_dump("degrade")
         probe = self._probe
         if probe is None:
@@ -704,6 +734,10 @@ class Daemon:
             return self._op_metrics()
         if op == "trace":
             return self._op_trace()
+        if op == "profile":
+            return self._op_profile()
+        if op == "events":
+            return self._op_events(msg)
         return self._op_shutdown()
 
     def _op_submit(self, msg: dict) -> dict:
@@ -786,6 +820,8 @@ class Daemon:
                 protocol.E_QUEUE_FULL,
                 f"queue full ({e.cap} jobs queued); retry later or raise "
                 "SPGEMM_TPU_SERVE_QUEUE_CAP", id=None)
+        obs_events.emit("job_submit", job_id=job.id, folder=folder,
+                        queued=depth)
         return protocol.ok(id=job.id, state=job.state, queued=depth)
 
     def _op_status(self, msg: dict, wait: bool) -> dict:
@@ -850,6 +886,8 @@ class Daemon:
             jobs_terminal=terminal,
             journal=self._journal_stats(),
             trace=obs_trace.RECORDER.stats(),
+            events=obs_events.LOG.stats(),
+            profile=obs_profile.summary(),
             flight_dir=self.flight_dir,
             plan_cache=cache,
             delta=delta_stats,
@@ -896,6 +934,25 @@ class Daemon:
         (the same serialization the postmortem auto-dump writes)."""
         events = obs_trace.to_trace_events()
         return protocol.ok(spans=len(events), trace_events=events)
+
+    def _op_profile(self) -> dict:
+        """The deep-profiling report (obs/profile.py): compile/cost/
+        memory accounting + estimator/delta prediction accountability.
+        jax-free scrape-side, like metrics."""
+        return protocol.ok(profile=obs_profile.report())
+
+    def _op_events(self, msg: dict) -> dict:
+        """The newest N structured event-log records (obs/events.py
+        ring; the on-disk JSONL next to the journal holds the longer
+        history)."""
+        n = msg.get("n", 50)
+        try:
+            n = int(n)
+        except (TypeError, ValueError):
+            return protocol.error(protocol.E_BAD_REQUEST,
+                                  f"n must be an integer, got {n!r}")
+        return protocol.ok(events=obs_events.LOG.tail(n),
+                           log=obs_events.LOG.stats())
 
     def _op_shutdown(self) -> dict:
         self._stop.set()

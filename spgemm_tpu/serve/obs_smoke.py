@@ -8,6 +8,20 @@ observability contract:
   * the per-phase engine series (`spgemm_phase_seconds_total{phase=...}`)
     and the plan-cache series MOVE across the submit -- a daemon whose
     metrics never change is a daemon you cannot operate;
+  * the deep-profiling families (obs/profile.py) appear and move:
+    compile accounting (`spgemm_compiles_total{site="numeric_round"}`
+    with nonzero cost-model FLOPs), the span-fed phase latency
+    histogram (`spgemm_phase_seconds_count{phase="plan"}`), estimator
+    prediction accountability (`spgemm_est_rel_error_count` -- the
+    chain is sized past the estimator's row-sample budget so the
+    daemon's plans take the estimated route and are scored on landing),
+    delta prediction accountability (`spgemm_delta_dirty_fraction_count`),
+    and the event-log counters;
+  * `spgemm_tpu.cli profile --json` reports >= 1 compile record with
+    nonzero FLOPs through the real CLI (the acceptance gate);
+  * `spgemm_tpu.cli events --tail` returns the submit's lifecycle
+    records (job_submit/job_start/job_done carrying the job id) and the
+    JSONL file landed next to the journal;
   * terminal job accounting works (`spgemmd_jobs_terminal_total{
     outcome="done"}` counts the job);
   * the `trace` op returns Perfetto/Chrome trace_event JSON whose spans
@@ -58,13 +72,18 @@ def main() -> int:
 
     from spgemm_tpu.serve import client  # noqa: PLC0415
     from spgemm_tpu.utils import io_text  # noqa: PLC0415
-    from spgemm_tpu.utils.gen import random_chain  # noqa: PLC0415
+    from spgemm_tpu.utils.gen import banded_block_sparse  # noqa: PLC0415
 
     tmp = tempfile.mkdtemp(prefix="spgemmd-obs-smoke-")
     sock = os.path.join(tmp, "d.sock")
     folder = os.path.join(tmp, "chain_in")
+    # banded, 64 tile-rows: PAST the estimator's row-sample budget
+    # (SPGEMM_TPU_EST_SAMPLE_ROWS default 48), so the daemon's
+    # first-contact plans take the estimated route and the accuracy
+    # series gets scored when the deferred exact joins land
     n, k = 4, 4
-    mats = random_chain(n, 6, k, 0.5, np.random.default_rng(7), "full")
+    rng = np.random.default_rng(7)
+    mats = [banded_block_sparse(64, k, 1, rng, "full") for _ in range(n)]
     io_text.write_chain_dir(folder, mats, k)
 
     proc = subprocess.Popen(
@@ -116,6 +135,67 @@ def main() -> int:
         if after.get("spgemm_trace_spans_emitted_total", 0) <= 0:
             return _fail(proc, "flight recorder emitted no spans")
 
+        # deep-profiling families (obs/profile.py): compile accounting
+        # with nonzero cost, span-fed phase latency histogram, estimator
+        # + delta prediction accountability, event-log counters -- all
+        # must appear and move across the submit
+        compiles = 'spgemm_compiles_total{site="numeric_round"}'
+        if after.get(compiles, 0) <= before.get(compiles, 0):
+            return _fail(proc, f"{compiles} did not move across the "
+                               "submit")
+        flops = 'spgemm_compile_flops_total{site="numeric_round"}'
+        if after.get(flops, 0) <= 0:
+            return _fail(proc, "compile cost accounting reports zero "
+                               "FLOPs for the numeric round")
+        phase_hist = 'spgemm_phase_seconds_count{phase="plan"}'
+        if after.get(phase_hist, 0) <= before.get(phase_hist, 0):
+            return _fail(proc, f"{phase_hist} did not move across the "
+                               "submit (span-fed phase histogram)")
+        est_count = 'spgemm_est_rel_error_count{quantity="keys"}'
+        if after.get(est_count, 0) <= 0:
+            return _fail(proc, "estimator accuracy series has no "
+                               "observations after an estimator-routed "
+                               "submit")
+        if after.get("spgemm_delta_dirty_fraction_count", 0) <= 0:
+            return _fail(proc, "delta prediction-accountability series "
+                               "has no observations")
+        ev_count = "spgemm_events_emitted_total"
+        if after.get(ev_count, 0) <= before.get(ev_count, 0):
+            return _fail(proc, "event-log counter did not move across "
+                               "the submit")
+
+        # `cli profile --json` through the real CLI: >= 1 compile record
+        # with nonzero cost (the acceptance gate)
+        rc = subprocess.run(
+            [sys.executable, "-m", "spgemm_tpu.cli", "profile",
+             "--socket", sock, "--json"],
+            capture_output=True, text=True, timeout=60)
+        if rc.returncode != 0:
+            return _fail(proc, f"cli profile failed: {rc.stderr[-500:]}")
+        prof = json.loads(rc.stdout)
+        recs = [r for r in prof.get("compiles", []) if r.get("flops", 0) > 0]
+        if not recs:
+            return _fail(proc, "cli profile --json reports no compile "
+                               "record with nonzero cost")
+
+        # `cli events --tail` through the real CLI: the submit's
+        # lifecycle records, correlated by job id
+        rc = subprocess.run(
+            [sys.executable, "-m", "spgemm_tpu.cli", "events",
+             "--socket", sock, "--tail", "200"],
+            capture_output=True, text=True, timeout=60)
+        if rc.returncode != 0:
+            return _fail(proc, f"cli events failed: {rc.stderr[-500:]}")
+        recs = [json.loads(line) for line in rc.stdout.splitlines() if line]
+        kinds = {r["kind"] for r in recs
+                 if r.get("job_id") == job_id}
+        if not {"job_submit", "job_start", "job_done"} <= kinds:
+            return _fail(proc, f"event log lacks the job lifecycle for "
+                               f"{job_id} (saw kinds {sorted(kinds)})")
+        if not os.path.exists(sock + ".events.jsonl"):
+            return _fail(proc, "event-log JSONL did not land next to "
+                               "the journal")
+
         events = client.trace(sock)
         if not events or not isinstance(events, list):
             return _fail(proc, "trace op returned no events")
@@ -152,8 +232,9 @@ def main() -> int:
     finally:
         if proc.poll() is None:
             proc.kill()
-    print(f"obs-smoke: OK (phase+plan-cache series moved, {len(events)} "
-          f"trace events, {len(tagged)} tagged {job_id}, clean shutdown)")
+    print(f"obs-smoke: OK (phase+plan-cache+compile+accuracy series "
+          f"moved, profile/events CLIs answered, {len(events)} trace "
+          f"events, {len(tagged)} tagged {job_id}, clean shutdown)")
     return 0
 
 

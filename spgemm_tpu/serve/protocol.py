@@ -31,6 +31,15 @@ Ops:
                                        flight recorder as Perfetto/Chrome
                                        trace_event JSON (obs/trace.py;
                                        `spgemm_tpu.cli trace-dump`)
+  profile  {}                       -> {profile: <deep-profiling report>}
+                                       -- compile/cost/memory accounting +
+                                       estimator/delta prediction
+                                       accountability (obs/profile.py;
+                                       `spgemm_tpu.cli profile`)
+  events   {n?}                     -> {events: [newest n JSONL records]}
+                                       -- the structured event log's ring
+                                       (obs/events.py; `spgemm_tpu.cli
+                                       events --tail N`)
   shutdown {}                       -> {stopping: true}
 
 jax-free by design: the client must be importable (and the protocol
@@ -47,7 +56,8 @@ from spgemm_tpu.utils import knobs
 
 PROTOCOL_VERSION = 1
 
-OPS = ("submit", "status", "wait", "stats", "metrics", "trace", "shutdown")
+OPS = ("submit", "status", "wait", "stats", "metrics", "trace", "profile",
+       "events", "shutdown")
 
 # server-side bound on one request line: a peer streaming newline-free
 # bytes must exhaust THIS, not the daemon's memory (real requests are a

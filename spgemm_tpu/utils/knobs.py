@@ -207,6 +207,21 @@ _KNOBS = (
          "spans and evicts the oldest (dropped spans are counted, never "
          "an unbounded buffer in a resident daemon).",
          "obs/trace.py", default="4096", minimum=1),
+    Knob("SPGEMM_TPU_OBS_EVENTS", "bool01",
+         "Structured event log (obs/events.py): 1 = engine/daemon "
+         "lifecycle events (job transitions, watchdog reap/degrade, "
+         "est/delta fallbacks with reasons, jit compile records) are "
+         "emitted as JSONL -- into a bounded in-process ring always, and "
+         "onto disk next to the spgemmd journal (<socket>.events.jsonl, "
+         "rotated at SPGEMM_TPU_OBS_EVENTS_MAX_KB); 0 = no event "
+         "emission anywhere.",
+         "obs/events.py", default="1"),
+    Knob("SPGEMM_TPU_OBS_EVENTS_MAX_KB", "int",
+         "Event-log rotation threshold in KiB: when the on-disk JSONL "
+         "grows past this the file rotates to <path>.1 (one rotation "
+         "generation -- worst-case disk is ~2x this cap, never unbounded "
+         "under a resident daemon).",
+         "obs/events.py", default="256", minimum=1),
     Knob("SPGEMM_TPU_PROBE_TIMEOUT", "float",
          "Backend liveness probe subprocess timeout, seconds (a dead TPU "
          "HANGS, never raises -- the probe is the only safe touch).",

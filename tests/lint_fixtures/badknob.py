@@ -66,3 +66,12 @@ def legal_knob_write():
     # way for code that then reads them through the registry
     os.environ["SPGEMM_TPU_SEEDED_A"] = "0"
     del environ["SPGEMM_TPU_SEEDED_C"]
+
+
+def bad_obs_knob_reads():
+    # the observability/event-log knobs are registry knobs like any
+    # other: raw reads are KNB findings (registered in utils/knobs.py,
+    # read via knobs.get in obs/events.py / obs/trace.py)
+    ev = os.environ.get("SPGEMM_TPU_OBS_EVENTS", "1")  # seeded KNB
+    cap = os.getenv("SPGEMM_TPU_OBS_EVENTS_MAX_KB")  # seeded KNB
+    return ev, cap

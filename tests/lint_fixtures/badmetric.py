@@ -34,3 +34,12 @@ def legal_local_instance():
     t = PhaseTimers()
     with t.phase("driver-local"):  # legal: not the ENGINE registry
         pass
+
+
+def bad_profile_layer_names():
+    # the deep-profiling layer's series ride the same registries: a
+    # near-miss of the new `compiles` counter (the family name, not the
+    # declared counter name) and an ad-hoc compile phase are findings
+    timers.incr("spgemm_compiles_total")  # MET: undeclared profile counter
+    with timers.phase("compile_wait"):  # MET: undeclared profile phase
+        pass

@@ -115,7 +115,7 @@ def test_knb_fixture_each_violation_caught():
     the same fixture (how harnesses and tests drive knob values) must NOT
     be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 14
+    assert [f.rule for f in findings] == ["KNB"] * 16
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
                    "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
@@ -126,7 +126,9 @@ def test_knb_fixture_each_violation_caught():
                    "SPGEMM_TPU_PLAN_ESTIMATE",
                    "SPGEMM_TPU_EST_SAMPLE_ROWS",
                    "SPGEMM_TPU_EST_CONFIDENCE",
-                   "SPGEMM_TPU_DELTA", "SPGEMM_TPU_DELTA_RETAIN"):
+                   "SPGEMM_TPU_DELTA", "SPGEMM_TPU_DELTA_RETAIN",
+                   "SPGEMM_TPU_OBS_EVENTS",
+                   "SPGEMM_TPU_OBS_EVENTS_MAX_KB"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -209,14 +211,19 @@ def test_met_fixture_each_violation_caught():
     declared names and ad-hoc PhaseTimers instances stay legal."""
     findings = lint_file(os.path.join(FIXTURES, "badmetric.py"))
     met = [f for f in findings if f.rule == "MET"]
-    assert len(met) == 3 and findings == met
+    assert len(met) == 5 and findings == met
     flagged = [f.line for f in met]
     for needle in ("MET: undeclared phase name",
                    "MET: undeclared counter name",
-                   "MET: computed metric name"):
+                   "MET: computed metric name",
+                   "MET: undeclared profile counter",
+                   "MET: undeclared profile phase"):
         assert _fixture_lines("badmetric.py", needle)[0] in flagged
     msgs = " ".join(f.message for f in met)
     assert "made_up_phase" in msgs and "made_up_counter" in msgs
+    # the deep-profiling near-misses: the FAMILY name is not the declared
+    # counter name, and an ad-hoc compile phase does not exist
+    assert "spgemm_compiles_total" in msgs and "compile_wait" in msgs
     assert "ENGINE_PHASES" in msgs and "ENGINE_COUNTERS" in msgs
     for needle in ("legal: declared phase", "legal: declared counter",
                    "legal: not the ENGINE registry"):
@@ -260,7 +267,7 @@ def test_met_registry_covers_live_call_sites():
                  "plan_cache_evictions", "ring_steps", "serve_reaps",
                  "serve_degrades", "est_hits", "est_fallbacks",
                  "delta_rows_recomputed", "delta_rows_total",
-                 "delta_full_fallbacks"):
+                 "delta_full_fallbacks", "compiles"):
         assert name in ENGINE_COUNTERS
 
 
@@ -542,13 +549,14 @@ def test_json_report_fixture_run():
     report = json.loads(rc.stdout)
     assert report["clean"] is False
     # badknob: 3 classic + 2 planner-knob + 4 serve-knob + 3
-    # estimator-knob + 2 delta-knob reads; badbackend: 3 import-time
-    # touches; badplanner: 2 @host_only-body touches; FLD: 5 per-module
-    # + 2 interprocedural (callchain) + 1 ops/estimate + 1 ops/delta
-    # numeric-scope; badthread/badexcept/stalesup: 3 each; badmetric:
-    # undeclared phase + undeclared counter + computed name
-    assert report["counts"] == {"FLD": 9, "KNB": 14, "BKD": 5, "THR": 3,
-                                "EXC": 3, "MET": 3, "DOC": 1, "SUP": 3,
+    # estimator-knob + 2 delta-knob + 2 obs-events-knob reads;
+    # badbackend: 3 import-time touches; badplanner: 2 @host_only-body
+    # touches; FLD: 5 per-module + 2 interprocedural (callchain) + 1
+    # ops/estimate + 1 ops/delta numeric-scope; badthread/badexcept/
+    # stalesup: 3 each; badmetric: undeclared phase + undeclared counter
+    # + computed name + 2 deep-profiling near-misses
+    assert report["counts"] == {"FLD": 9, "KNB": 16, "BKD": 5, "THR": 3,
+                                "EXC": 3, "MET": 5, "DOC": 1, "SUP": 3,
                                 "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:

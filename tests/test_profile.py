@@ -1,0 +1,365 @@
+"""L5 deep profiling (spgemm_tpu/obs/profile.py + obs/events.py):
+compile/cost/memory accounting, prediction accountability, the
+structured event log's rotation bound, and the whole layer's inertness
+under SPGEMM_TPU_OBS_TRACE=0 (the satellite-mandated degradation
+coverage: memory_stats absent/raising never crashes and omits the
+gauges; the event log honors its byte cap; disabled means flat)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.obs import events, metrics, profile, trace
+from spgemm_tpu.utils.gen import random_block_sparse
+
+
+@pytest.fixture(autouse=True)
+def clean_accounts():
+    profile.clear()
+    events.LOG.clear()
+    trace.RECORDER.clear()
+    yield
+    profile.clear()
+    events.LOG.clear()
+    trace.RECORDER.clear()
+
+
+def _spgemm_once(seed=0, k=4, dim=6):
+    from spgemm_tpu.ops.spgemm import spgemm
+
+    rng = np.random.default_rng(seed)
+    a = random_block_sparse(dim, dim, k, 0.4, rng, "small")
+    b = random_block_sparse(dim, dim, k, 0.4, rng, "small")
+    return a, b, spgemm(a, b, backend="xla")
+
+
+# ------------------------------------------------- compile accounting --
+def test_compile_accounting_records_nonzero_cost():
+    """One CPU multiply lands compile records for the numeric round with
+    compile wall, cost-model FLOPs, and the jit-static knob vector --
+    the acceptance shape `cli profile --json` reports."""
+    _spgemm_once(seed=1)
+    rep = profile.report()
+    sites = rep["compile_sites"]
+    assert "numeric_round" in sites
+    agg = sites["numeric_round"]
+    assert agg["count"] >= 1
+    assert agg["flops_total"] > 0
+    assert agg["seconds"]["count"] == agg["count"]
+    assert agg["seconds"]["sum"] > 0
+    recs = [r for r in rep["compiles"] if r["site"] == "numeric_round"]
+    assert recs and recs[0]["flops"] > 0
+    assert "SPGEMM_TPU_VPU_ALGO" in recs[0]["static_knobs"]
+    # memory_analysis works on CPU: argument/output bytes are real
+    assert recs[0]["argument_bytes"] > 0
+    # a repeat of the same shapes compiles nothing new
+    n_before = sum(a["count"] for a in sites.values())
+    _spgemm_once(seed=1)
+    n_after = sum(a["count"]
+                  for a in profile.report()["compile_sites"].values())
+    assert n_after == n_before
+
+
+def test_profiled_jit_bit_identical_to_plain_jit():
+    """The AOT-accounted dispatch path returns the same bits as the
+    plain jit call (the oracle parity of the wrapped engine is pinned
+    elsewhere; this pins the wrapper itself)."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, y):
+        return x * 2 + y
+
+    plain = jax.jit(f)
+    wrapped = profile.ProfiledJit("test_site", jax.jit(f))
+    x = jnp.arange(12, dtype=jnp.uint32).reshape(3, 4)
+    y = jnp.ones((3, 4), jnp.uint32)
+    assert (np.asarray(wrapped(x, y)) == np.asarray(plain(x, y))).all()
+    assert profile.compile_stats()["test_site"]["count"] == 1
+    # second call: cached executable, no new record
+    wrapped(x, y)
+    assert profile.compile_stats()["test_site"]["count"] == 1
+    # new shape: one more record
+    wrapped(x[:2], y[:2])
+    assert profile.compile_stats()["test_site"]["count"] == 2
+
+
+def test_profiled_jit_degrades_on_unloweable_fn():
+    """A callable without the AOT surface is dispatched untouched --
+    accounting must never break dispatch."""
+    calls = []
+
+    def plain(x):
+        calls.append(x)
+        return x + 1
+
+    wrapped = profile.ProfiledJit("broken_site", plain)
+    assert wrapped(1) == 2 and calls == [1]
+    assert "broken_site" not in profile.compile_stats()
+
+
+# ------------------------------------------------- memory watermarks --
+def test_memory_absent_on_cpu_omits_gauges_never_crashes():
+    """The CPU backend's memory_stats() returns None: the engine's
+    sampling must record nothing, report unavailable, and the scrape
+    must omit the HBM gauges (not render zeros)."""
+    _spgemm_once(seed=2)
+    mem = profile.memory_stats()
+    assert mem["available"] is False and mem["samples"] == 0
+    profile.memory_job_begin("job-x")  # no-op while unavailable
+    assert profile.memory_job_peak("job-x") is None
+    assert profile.memory_job_peak(None) is None
+    text = metrics.render(metrics.collect_engine())
+    assert "spgemm_hbm_bytes_in_use" not in text
+    assert "spgemm_hbm_peak_bytes" not in text
+    # the sample counter still renders (0 = backend never reported)
+    assert "spgemm_hbm_samples_total 0" in text
+
+
+def test_memory_observation_feeds_watermarks_and_job_window():
+    """A backend that DOES report feeds the gauges, the process peak,
+    and the per-job window -- keyed by the emitting thread's span
+    job_id tag, so a wedged predecessor's late sample lands in ITS
+    window, never the current job's (exercised with pushed readings --
+    the jax-side sampler is a thin try/except around memory_stats)."""
+    profile.observe_memory({"bytes_in_use": 100, "peak_bytes_in_use": 120})
+    profile.memory_job_begin("job-b")
+    with trace.RECORDER.tagged(job_id="job-b"):
+        profile.observe_memory({"bytes_in_use": 500,
+                                "peak_bytes_in_use": 600})
+        profile.observe_memory({"bytes_in_use": 300})
+    mem = profile.memory_stats()
+    assert mem["available"] is True and mem["samples"] == 3
+    assert mem["bytes_in_use"] == 300
+    assert mem["peak_bytes"] == 600
+    assert profile.memory_job_peak("job-b") == 500  # window opened at 100
+    # cross-job attribution: a late sample tagged with the OLD job's id
+    # (a wedged executor unwedging) must not move the new job's window
+    with trace.RECORDER.tagged(job_id="job-a"):
+        profile.observe_memory({"bytes_in_use": 9000})
+    assert profile.memory_job_peak("job-b") == 500
+    assert profile.memory_job_peak("job-a") == 9000
+    text = metrics.render(metrics.collect_engine())
+    assert "spgemm_hbm_bytes_in_use 9000" in text
+    assert "spgemm_hbm_peak_bytes 9000" in text
+    # malformed / None readings are ignored, never a crash
+    profile.observe_memory(None)
+    profile.observe_memory({"weird": 1})
+    assert profile.memory_stats()["samples"] == 4
+
+
+# -------------------------------------------- prediction accountability --
+def test_estimator_accuracy_scored_when_exact_join_lands(monkeypatch):
+    """An estimator-routed plan is scored against the exact join at
+    ensure_exact time: one observation per estimate, per quantity."""
+    from spgemm_tpu.ops import plancache
+    from spgemm_tpu.ops.spgemm import plan as plan_spgemm
+
+    monkeypatch.setenv("SPGEMM_TPU_EST_SAMPLE_ROWS", "8")
+    plancache.clear()
+    rng = np.random.default_rng(3)
+    a = random_block_sparse(24, 24, 4, 0.3, rng, "small")
+    b = random_block_sparse(24, 24, 4, 0.3, rng, "small")
+    p = plan_spgemm(a, b, backend="xla", platform="cpu")
+    assert p.plan_route == "estimated"
+    assert profile.est_stats()["count"] == 0  # join not landed yet
+    p.ensure_exact()
+    est = profile.est_stats()
+    assert est["count"] == 1
+    assert set(est["rel_error"]) == {"keys", "pairs", "fanout"}
+    for hist in est["rel_error"].values():
+        assert hist["count"] == 1
+    text = metrics.render(metrics.collect_engine())
+    assert 'spgemm_est_rel_error_count{quantity="keys"} 1' in text
+    # a REJECTED estimate (low confidence -> inline join_fallback) never
+    # steered the plan and must not bias the drift-alert series
+    monkeypatch.setenv("SPGEMM_TPU_EST_CONFIDENCE", "2")  # force fallback
+    plancache.clear()
+    p2 = plan_spgemm(a, b, backend="xla", platform="cpu")
+    assert p2.plan_route == "exact" and p2.estimate is not None
+    assert profile.est_stats()["count"] == 1  # unchanged
+
+
+def test_delta_accountability_and_fallback_reasons(monkeypatch):
+    """Delta multiplies observe their predicted-dirty fraction (a full
+    fallback observes 1.0, an unchanged repeat 0.0) with the fallback
+    reason counted in delta.stats() and the event log, and executed ==
+    predicted always (mispredictions stay 0 by construction)."""
+    from spgemm_tpu.ops import delta
+    from spgemm_tpu.ops.spgemm import spgemm_device
+
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    delta.clear()
+    a, b, _ = _spgemm_once(seed=4)
+    # first contact was a fallback (reason no_entry, fraction 1.0);
+    # second submit of identical operands is a delta hit with an empty
+    # diff (fraction 0.0)
+    spgemm_device(a, b)
+    dlt = profile.delta_stats()
+    assert dlt["count"] >= 2
+    assert dlt["mispredictions"] == 0
+    frac = dlt["dirty_fraction"]
+    assert frac["buckets"][0.0] >= 1  # the empty-diff repeat
+    assert frac["count"] > frac["buckets"][0.9]  # the 1.0 fallback
+    assert delta.stats()["fallback_reasons"].get("no_entry", 0) >= 1
+    kinds = [r["kind"] for r in events.LOG.tail(100)]
+    assert "delta_fallback" in kinds
+    text = metrics.render(metrics.collect_engine())
+    assert "spgemm_delta_dirty_fraction_count" in text
+    assert "spgemm_delta_mispredictions_total 0" in text
+
+
+# ------------------------------------------------------- phase histogram --
+def test_phase_histogram_fed_from_spans():
+    from spgemm_tpu.utils.timers import PhaseTimers
+
+    t = PhaseTimers()
+    t.record("plan", 0.005)
+    t.record("plan", 2.0)
+    hist = profile.phase_stats()["plan"]
+    assert hist["count"] == 2
+    assert hist["buckets"][0.01] == 1  # the 5 ms entry
+    text = metrics.render(metrics.collect_engine())
+    assert 'spgemm_phase_seconds_count{phase="plan"} 2' in text
+
+
+def test_phase_histogram_admits_only_declared_names():
+    """Ad-hoc PhaseTimers instances (the run-once CLI's local driver
+    phases) flow through the recorder but are outside the MET registry:
+    they must not mint undeclared label values on the declared-only
+    spgemm_phase_seconds family."""
+    from spgemm_tpu.utils.timers import PhaseTimers
+
+    t = PhaseTimers()
+    t.record("driver-local-load", 0.5)  # undeclared: span only
+    t.record("assembly", 0.5)           # declared
+    assert set(profile.phase_stats()) == {"assembly"}
+
+
+# ------------------------------------------------------------ event log --
+def test_event_log_rotation_honors_cap(tmp_path, monkeypatch):
+    """The on-disk JSONL rotates at SPGEMM_TPU_OBS_EVENTS_MAX_KB: the
+    live file stays under ~cap, one .1 generation holds the overflow --
+    bounded disk under a resident daemon."""
+    monkeypatch.setenv("SPGEMM_TPU_OBS_EVENTS_MAX_KB", "1")  # 1 KiB
+    path = str(tmp_path / "d.events.jsonl")
+    events.LOG.configure(path)
+    payload = "x" * 100
+    for i in range(64):
+        events.emit("test_event", i=i, payload=payload)
+    assert events.LOG.flush(timeout=10)  # the writer thread owns the file
+    st = events.LOG.stats()
+    assert st["rotations"] >= 1
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    assert os.path.getsize(path) <= 1024 + 200  # cap + one record slack
+    assert os.path.getsize(path + ".1") <= 1024 + 200
+    # every line of the live file is valid JSON with seq/ts/kind
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            rec = json.loads(line)
+            assert {"seq", "ts", "mono_us", "kind"} <= set(rec)
+    # the in-process ring is bounded too
+    assert st["ring"] <= events.EventLog.RING_RETAIN
+
+
+def test_event_log_carries_trace_tags():
+    """Auto-correlation: an event emitted inside a tagged job context
+    carries the job/trace ids without the call site passing them."""
+    with trace.RECORDER.tagged(job_id="job-5", trace_id="tr-5"):
+        events.emit("test_event", detail="hello")
+    (rec,) = events.LOG.tail(1)
+    assert rec["job_id"] == "job-5" and rec["trace_id"] == "tr-5"
+    assert rec["detail"] == "hello" and rec["kind"] == "test_event"
+
+
+def test_event_log_disabled_by_its_knob(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_OBS_EVENTS", "0")
+    events.emit("test_event")
+    assert events.LOG.stats()["emitted"] == 0
+    assert events.LOG.tail(10) == []
+
+
+def test_event_write_errors_counted_not_raised(tmp_path):
+    """A dead file sink loses log lines, never the emitter (the daemon
+    must survive a full disk); emit() itself does no file I/O -- the
+    failure lands on the writer thread and is counted."""
+    events.LOG.configure(str(tmp_path / "no_such_dir" / "e.jsonl"))
+    events.emit("test_event")
+    events.LOG.flush(timeout=10)
+    st = events.LOG.stats()
+    assert st["write_errors"] == 1 and st["emitted"] == 1
+    assert events.LOG.tail(1)[0]["kind"] == "test_event"  # ring still fed
+
+
+def test_event_sink_recovers_after_file_vanishes(tmp_path, monkeypatch):
+    """An operator cleaner removing the live JSONL mid-run must not
+    wedge the sink: the failed rotation resyncs the tracked size and
+    the next append recreates the file."""
+    monkeypatch.setenv("SPGEMM_TPU_OBS_EVENTS_MAX_KB", "1")
+    path = str(tmp_path / "v.events.jsonl")
+    events.LOG.configure(path)
+    payload = "x" * 200
+    for i in range(4):  # ~900 tracked bytes, just under the 1 KiB cap
+        events.emit("test_event", i=i, payload=payload)
+    assert events.LOG.flush(timeout=10)
+    os.remove(path)
+    for i in range(8):  # the first over-cap line hits the dead rotation
+        events.emit("test_event", i=i, payload=payload)
+    assert events.LOG.flush(timeout=10)
+    st = events.LOG.stats()
+    assert os.path.exists(path), "sink never recovered the file"
+    assert os.path.getsize(path) > 0
+    # at most the one line riding the failed rotation was lost
+    assert st["write_errors"] <= 1
+
+
+def test_event_rotation_accounting_is_byte_accurate(tmp_path, monkeypatch):
+    """Non-ASCII payloads (paths, repr'd exceptions) are budgeted in
+    utf-8 BYTES, not str characters -- the on-disk file must not exceed
+    the documented cap by the multibyte inflation factor."""
+    monkeypatch.setenv("SPGEMM_TPU_OBS_EVENTS_MAX_KB", "1")
+    path = str(tmp_path / "u.events.jsonl")
+    events.LOG.configure(path)
+    payload = "é" * 120  # 2 bytes each in utf-8
+    for i in range(32):
+        events.emit("test_event", i=i, payload=payload)
+    assert events.LOG.flush(timeout=10)
+    assert os.path.getsize(path) <= 1024 + 600  # cap + one record slack
+    assert events.LOG.stats()["rotations"] >= 1
+
+
+# -------------------------------------------------- master-knob inertness --
+def test_profile_layer_inert_under_obs_trace_zero(monkeypatch):
+    """SPGEMM_TPU_OBS_TRACE=0 makes the WHOLE deep-profiling layer
+    inert: no compile records, no memory/accuracy/phase observations --
+    and the engine still computes bit-identically."""
+    monkeypatch.setenv("SPGEMM_TPU_OBS_TRACE", "0")
+    a, b, got = _spgemm_once(seed=5)
+    profile.observe_memory({"bytes_in_use": 100})
+    profile.observe_estimate(1, 1, 1, 2, 2, 2)
+    profile.observe_delta(1, 1, 2)
+    rep = profile.report()
+    assert rep["enabled"] is False
+    assert rep["compiles"] == [] and rep["compile_sites"] == {}
+    assert rep["memory"]["samples"] == 0
+    assert rep["estimator"]["count"] == 0
+    assert rep["delta"]["count"] == 0
+    assert profile.phase_stats() == {}
+    # parity: the disabled layer changed no bits
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+    from spgemm_tpu.utils.semantics import spgemm_oracle
+
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, a.k, spgemm_oracle(a.to_dict(), b.to_dict(), a.k))
+    assert got == want
+
+
+# ------------------------------------------------------ report plumbing --
+def test_report_and_summary_are_json_serializable():
+    _spgemm_once(seed=6)
+    events.emit("test_event")
+    json.dumps(profile.report())
+    json.dumps(profile.summary())
+    assert profile.summary()["compiles"] >= 1
