@@ -67,7 +67,10 @@ bench:
 # of the same input (second must hit the warm plan cache), then a third
 # submit with a handful of mutated tiles (must take the delta-recompute
 # path: 0 < delta_rows < total_rows in its status detail), all results
-# bit-exact vs the oracle, clean shutdown; exits nonzero on any step.
+# bit-exact vs the oracle, clean shutdown; then a RESTART leg -- a second
+# daemon on the same socket + warm dir re-serves the chain and its first
+# contact must come from the persistent warm store (warm_hits >= 1, zero
+# delta full fallbacks, a clean 0-row delta); exits nonzero on any step.
 serve-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m spgemm_tpu.serve.smoke

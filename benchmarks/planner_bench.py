@@ -270,6 +270,76 @@ def _repeat_structure_detail(args) -> dict:
             "plan_cache": stats}
 
 
+def _cross_process_detail(args) -> dict:
+    """--cross-process: the warm-start analog of --repeat-structure.  The
+    in-process hit path proves the fingerprint works; this mode proves it
+    SURVIVES the process: a child interpreter plans the structure and
+    persists it (ops/warmstore write-through), then a SECOND fresh
+    interpreter -- cold import, empty plan cache -- times plan() against
+    the warm dir and must be served from disk.  Emits warm_plan_wall_s
+    next to plan_cache_hit_wall_s (the figures bracket a restarted
+    daemon's per-structure planning cost)."""
+    import subprocess  # noqa: PLC0415
+    import tempfile  # noqa: PLC0415
+
+    warm_dir = tempfile.mkdtemp(prefix="warm-xproc-")
+    env = {**os.environ, "SPGEMM_TPU_WARM_DIR": warm_dir,
+           "SPGEMM_TPU_WARM": "1", "JAX_PLATFORMS": "cpu"}
+    base = [sys.executable, os.path.abspath(__file__),
+            "--keys", str(args.keys), "--fanout", str(args.fanout),
+            "--repeats", str(args.repeats)]
+    out = {}
+    for mode in ("seed", "timed"):
+        rc = subprocess.run(base + ["--_warm-child", mode],
+                            env=env, capture_output=True, text=True,
+                            timeout=600)
+        if rc.returncode != 0:
+            raise SystemExit(f"--cross-process {mode} child failed:\n"
+                             f"{rc.stdout[-2000:]}{rc.stderr[-2000:]}")
+        out[mode] = json.loads(rc.stdout.strip().splitlines()[-1])
+    return {"cross_process": {
+        "warm_plan_wall_s": out["timed"]["warm_plan_wall_s"],
+        "cold_plan_wall_s": out["seed"]["cold_plan_wall_s"],
+        "warm_store": out["timed"]["warm_store"],
+    }}
+
+
+def _warm_child(args) -> int:
+    """Internal: one --cross-process child (seed = plan + persist, timed
+    = fresh-interpreter plan against the warm dir).  Prints one JSON
+    line; the parent reads it."""
+    from spgemm_tpu.ops import warmstore
+    from spgemm_tpu.ops.spgemm import plan as plan_spgemm
+
+    a = _synth_structure(args.keys, args.fanout, 8, seed=5)
+    b = _synth_structure(args.keys, args.fanout, 8, seed=6)
+    t0 = time.perf_counter()
+    p = plan_spgemm(a, b, backend="xla", platform="cpu")
+    if args.warm_child == "seed":
+        # the cold figure is the FULL exact-plan cost (join included):
+        # an estimator-routed plan's fast return defers the join, and
+        # that is exactly the work the warm dir spares a restart
+        p.ensure_exact()
+        wall = time.perf_counter() - t0
+        warmstore.flush()  # an estimator-routed plan persists here
+        stats = warmstore.stats()
+        if stats["plans"] < 1:
+            raise SystemExit(f"seed child persisted no plan: {stats}")
+        print(json.dumps({"cold_plan_wall_s": round(wall, 6)}))
+        return 0
+    wall = time.perf_counter() - t0
+    stats = warmstore.stats()
+    if stats["plan_hits"] < 1:
+        raise SystemExit("timed child was not served from the warm dir: "
+                         f"{stats}")
+    print(json.dumps({
+        "warm_plan_wall_s": round(wall, 6),
+        "warm_store": {k: stats[k] for k in ("plans", "bytes",
+                                             "plan_hits", "corrupt")},
+    }))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--keys", type=int, default=100_000)
@@ -299,7 +369,18 @@ def main() -> int:
                         "(default 8: heavy enough numeric work that the "
                         "fold dominates the wall, CPU-tractable at the "
                         "20k-key acceptance config)")
+    p.add_argument("--cross-process", action="store_true",
+                   help="warm-start A/B (ops/warmstore): a child "
+                        "interpreter plans + persists the structure, a "
+                        "SECOND fresh interpreter times plan() against "
+                        "the warm dir -- emits detail.cross_process."
+                        "warm_plan_wall_s next to plan_cache_hit_wall_s "
+                        "(the cross-process analog of --repeat-structure)")
+    p.add_argument("--_warm-child", dest="warm_child", default=None,
+                   choices=("seed", "timed"), help=argparse.SUPPRESS)
     args = p.parse_args()
+    if args.warm_child:
+        return _warm_child(args)
     if args.repeats < 1:
         p.error("--repeats must be >= 1 (best-of timing needs a sample; "
                 "0 would serialize as non-JSON Infinity)")
@@ -322,6 +403,8 @@ def main() -> int:
               "plan_rounds_wall_s": round(rounds_s, 4)}
     if args.repeat_structure:
         detail.update(_repeat_structure_detail(args))
+    if args.cross_process:
+        detail.update(_cross_process_detail(args))
     if args.cold_structure:
         detail.update(_cold_structure_detail(args))
     if args.delta:

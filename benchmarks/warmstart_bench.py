@@ -1,0 +1,199 @@
+"""Restart-to-first-result: cold vs warm spgemmd (ops/warmstore A/B).
+
+The warm-start acceptance proof, end to end through the real daemon: a
+COLD spgemmd (empty warm dir) pays import + symbolic plan + jit compile
++ a full recompute for its first submit; a WARM restart on the same
+socket + warm dir must serve the same submit from the persisted plan,
+the rehydrated delta entry, and the persistent compilation cache --
+restart-to-first-result (daemon spawn -> first job done) is the timed
+span, both legs including process startup, so the speedup is the honest
+operator-visible figure.
+
+Asserted per run (exit nonzero on any failure):
+  * both legs' outputs are bit-exact vs the host-only oracle;
+  * the warm leg's job reports `warm_hits >= 1` and ZERO
+    `delta_full_fallbacks` (a delta, not a cold recompute);
+  * the warm leg records ZERO new jit compiles (`cli profile` surface:
+    the clean-diff submit never dispatches a kernel) while the cold leg
+    records at least one;
+  * a third leg with SPGEMM_TPU_WARM=0 restores exact cold behavior
+    (no warm hits, compiles again) -- the whole-engine A/B.
+
+Usage: python benchmarks/warmstart_bench.py [--keys 20000] [--k 8]
+Prints one JSON line:
+  {"metric": "warmstart_restart_to_first_result", "value": <speedup x>,
+   ...}
+
+The parent process stays jax-free (oracle + generator are pure numpy);
+only the daemon subprocesses touch a backend -- the deployment shape
+being measured.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _start_daemon(sock: str, env: dict) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+         "--socket", sock, "--device", "cpu"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+
+def _leg(name: str, sock: str, folder: str, out_path: str,
+         env: dict) -> dict:
+    """One restart-to-first-result measurement: daemon spawn -> submit ->
+    first job done, then a profile scrape and a clean shutdown."""
+    from spgemm_tpu.serve import client
+
+    t0 = time.perf_counter()
+    proc = _start_daemon(sock, env)
+    try:
+        deadline = time.time() + 180
+        while not os.path.exists(sock):
+            if proc.poll() is not None:
+                out, _ = proc.communicate(timeout=10)
+                raise SystemExit(f"{name}: daemon died at startup:\n"
+                                 f"{out[-3000:]}")
+            if time.time() > deadline:
+                raise SystemExit(f"{name}: daemon never bound its socket")
+            time.sleep(0.05)
+        resp = client.submit(folder, sock, {"output": out_path})
+        resp = client.wait(resp["id"], sock, timeout=1200)
+        wall = time.perf_counter() - t0
+        job = resp["job"]
+        if job["state"] != "done":
+            raise SystemExit(f"{name}: job ended {job['state']}: "
+                             f"{job['error']}")
+        profile = client.profile(sock)
+        client.shutdown(sock)
+        proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    det = job["detail"]
+    return {
+        "wall_s": round(wall, 3),
+        "warm_hits": det.get("warm_hits", 0),
+        "warm_misses": det.get("warm_misses", 0),
+        "compiles": det.get("compiles", 0),
+        "compile_records": len(profile.get("compiles", [])),
+        "delta_full_fallbacks": det.get("delta_full_fallbacks", 0),
+        "delta_rows": det.get("delta_rows", 0),
+        "total_rows": det.get("total_rows", 0),
+        "plan_cache": det.get("plan_cache"),
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--keys", type=int, default=20_000,
+                   help="approximate output tile-key count per multiply "
+                        "(the acceptance config is 20k on CPU)")
+    p.add_argument("--k", type=int, default=8, help="tile edge")
+    p.add_argument("--chain", type=int, default=5,
+                   help="chain length (default 5 -> 4 multiplies): the "
+                        "serving shape -- a cold daemon pays plan + "
+                        "compile + full recompute PER STRUCTURE, a warm "
+                        "one pays none of them, so the chain is what "
+                        "restart-to-first-result actually amortizes")
+    args = p.parse_args()
+    if args.chain < 2:
+        p.error("--chain must be >= 2 (a chain job needs one multiply)")
+
+    from spgemm_tpu.utils import io_text
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+    from spgemm_tpu.utils.gen import banded_block_sparse
+    from spgemm_tpu.utils.semantics import chain_oracle
+
+    tmp = tempfile.mkdtemp(prefix="warmstart-bench-")
+    sock = os.path.join(tmp, "d.sock")
+    folder = os.path.join(tmp, "chain_in")
+    rng = np.random.default_rng(7)
+    # band 2 -> ~5 blocks/row, product band 4 -> ~9 keys/row (the
+    # planner_bench --delta sizing): block_dim targets --keys output keys
+    block_dim = max(8, args.keys // 9)
+    # distinct band per matrix: every multiply (partials included) gets
+    # its own structure fingerprint -- the serving shape the warm store
+    # exists for.  A chain of IDENTICAL structures would alias one delta
+    # entry across multiplies and thrash it (correct but never clean).
+    mats = [banded_block_sparse(block_dim, args.k, 2 + (i % 3), rng,
+                                "small")
+            for i in range(args.chain)]
+    io_text.write_chain_dir(folder, mats, args.k)
+    want = chain_oracle([m.to_dict() for m in mats], args.k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, args.k, want).prune_zeros())
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("SPGEMM_TPU_WARM")}
+    env["SPGEMM_TPU_WARM"] = "1"
+
+    def check_output(name: str) -> None:
+        got = open(os.path.join(tmp, f"matrix.{name}"), "rb").read()
+        if got != want_bytes:
+            raise SystemExit(f"{name} leg output does not match the "
+                             "oracle bytes")
+
+    legs = {}
+    # cold: first-ever daemon on a fresh warm dir
+    shutil.rmtree(sock + ".warm", ignore_errors=True)
+    legs["cold"] = _leg("cold", sock, folder,
+                        os.path.join(tmp, "matrix.cold"), env)
+    check_output("cold")
+    # warm: restarted daemon inherits the dir the cold leg flushed
+    legs["warm"] = _leg("warm", sock, folder,
+                        os.path.join(tmp, "matrix.warm"), env)
+    check_output("warm")
+    # off: SPGEMM_TPU_WARM=0 must restore exact cold behavior even with
+    # the populated dir sitting right there
+    env_off = {**env, "SPGEMM_TPU_WARM": "0"}
+    legs["warm_off"] = _leg("warm_off", sock, folder,
+                            os.path.join(tmp, "matrix.warm_off"), env_off)
+    check_output("warm_off")
+
+    cold, warm, off = legs["cold"], legs["warm"], legs["warm_off"]
+    if warm["warm_hits"] < 1:
+        raise SystemExit(f"warm leg served nothing from disk: {warm}")
+    if warm["compiles"] != 0 or warm["compile_records"] != 0:
+        raise SystemExit("warm leg recorded new jit compiles (want 0): "
+                         f"{warm}")
+    if warm["delta_full_fallbacks"] != 0 or warm["delta_rows"] != 0:
+        raise SystemExit("warm leg was not a clean delta against the "
+                         f"rehydrated result: {warm}")
+    if cold["compiles"] < 1:
+        raise SystemExit(f"cold leg recorded no compiles -- the A/B is "
+                         f"not measuring what it claims: {cold}")
+    if off["warm_hits"] != 0:
+        raise SystemExit("SPGEMM_TPU_WARM=0 leg still hit the warm "
+                         f"store: {off}")
+    if off["compiles"] < 1:
+        raise SystemExit("SPGEMM_TPU_WARM=0 leg did not restore cold "
+                         f"behavior: {off}")
+    speedup = round(cold["wall_s"] / warm["wall_s"], 2) \
+        if warm["wall_s"] > 0 else None
+    print(json.dumps({
+        "metric": "warmstart_restart_to_first_result",
+        "value": speedup, "unit": "x",
+        "vs_baseline": None,
+        "detail": {"keys": args.keys, "k": args.k,
+                   "block_dim": block_dim, **legs},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

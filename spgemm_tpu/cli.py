@@ -33,6 +33,9 @@ the daemon's deep-profiling accounts (jit compile wall + cost/memory
 analyses per engine site, HBM watermarks, estimator/delta prediction
 accuracy) and `events` tails its structured event log (obs/events.py
 JSONL: job lifecycle, watchdog transitions, fallbacks with reasons).
+`warm --stat|--clear` inspects or empties the persistent warm-start
+store (ops/warmstore: the on-disk plan/delta entries + xla compilation
+cache a restarted spgemmd rehydrates from).
 """
 
 from __future__ import annotations
@@ -174,6 +177,15 @@ def run_knobs(argv: list[str]) -> int:
         dlt = {"hits": 0, "full_fallbacks": 0, "evictions": 0,
                "rows_recomputed": 0, "rows_total": 0, "entries": 0,
                "capacity": "?", "enabled": "?", "error": str(e)}
+    from spgemm_tpu.ops import warmstore  # noqa: PLC0415
+
+    try:
+        warm = warmstore.stats()
+    except ValueError as e:
+        warm = {"plans": 0, "deltas": 0, "bytes": 0, "plan_hits": 0,
+                "plan_misses": 0, "delta_hits": 0, "delta_misses": 0,
+                "corrupt": 0, "dir": None, "enabled": "?",
+                "error": str(e)}
     # deep-profiling digest (obs/profile, jax-free): compile count/wall +
     # prediction-accuracy means ride next to the routing stats, so an
     # estimator drifting off its predictions is visible in the same
@@ -189,7 +201,7 @@ def run_knobs(argv: list[str]) -> int:
         except ValueError as e:
             prof_report = {"error": str(e)}
         print(json.dumps({"knobs": rows, "plan_cache": cache,
-                          "estimator": est, "delta": dlt,
+                          "estimator": est, "delta": dlt, "warm": warm,
                           "profile": prof_report}, indent=2))
         return 0
     try:
@@ -234,6 +246,17 @@ def run_knobs(argv: list[str]) -> int:
               "  [ops/delta.py]")
         if dlt.get("error"):
             print(f"  !! {dlt['error']}")
+        w_on = warm["enabled"]
+        print(f"warm:       plans={warm['plans']} deltas={warm['deltas']} "
+              f"bytes={warm['bytes']} "
+              f"hits={warm['plan_hits'] + warm['delta_hits']} "
+              f"misses={warm['plan_misses'] + warm['delta_misses']} "
+              f"corrupt={warm['corrupt']} "
+              f"dir={warm['dir'] or '(unbound)'} "
+              f"enabled={w_on if w_on == '?' else int(w_on)}"
+              "  [ops/warmstore.py]")
+        if warm.get("error"):
+            print(f"  !! {warm['error']}")
         print(f"profile:    compiles={prof['compiles']} "
               f"({prof['compile_s']}s) "
               f"est_err={prof['est_mean_rel_error'] or None} "
@@ -249,6 +272,55 @@ def run_knobs(argv: list[str]) -> int:
         import os  # noqa: PLC0415
 
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+def run_warm(argv: list[str]) -> int:
+    """`spgemm_tpu warm [--stat|--clear] [--dir PATH] [--json]`: inspect
+    or empty the persistent warm-start store (ops/warmstore) -- the
+    on-disk plan/delta entries a restarted spgemmd rehydrates from.  The
+    dir resolves like the daemon's: --dir, else SPGEMM_TPU_WARM_DIR, else
+    the default socket's journal-adjacent <socket>.warm/."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu warm",
+        description="inspect (--stat, default) or empty (--clear) the "
+                    "persistent warm-start store")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("--stat", action="store_true",
+                   help="entry counts, bytes, budget, and whether a live "
+                        "process holds the dir (the default action)")
+    g.add_argument("--clear", action="store_true",
+                   help="delete every warm entry and the xla compilation-"
+                        "cache subdir; refuses while a live process holds "
+                        "the dir's lock")
+    p.add_argument("--dir", default=None, metavar="PATH",
+                   help="warm dir (default: SPGEMM_TPU_WARM_DIR, else "
+                        "<default socket>.warm)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    from spgemm_tpu.ops import warmstore  # noqa: PLC0415
+    from spgemm_tpu.serve import protocol  # noqa: PLC0415
+    target = (args.dir or knobs_registry.get("SPGEMM_TPU_WARM_DIR")
+              or protocol.default_socket_path() + ".warm")
+    if args.clear:
+        try:
+            removed = warmstore.clear(target)
+        except RuntimeError as e:
+            print(f"warm: {e}", file=sys.stderr)
+            return 1
+        print(f"warm: cleared {removed} entries from {target}")
+        return 0
+    info = warmstore.scan(target)
+    if args.as_json:
+        import json  # noqa: PLC0415
+
+        print(json.dumps(info, indent=2))
+        return 0
+    state = "missing" if not info["exists"] else \
+        "in use by a live process" if info["locked"] else "idle"
+    print(f"warm store {target}: {state}")
+    print(f"  plans={info['plans']} deltas={info['deltas']} "
+          f"bytes={info['bytes']} budget={info['budget_bytes']}")
     return 0
 
 
@@ -288,7 +360,7 @@ def _subcommands() -> dict:
     return {"knobs": run_knobs, "serve": serve,
             "submit": submit, "status": status,
             "metrics": metrics, "trace-dump": trace_dump,
-            "profile": profile, "events": events}
+            "profile": profile, "events": events, "warm": run_warm}
 
 
 def run(argv: list[str] | None = None) -> int:
@@ -302,7 +374,8 @@ def run(argv: list[str] | None = None) -> int:
     # `./knobs` matrix folder keeps its old meaning, while an unrelated
     # scratch dir does not swallow the subcommand
     if (argv and argv[0] in ("knobs", "serve", "submit", "status",
-                             "metrics", "trace-dump", "profile", "events")
+                             "metrics", "trace-dump", "profile", "events",
+                             "warm")
             and not os.path.exists(os.path.join(argv[0], "size"))):
         return _subcommands()[argv[0]](argv[1:])
     parser = build_parser()

@@ -81,6 +81,11 @@ class EventLog:
         self._path = None             # spgemm-lint: guarded-by(_lock)
         self._size = 0                # spgemm-lint: guarded-by(_lock)
         self._writer = None           # spgemm-lint: guarded-by(_lock)
+        # lines POPPED from pending but not yet on disk: flush()'s drain
+        # contract must cover them too, or a caller (test asserting file
+        # bytes, daemon shutdown) can observe the rotation's mid-air
+        # window -- old file replaced away, new one not yet created
+        self._in_flight = 0           # spgemm-lint: guarded-by(_lock)
         self._wake = threading.Event()
 
     def configure(self, path: str | None) -> None:
@@ -157,6 +162,7 @@ class EventLog:
                 if not self._pending:
                     return
                 data = self._pending.popleft()
+                self._in_flight = 1
                 path = self._path
                 size = self._size
             cap = cap_bytes()
@@ -178,6 +184,7 @@ class EventLog:
                 # over-cap _size that makes every later rotation attempt
                 # fail forever; the next append simply recreates it.
                 with self._lock:
+                    self._in_flight = 0
                     self._write_errors += 1
                     if self._path == path:
                         if rotated:
@@ -188,19 +195,22 @@ class EventLog:
                             self._size = 0
                 continue
             with self._lock:
+                self._in_flight = 0
                 if self._path == path:  # configure() may have moved it
                     self._size = size + len(data)
                     if rotated:
                         self._rotations += 1
 
     def flush(self, timeout: float = 5.0) -> bool:
-        """Wait for the pending queue to drain (tests, daemon
-        shutdown); True when it drained within `timeout`."""
+        """Wait for the pending queue -- AND the line the writer has
+        already popped but not yet landed -- to drain (tests, daemon
+        shutdown); True when both drained within `timeout`."""
         self._wake.set()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:
-                if not self._pending or self._path is None:
+                if ((not self._pending and not self._in_flight)
+                        or self._path is None):
                     return True
                 writer = self._writer
             if writer is None or not writer.is_alive():

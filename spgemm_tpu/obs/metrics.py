@@ -47,6 +47,11 @@ ENGINE_PHASES: dict[str, str] = {
                     "previous result",
     "serve_queue_wait": "spgemmd: submit-to-execution queue wait",
     "serve_execute": "spgemmd: one job's executor span",
+    "warm_load": "warm-start store: one on-disk plan/delta entry "
+                 "deserialize attempt (ops/warmstore)",
+    "warm_flush": "warm-start store: persist in-memory plan/delta "
+                  "entries + budget prune (spgemmd terminal events, "
+                  "shutdown)",
 }
 
 # Engine event COUNTER names: the only names the package may pass to
@@ -78,6 +83,15 @@ ENGINE_COUNTERS: dict[str, str] = {
                 "of the cold-jit tax",
     "serve_reaps": "spgemmd watchdog job reaps (deadline exceeded)",
     "serve_degrades": "spgemmd degrade transitions to the CPU path",
+    "warm_hits": "warm-start store hits: a plan or delta entry a "
+                 "previous process persisted was deserialized and "
+                 "served (ops/warmstore)",
+    "warm_misses": "warm-start store misses: no on-disk entry for the "
+                   "fingerprint (first-ever contact, pruned entry, or "
+                   "a different knob vector's fingerprint)",
+    "warm_corrupt": "warm entries skipped as corrupt/version-skewed/"
+                    "knob-vector-mismatched -- each a counted cold "
+                    "fallback, never a crash or wrong bits",
 }
 
 
@@ -129,6 +143,25 @@ _METRICS = (
            "Configured plan-cache LRU capacity "
            "(SPGEMM_TPU_PLAN_CACHE_CAP).",
            "ops/plancache.py"),
+    Metric("spgemm_warm_hits_total", "counter",
+           "Warm-start store hits since process start (plan + delta "
+           "entries served from disk).",
+           "ops/warmstore.py"),
+    Metric("spgemm_warm_misses_total", "counter",
+           "Warm-start store misses since process start.",
+           "ops/warmstore.py"),
+    Metric("spgemm_warm_corrupt_total", "counter",
+           "Warm entries skipped as corrupt/version-skewed/knob-vector-"
+           "mismatched (counted cold fallbacks).",
+           "ops/warmstore.py"),
+    Metric("spgemm_warm_entries", "gauge",
+           "Entries currently persisted in the warm dir, by kind "
+           "(plan, delta).",
+           "ops/warmstore.py", labels=("kind",)),
+    Metric("spgemm_warm_bytes", "gauge",
+           "On-disk bytes of warm plan/delta entries (the xla "
+           "compilation-cache subdir is excluded).",
+           "ops/warmstore.py"),
     Metric("spgemm_trace_spans", "gauge",
            "Spans currently retained in the flight-recorder ring.",
            "obs/trace.py"),
@@ -367,6 +400,22 @@ def collect_engine() -> list[tuple]:
              cache.get("evictions", 0)),
             ("spgemm_plan_cache_entries", {}, cache["entries"]),
             ("spgemm_plan_cache_capacity", {}, cache["capacity"]),
+        ]
+    from spgemm_tpu.ops import warmstore  # noqa: PLC0415
+    try:
+        warm = warmstore.stats()
+    except ValueError:
+        warm = None  # invalid warm knob: skip the rows, keep the scrape
+    if warm is not None:
+        samples += [
+            ("spgemm_warm_hits_total", {},
+             warm["plan_hits"] + warm["delta_hits"]),
+            ("spgemm_warm_misses_total", {},
+             warm["plan_misses"] + warm["delta_misses"]),
+            ("spgemm_warm_corrupt_total", {}, warm["corrupt"]),
+            ("spgemm_warm_entries", {"kind": "plan"}, warm["plans"]),
+            ("spgemm_warm_entries", {"kind": "delta"}, warm["deltas"]),
+            ("spgemm_warm_bytes", {}, warm["bytes"]),
         ]
     ring = trace.RECORDER.stats()
     samples += [

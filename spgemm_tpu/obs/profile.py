@@ -62,9 +62,11 @@ def enabled() -> bool:
 def static_knob_vector() -> tuple:
     """Every jit-static knob's current value -- the compile record's
     provenance: two records for one site with different vectors are two
-    different executables by the registry's own staticity contract."""
-    return tuple((kb.name, str(knobs.get(kb.name)))
-                 for kb in knobs.REGISTRY.values() if kb.jit_static)
+    different executables by the registry's own staticity contract.
+    Delegates to the canonical registry definition (knobs.
+    jit_static_vector), shared with the plan-cache fingerprint and the
+    warm-start store's on-disk validation."""
+    return knobs.jit_static_vector()
 
 
 # ------------------------------------------------------------ histograms --

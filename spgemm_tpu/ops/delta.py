@@ -318,6 +318,40 @@ def _store_entry(entry: DeltaEntry) -> None:
             _STATS["evictions"] += 1
 
 
+def entries() -> list:
+    """Snapshot of the live (key, DeltaEntry) pairs, LRU-first.  The
+    warm-start flush (ops/warmstore) walks it to persist retained results
+    whose version moved since the last flush; a copy, so serialization
+    (one D2H per changed entry) holds no lock."""
+    with _LOCK:
+        return list(_STORE.items())
+
+
+def fence_version(v: int) -> None:
+    """Advance the global version source past `v`.  The monotonic-version
+    contract (see _VERSION) must survive restart: a persisted entry (or a
+    persisted tag REFERENCE to another entry) carries a version from a
+    previous process, and a new process handing out versions from 1 again
+    would let an old lineage alias a fresh one -- a rehydrated consumer
+    would then read a fresh producer's tag as "the exact version I
+    already consumed" and splice stale rows.  The warm store fences at
+    BIND time over every on-disk entry's version; a consumer's tag
+    references are always older than its own version (versions are
+    minted at commit, after the consumed tag existed), so the on-disk
+    maximum covers every reference too."""
+    global _VERSION
+    with _LOCK:
+        _VERSION = max(_VERSION, int(v))
+
+
+def seed_entry(entry: DeltaEntry) -> None:
+    """Install a rehydrated (warm-start) entry AND fence the version
+    source past it (defense in depth -- the bind-time fence above is the
+    load-order-independent guarantee)."""
+    fence_version(entry.version)
+    _store_entry(entry)
+
+
 # ---------------------------------------------------------------- diffing --
 def _operand_dirty(src: tuple, m):
     """Dirty tile-row set of operand m against its stored provenance, or

@@ -116,15 +116,39 @@ def store(key: str, plan) -> int:
     return evicted
 
 
-def stats() -> dict:
+def entries() -> list:
+    """Snapshot of the live (key, plan) pairs, LRU-first.  The warm-start
+    flush (ops/warmstore) walks it to persist plans not yet on disk; the
+    list is a copy, so the walker holds no lock while serializing."""
+    with _LOCK:
+        return list(_CACHE.items())
+
+
+def baseline() -> dict:
+    """Counter snapshot for scope-diffing (see stats(since=...)): a
+    caller that wants per-job (not process-lifetime) hit/miss/eviction
+    figures captures a baseline before the work and diffs after -- the
+    PhaseScope discipline, applied to the cache counters.  spgemmd
+    stashes one per job so a second job's detail never inherits the
+    first's totals."""
+    with _LOCK:
+        return dict(_STATS)
+
+
+def stats(since: dict | None = None) -> dict:
     """Live per-process cache state, for `spgemm_tpu.cli knobs` and bench
     detail: hits/misses since process start (or the last clear), current
-    entry count, and the configured knob values."""
+    entry count, and the configured knob values.
+
+    since: an earlier baseline() snapshot -- the hit/miss/eviction
+    figures then report the DELTA since that scope opened (entry count
+    and knob values stay live)."""
+    base = since or {}
     with _LOCK:
         return {
-            "hits": _STATS["hits"],
-            "misses": _STATS["misses"],
-            "evictions": _STATS["evictions"],
+            "hits": _STATS["hits"] - base.get("hits", 0),
+            "misses": _STATS["misses"] - base.get("misses", 0),
+            "evictions": _STATS["evictions"] - base.get("evictions", 0),
             "entries": len(_CACHE),
             "capacity": capacity(),
             "enabled": enabled(),

@@ -553,3 +553,97 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
                 rounds.append(Round(key_index=chunk, pa=pa, pb=pb,
                                     max_fanout=int(lens.max())))
     return rounds
+
+
+# ------------------------------------------------- plan <-> arrays codec --
+# Schema version of the flat-array plan encoding below.  Bump on ANY field
+# or layout change: the warm-start store (ops/warmstore) refuses to decode
+# a mismatched version -- a version-skewed on-disk entry must be a counted
+# cold fallback, never a half-parsed plan.
+PLAN_CODEC_VERSION = 1
+
+# SpgemmPlan scalar fields packed into the "scalars" int64 array, in order
+# (None encodes as -1 for the two optional ints; batch as 0/1).
+_SCALAR_FIELDS = ("k", "a_nnzb", "b_nnzb", "batch", "round_size",
+                  "split_fanout", "num_rounds", "has_take")
+
+
+def plan_to_arrays(plan: SpgemmPlan) -> dict | None:
+    """Flatten an EXACT plan into a dict of numpy arrays (npz-ready).
+
+    The warm-start persistence codec: everything ops/spgemm.execute needs
+    -- the exact join, the padded round index arrays, the assembly
+    permutation, and the operand coords check_operands guards with --
+    round-trips through plain arrays, so a persisted plan replays
+    byte-identically (the pa/pb gathers ARE the fold order).  Returns
+    None for a deferred (estimator-routed, join not yet landed) plan:
+    persisting a plan without its exact join would save nothing worth the
+    bytes.  Pure numpy, jax-free (host codec, any thread)."""
+    if plan.is_deferred or plan.join is None or plan.rounds is None:
+        return None
+    scalars = np.array(
+        [plan.k, plan.a_nnzb, plan.b_nnzb, int(plan.batch),
+         -1 if plan.round_size is None else plan.round_size,
+         -1 if plan.split_fanout is None else plan.split_fanout,
+         len(plan.rounds), int(plan.take is not None)], np.int64)
+    out = {
+        "codec": np.int64(PLAN_CODEC_VERSION),
+        "backend": np.array(plan.backend),
+        "platform": np.array(plan.platform),
+        "scalars": scalars,
+        "join_keys": plan.join.keys,
+        "join_pair_ptr": plan.join.pair_ptr,
+        "join_pair_a": plan.join.pair_a,
+        "join_pair_b": plan.join.pair_b,
+        "round_max_fanout": np.array(
+            [r.max_fanout for r in plan.rounds], np.int64),
+        "a_coords": (plan._a_coords if plan._a_coords is not None
+                     else np.zeros((0, 2), np.int64)),
+        "b_coords": (plan._b_coords if plan._b_coords is not None
+                     else np.zeros((0, 2), np.int64)),
+    }
+    if plan.take is not None:
+        out["take"] = plan.take
+    for i, r in enumerate(plan.rounds):
+        out[f"r{i}_key_index"] = r.key_index
+        out[f"r{i}_pa"] = r.pa
+        out[f"r{i}_pb"] = r.pb
+    return out
+
+
+def plan_from_arrays(d, fingerprint: str | None = None) -> SpgemmPlan:
+    """Rebuild a SpgemmPlan from plan_to_arrays output (or a loaded npz
+    mapping).  Raises ValueError on codec-version skew and KeyError/
+    ValueError on missing or malformed fields -- the caller (the
+    warm-start store) catches and counts, never trusts."""
+    version = int(d["codec"])
+    if version != PLAN_CODEC_VERSION:
+        raise ValueError(f"plan codec version {version} != "
+                         f"{PLAN_CODEC_VERSION} (version skew)")
+    s = {name: int(v) for name, v in zip(_SCALAR_FIELDS,
+                                         np.asarray(d["scalars"]))}
+    join = JoinResult(
+        keys=np.asarray(d["join_keys"], np.int64),
+        pair_ptr=np.asarray(d["join_pair_ptr"], np.int64),
+        pair_a=np.asarray(d["join_pair_a"], np.int32),
+        pair_b=np.asarray(d["join_pair_b"], np.int32))
+    max_fan = np.asarray(d["round_max_fanout"], np.int64)
+    if len(max_fan) != s["num_rounds"]:
+        raise ValueError("round count does not match the scalars header")
+    rounds = [Round(key_index=np.asarray(d[f"r{i}_key_index"], np.int64),
+                    pa=np.asarray(d[f"r{i}_pa"], np.int32),
+                    pb=np.asarray(d[f"r{i}_pb"], np.int32),
+                    max_fanout=int(max_fan[i]))
+              for i in range(s["num_rounds"])]
+    take = np.asarray(d["take"], np.int64) if s["has_take"] else None
+    a_coords = np.asarray(d["a_coords"], np.int64)
+    b_coords = np.asarray(d["b_coords"], np.int64)
+    return SpgemmPlan(
+        backend=str(d["backend"]), platform=str(d["platform"]),
+        k=s["k"], a_nnzb=s["a_nnzb"], b_nnzb=s["b_nnzb"], join=join,
+        rounds=rounds, take=take, batch=bool(s["batch"]),
+        round_size=None if s["round_size"] < 0 else s["round_size"],
+        split_fanout=None if s["split_fanout"] < 0 else s["split_fanout"],
+        fingerprint=fingerprint,
+        _a_coords=a_coords if len(a_coords) else None,
+        _b_coords=b_coords if len(b_coords) else None)

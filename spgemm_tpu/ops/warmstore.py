@@ -1,0 +1,712 @@
+"""Persistent warm start: the plan cache and delta store, on disk.
+
+The engine's amortization story (JITSPMM / KokkosKernels symbolic reuse,
+PAPERS.md) died at process death: a restarted spgemmd paid cold import +
+cold jit + a full symbolic plan per structure + one full recompute per
+delta structure.  This module is the disk tier under the two in-memory
+stores:
+
+  * an EXACT SpgemmPlan (ops/plancache entry) serializes to one npz next
+    to the job journal -- plans are content-fingerprinted over operand
+    coords + plan params + the jit-static knob vector, so the fingerprint
+    IS the file key and a hit can never straddle a config change;
+  * a delta-store entry (ops/delta: retained previous result + operand
+    provenance) serializes with its result planes fetched to host, so an
+    evolving-input client's first post-restart submit diffs against the
+    retained result instead of paying a counted full fallback;
+  * spgemmd additionally points JAX's persistent compilation cache at a
+    subdir of the same store (configure_compilation_cache), so re-jit of
+    unchanged executables is a disk hit.
+
+Loading is LAZY: startup only counts files (the `warm_load` event); an
+entry deserializes on its first fingerprint match, inside the engine's
+`warm_load` phase.  Every write is atomic (tmp + os.replace), versioned
+(symbolic.PLAN_CODEC_VERSION + the store schema below), and bounded
+(SPGEMM_TPU_WARM_MAX_MB, oldest entries pruned after flush).
+
+Failure policy -- the checkpoint.latest_pass contract, applied here: any
+corrupt, truncated, version-skewed, or knob-vector-mismatched entry is a
+loudly counted cold fallback (`warm_corrupt` counter + a
+`warm_corrupt_skipped` event naming the file), NEVER a crash and never
+wrong bits -- persistence only short-circuits planning and retention,
+the fold order is baked into the persisted pa/pb gathers themselves.
+
+Concurrency: one flock per warm dir.  A process that cannot take it
+(a second daemon pointed at a live daemon's dir) runs COLD with a
+`warm_disabled` event instead of corrupting the owner's entries.
+
+jax-free by design: imported by the CLI (`warm` subcommand), the daemon
+startup path, and the metrics scrape -- none may touch a backend.  The
+delta result planes cross the device boundary only via the caller's
+arrays (np.asarray on save forces the D2H; rehydration's H2D lives in
+ops/spgemm, the module that owns device arrays).
+
+Knobs (central registry, utils/knobs.py): SPGEMM_TPU_WARM (0|1, default
+1), SPGEMM_TPU_WARM_DIR (unset: daemon uses <socket>.warm/),
+SPGEMM_TPU_WARM_MAX_MB (default 256).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+
+import numpy as np
+
+from spgemm_tpu.utils import knobs
+
+log = logging.getLogger("spgemm_tpu.warmstore")
+
+# On-disk envelope schema.  Bump on any envelope change; entry payloads
+# additionally carry their own codec version (symbolic.PLAN_CODEC_VERSION
+# inside plan payloads) -- either mismatch is a counted cold fallback.
+SCHEMA_VERSION = 1
+
+_LOCK = threading.Lock()
+_DIR: str | None = None          # spgemm-lint: guarded-by(_LOCK)
+_DISABLED: str | None = None     # spgemm-lint: guarded-by(_LOCK)
+_LOCK_FILE = None                # spgemm-lint: guarded-by(_LOCK)
+# delta entries already persisted, key -> version (re-flushing an
+# unchanged entry would re-pay its result's D2H every terminal event)
+_SAVED_DELTA: dict = {}          # spgemm-lint: guarded-by(_LOCK)
+_STATS = {"plan_hits": 0, "plan_misses": 0, "delta_hits": 0,
+          "delta_misses": 0, "corrupt": 0, "saved_plans": 0,
+          "saved_deltas": 0, "pruned": 0}  # spgemm-lint: guarded-by(_LOCK)
+
+
+def enabled() -> bool:
+    """SPGEMM_TPU_WARM=0|1 (default 1) -- re-read per call, so the
+    whole-engine A/B is one env flip even mid-process."""
+    return knobs.get("SPGEMM_TPU_WARM")
+
+
+def budget_bytes() -> int:
+    """SPGEMM_TPU_WARM_MAX_MB (default 256) in bytes."""
+    return knobs.get("SPGEMM_TPU_WARM_MAX_MB") * (1 << 20)
+
+
+def _knob_sig() -> str:
+    """The jit-static knob vector as one comparable string -- stored in
+    every entry and validated on load (the fingerprint already bakes the
+    vector in, so this only fires on a tampered/hand-copied file -- which
+    is exactly when it must)."""
+    return repr(knobs.jit_static_vector())
+
+
+# ---------------------------------------------------------- configuration --
+def configure(path: str | None = None) -> bool:
+    """Bind the store to a directory and take its flock.
+
+    Explicit SPGEMM_TPU_WARM_DIR wins over `path` (so a fleet deployment
+    can share one dir across sockets); with neither, the store stays
+    inactive.  Returns True when the store is usable.  Lock contention
+    (another live process owns the dir) disables the store for this
+    process -- a counted, evented cold start, never a corrupted peer."""
+    global _DIR, _DISABLED, _LOCK_FILE
+    if not enabled():
+        return False
+    directory = knobs.get("SPGEMM_TPU_WARM_DIR") or path
+    if not directory:
+        return False
+    from spgemm_tpu.obs import events  # noqa: PLC0415
+    with _LOCK:
+        if _DIR == directory and _LOCK_FILE is not None:
+            return True  # already configured on this dir
+        _release_locked()
+        try:
+            os.makedirs(directory, exist_ok=True)
+            lock_path = os.path.join(directory, "lock")
+            fh = open(lock_path, "a+")
+        except OSError as e:
+            _DISABLED = f"warm dir unusable: {e!r}"
+            log.warning("warm store disabled: %s", _DISABLED)
+            return False
+        import fcntl  # noqa: PLC0415 -- posix-only, like the daemon's unix socket
+        # brief retry: `cli warm --stat/--clear` probes the lock for a
+        # few microseconds, and losing THAT race must not cold-start a
+        # daemon for its whole lifetime; a dir genuinely held by a live
+        # process still fails fast (~a quarter second)
+        locked = False
+        for attempt in range(6):
+            try:
+                fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                locked = True
+                break
+            except OSError:
+                if attempt < 5:
+                    import time  # noqa: PLC0415
+                    time.sleep(0.05)
+        if not locked:
+            fh.close()
+            _DISABLED = (f"warm dir {directory} is locked by another "
+                         "live process; running cold")
+            log.warning("warm store disabled: %s", _DISABLED)
+            events.emit("warm_disabled", dir=directory,
+                        reason="lock_contention")
+            return False
+        _DIR, _DISABLED, _LOCK_FILE = directory, None, fh
+        plans, deltas, size = _scan_locked()
+    _fence_delta_versions(directory)
+    log.info("warm store at %s: %d plans, %d delta entries, %d bytes",
+             directory, plans, deltas, size)
+    events.emit("warm_load", dir=directory, plans=plans, deltas=deltas,
+                bytes=size)
+    return True
+
+
+def _fence_delta_versions(directory: str) -> None:
+    """Advance ops/delta's monotonic version source past every persisted
+    entry's version, BEFORE any multiply can mint a fresh one.  Without
+    this, a fresh process could re-issue a version number some surviving
+    on-disk tag still references -- a rehydrated consumer would read the
+    fresh producer's tag as already-consumed and splice stale rows
+    (wrong bits).  A consumer's tag references are always OLDER than its
+    own version (minted at commit, after the consumed tag existed), so
+    the on-disk maximum bounds every reference; reading one int64 per
+    entry keeps startup lazy (no payload deserializes).  An unreadable
+    entry is skipped here -- its load will count it corrupt."""
+    from spgemm_tpu.ops import delta  # noqa: PLC0415
+    high = 0
+    try:
+        names = [n for n in os.listdir(directory)
+                 if n.startswith("delta-") and n.endswith(".npz")]
+    except OSError:
+        return
+    for name in names:
+        try:
+            with np.load(os.path.join(directory, name),
+                         allow_pickle=False) as z:
+                high = max(high, int(z["version"]))
+        except Exception:  # noqa: BLE001 -- corrupt entry: counted at load, not here
+            continue
+    if high:
+        delta.fence_version(high)
+
+
+def _release_locked() -> None:
+    global _DIR, _DISABLED, _LOCK_FILE
+    if _LOCK_FILE is not None:
+        try:
+            _LOCK_FILE.close()  # closing drops the flock
+        except OSError:
+            pass
+    _DIR = _DISABLED = _LOCK_FILE = None
+    _SAVED_DELTA.clear()
+
+
+def release() -> None:
+    """Drop the flock and unbind (daemon stop, harness handoff to a
+    child process).  On-disk entries stay."""
+    with _LOCK:
+        _release_locked()
+
+
+def reset() -> None:
+    """Tests/A-B harnesses: release + zero the counters."""
+    with _LOCK:
+        _release_locked()
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _ensure_configured() -> None:
+    """Auto-bind from SPGEMM_TPU_WARM_DIR on first use (run-once CLI and
+    bench children need no explicit configure call)."""
+    with _LOCK:
+        ready = _LOCK_FILE is not None or _DISABLED is not None
+    if not ready and knobs.get("SPGEMM_TPU_WARM_DIR"):
+        configure()
+
+
+def active() -> bool:
+    """True when persistence is on, a dir is bound, and this process
+    holds its flock."""
+    if not enabled():
+        return False
+    _ensure_configured()
+    with _LOCK:
+        return _LOCK_FILE is not None
+
+
+def directory() -> str | None:
+    with _LOCK:
+        return _DIR
+
+
+def disabled_reason() -> str | None:
+    with _LOCK:
+        return _DISABLED
+
+
+# -------------------------------------------------------------- file layer --
+def _plan_path(d: str, fingerprint: str) -> str:
+    return os.path.join(d, f"plan-{fingerprint}.npz")
+
+
+def _delta_path(d: str, key: str) -> str:
+    # the delta key embeds device-placement brackets (ops/spgemm._delta_key)
+    # -- hash it into a filename; the full key is stored inside and checked
+    digest = hashlib.sha256(key.encode()).hexdigest()[:40]
+    return os.path.join(d, f"delta-{digest}.npz")
+
+
+def _atomic_savez(path: str, payload: dict) -> None:
+    tmp = path + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **payload)
+    os.replace(tmp, path)
+
+
+def _scan_locked() -> tuple[int, int, int]:
+    """(plan files, delta files, total npz bytes) of the bound dir."""
+    plans = deltas = size = 0
+    if _DIR is None:
+        return 0, 0, 0
+    try:
+        names = os.listdir(_DIR)
+    except OSError:
+        return 0, 0, 0
+    for name in names:
+        if not name.endswith(".npz"):
+            continue
+        try:
+            size += os.path.getsize(os.path.join(_DIR, name))
+        except OSError:
+            continue  # pruned/replaced under us: not worth a stale count
+        if name.startswith("plan-"):
+            plans += 1
+        elif name.startswith("delta-"):
+            deltas += 1
+    return plans, deltas, size
+
+
+def _note_corrupt(path: str, reason: str) -> None:
+    """One corrupt/skewed/mismatched entry skipped: count it, event it,
+    and UNLINK it so the slot self-heals -- the caller proceeds cold,
+    re-derives the entry, and the next flush re-persists it (a corrupt
+    file left in place would block save_plan's exists-check idempotency
+    and make this fingerprint cold on every future restart)."""
+    from spgemm_tpu.obs import events  # noqa: PLC0415
+    from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+    with _LOCK:
+        _STATS["corrupt"] += 1
+    ENGINE.incr("warm_corrupt")
+    try:
+        os.unlink(path)
+    except OSError:
+        pass  # already gone / unwritable dir: the count still stands
+    log.warning("warm entry %s skipped (%s); removed, cold fallback",
+                path, reason)
+    events.emit("warm_corrupt_skipped", path=os.path.basename(path),
+                reason=reason)
+
+
+def _check_envelope(z, path: str, kind: str, ident: str) -> bool:
+    """Validate one loaded npz's envelope: schema version, entry kind,
+    identity (fingerprint/key) and the jit-static knob vector.  False =
+    counted cold fallback."""
+    schema = int(z["schema"]) if "schema" in z.files else -1
+    if schema != SCHEMA_VERSION:
+        _note_corrupt(path, f"schema version {schema} != {SCHEMA_VERSION}")
+        return False
+    if str(z["kind"]) != kind or str(z["ident"]) != ident:
+        _note_corrupt(path, "entry identity mismatch")
+        return False
+    if str(z["knobs"]) != _knob_sig():
+        _note_corrupt(path, "jit-static knob vector mismatch")
+        return False
+    return True
+
+
+# ------------------------------------------------------------------ plans --
+def save_plan(plan) -> bool:
+    """Persist one EXACT fingerprinted plan (atomic, idempotent: an
+    existing file for the fingerprint is left alone -- plans are immutable
+    once their join landed).  False when skipped for any reason."""
+    if not active() or getattr(plan, "fingerprint", None) is None:
+        return False
+    from spgemm_tpu.ops.symbolic import plan_to_arrays  # noqa: PLC0415
+    with _LOCK:
+        d = _DIR
+    if d is None:
+        return False
+    path = _plan_path(d, plan.fingerprint)
+    if os.path.exists(path):
+        return False
+    payload = plan_to_arrays(plan)
+    if payload is None:
+        return False  # deferred join: nothing worth persisting yet
+    payload.update(schema=np.int64(SCHEMA_VERSION), kind=np.array("plan"),
+                   ident=np.array(plan.fingerprint),
+                   knobs=np.array(_knob_sig()))
+    try:
+        _atomic_savez(path, payload)
+    except OSError as e:
+        log.warning("warm plan save failed (%r); continuing", e)
+        return False
+    with _LOCK:
+        _STATS["saved_plans"] += 1
+    return True
+
+
+def load_plan(fingerprint: str):
+    """The persisted plan for a fingerprint, or None (miss or counted
+    corrupt fallback).  Runs inside the engine's `warm_load` phase with
+    the hit/miss counters bumped here, so per-job attribution rides the
+    calling thread like every other engine phase."""
+    if not active():
+        return None
+    from spgemm_tpu.ops.symbolic import plan_from_arrays  # noqa: PLC0415
+    from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+    with _LOCK:
+        d = _DIR
+    if d is None:
+        return None
+    path = _plan_path(d, fingerprint)
+    with ENGINE.phase("warm_load"):
+        if not os.path.exists(path):
+            with _LOCK:
+                _STATS["plan_misses"] += 1
+            ENGINE.incr("warm_misses")
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                if not _check_envelope(z, path, "plan", fingerprint):
+                    return None
+                plan = plan_from_arrays(z, fingerprint=fingerprint)
+        except Exception as e:  # noqa: BLE001 -- any unreadable entry is a counted cold fallback
+            _note_corrupt(path, repr(e))
+            return None
+    with _LOCK:
+        _STATS["plan_hits"] += 1
+    ENGINE.incr("warm_hits")
+    return plan
+
+
+# ------------------------------------------------------------ delta entries --
+def _encode_src(prefix: str, src: tuple, payload: dict) -> bool:
+    """One operand provenance tuple into the payload; False = not
+    persistable (opaque provenance cannot be diffed after restart)."""
+    if src[0] == "digest":
+        payload[f"{prefix}_kind"] = np.array("digest")
+        payload[f"{prefix}_rows"] = np.asarray(src[1], np.int64)
+        payload[f"{prefix}_digs"] = np.asarray(src[2], dtype="S32")
+        return True
+    if src[0] == "tag":
+        payload[f"{prefix}_kind"] = np.array("tag")
+        payload[f"{prefix}_tag_key"] = np.array(src[1])
+        payload[f"{prefix}_tag_version"] = np.int64(src[2])
+        return True
+    return False
+
+
+def _decode_src(prefix: str, z) -> tuple:
+    kind = str(z[f"{prefix}_kind"])
+    if kind == "digest":
+        return ("digest", np.asarray(z[f"{prefix}_rows"], np.int64),
+                np.asarray(z[f"{prefix}_digs"], dtype="S32"))
+    if kind == "tag":
+        return ("tag", str(z[f"{prefix}_tag_key"]),
+                int(z[f"{prefix}_tag_version"]))
+    raise ValueError(f"unknown provenance kind {kind!r}")
+
+
+_VAL_BOUND_NONE = (1 << 64) - 1  # sentinel: result.val_bound was None
+
+
+def save_delta(key: str, entry) -> bool:
+    """Persist one delta-store entry: provenance + the retained result's
+    planes fetched to host (np.asarray -- the one D2H of the flush; runs
+    off the serving critical path, after the job's terminal event)."""
+    if not active():
+        return False
+    res = entry.result
+    try:
+        hi = np.asarray(res.hi)
+        lo = np.asarray(res.lo)
+        meta = np.array([res.rows, res.cols, res.k], np.int64)
+        coords = np.asarray(res.coords, np.int64)
+        vb = res.val_bound
+    except AttributeError:
+        return False  # a result type without planes: nothing to retain
+    payload = {
+        "schema": np.int64(SCHEMA_VERSION), "kind": np.array("delta"),
+        "ident": np.array(key), "knobs": np.array(_knob_sig()),
+        "version": np.int64(entry.version),
+        "out_rows": np.int64(entry.out_rows),
+        "res_meta": meta, "res_coords": coords,
+        "res_hi": hi, "res_lo": lo,
+        "res_val_bound": np.uint64(_VAL_BOUND_NONE if vb is None
+                                   else min(vb, _VAL_BOUND_NONE - 1)),
+    }
+    if not (_encode_src("a", entry.a_src, payload)
+            and _encode_src("b", entry.b_src, payload)):
+        return False
+    with _LOCK:
+        d = _DIR
+    if d is None:
+        return False
+    path = _delta_path(d, key)
+    try:
+        _atomic_savez(path, payload)
+    except OSError as e:
+        log.warning("warm delta save failed (%r); continuing", e)
+        return False
+    with _LOCK:
+        _STATS["saved_deltas"] += 1
+        _SAVED_DELTA[key] = entry.version
+    return True
+
+
+def load_delta(key: str) -> dict | None:
+    """The persisted delta entry for a key as HOST data, or None (miss or
+    counted corrupt fallback): {"version", "out_rows", "a_src", "b_src",
+    "result": {rows, cols, k, coords, hi, lo, val_bound}}.  The caller
+    (ops/spgemm) re-uploads the planes and seeds ops/delta -- this module
+    stays jax-free."""
+    if not active():
+        return None
+    from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+    with _LOCK:
+        d = _DIR
+    if d is None:
+        return None
+    path = _delta_path(d, key)
+    with ENGINE.phase("warm_load"):
+        if not os.path.exists(path):
+            with _LOCK:
+                _STATS["delta_misses"] += 1
+            ENGINE.incr("warm_misses")
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                if not _check_envelope(z, path, "delta", key):
+                    return None
+                rows, cols, k = (int(v) for v in z["res_meta"])
+                vb = int(z["res_val_bound"])
+                out = {
+                    "version": int(z["version"]),
+                    "out_rows": int(z["out_rows"]),
+                    "a_src": _decode_src("a", z),
+                    "b_src": _decode_src("b", z),
+                    "result": {
+                        "rows": rows, "cols": cols, "k": k,
+                        "coords": np.asarray(z["res_coords"], np.int64),
+                        "hi": np.asarray(z["res_hi"], np.uint32),
+                        "lo": np.asarray(z["res_lo"], np.uint32),
+                        "val_bound": (None if vb == _VAL_BOUND_NONE
+                                      else vb),
+                    },
+                }
+        except Exception as e:  # noqa: BLE001 -- any unreadable entry is a counted cold fallback
+            _note_corrupt(path, repr(e))
+            return None
+    with _LOCK:
+        _STATS["delta_hits"] += 1
+        _SAVED_DELTA[key] = out["version"]  # what disk holds = what we loaded
+    ENGINE.incr("warm_hits")
+    return out
+
+
+# ------------------------------------------------------------------ flush --
+def flush() -> dict:
+    """Persist every in-memory entry not yet on disk, then prune to the
+    byte budget.  Called by spgemmd after each terminal job event and at
+    shutdown; cheap when nothing changed (plan files are checked by
+    existence, delta entries by version).  Never raises."""
+    counts = {"plans": 0, "deltas": 0, "pruned": 0}
+    try:
+        if not active():
+            return counts
+        from spgemm_tpu.obs import events  # noqa: PLC0415
+        from spgemm_tpu.ops import delta, plancache  # noqa: PLC0415
+        from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+        with ENGINE.phase("warm_flush"):
+            for _, plan in plancache.entries():
+                if save_plan(plan):
+                    counts["plans"] += 1
+            for key, entry in delta.entries():
+                with _LOCK:
+                    unchanged = _SAVED_DELTA.get(key) == entry.version
+                if not unchanged and save_delta(key, entry):
+                    counts["deltas"] += 1
+            counts["pruned"] = _prune_budget()
+        if counts["plans"] or counts["deltas"] or counts["pruned"]:
+            events.emit("warm_flush", **counts)
+    except Exception as e:  # noqa: BLE001 -- persistence must never take down the serving path (the spgemmd executor calls this bare)
+        log.warning("warm flush failed midway (%r); store left partial "
+                    "but every entry is self-validating", e)
+    return counts
+
+
+def _prune_budget() -> int:
+    """Drop oldest entries past SPGEMM_TPU_WARM_MAX_MB.  The xla/
+    compilation-cache subdir manages its own size and is excluded."""
+    with _LOCK:
+        d = _DIR
+    if d is None:
+        return 0
+    budget = budget_bytes()
+    try:
+        files = []
+        for name in os.listdir(d):
+            if not name.endswith(".npz"):
+                continue
+            path = os.path.join(d, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            files.append((st.st_mtime, st.st_size, path))
+    except OSError:
+        return 0
+    total = sum(size for _, size, _ in files)
+    pruned = 0
+    for _, size, path in sorted(files):
+        if total <= budget:
+            break
+        try:
+            os.unlink(path)
+        except OSError:
+            continue
+        total -= size
+        pruned += 1
+        with _LOCK:
+            # a pruned delta file must be re-flushable later
+            for key in list(_SAVED_DELTA):
+                if _delta_path(d, key) == path:
+                    del _SAVED_DELTA[key]
+    if pruned:
+        with _LOCK:
+            _STATS["pruned"] += pruned
+        log.info("warm store pruned %d entries to fit %d bytes",
+                 pruned, budget)
+    return pruned
+
+
+# ---------------------------------------------------------- jax wiring ----
+def configure_compilation_cache() -> bool:
+    """Point JAX's persistent compilation cache at <dir>/xla (daemon
+    startup, after the platform pin): re-jit of an executable an earlier
+    daemon compiled on the same jit-static knob vector becomes a disk
+    hit.  Lazy jax import -- this module stays importable jax-free; a
+    jax too old for the config keys is a logged no-op."""
+    with _LOCK:
+        d = _DIR
+    if d is None or not enabled():
+        return False
+    cache_dir = os.path.join(d, "xla")
+    try:
+        import jax  # noqa: PLC0415
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # noqa: BLE001 -- cache wiring is an optimization, never a startup failure
+        log.warning("persistent compilation cache not wired (%r)", e)
+        return False
+    log.info("jax persistent compilation cache at %s", cache_dir)
+    return True
+
+
+# ------------------------------------------------------------------ stats --
+def stats() -> dict:
+    """Live store state for `spgemm_tpu.cli warm --stat`, `cli knobs`,
+    spgemmd stats, and the Prometheus scrape."""
+    with _LOCK:
+        plans, deltas, size = _scan_locked()
+        return {
+            "dir": _DIR,
+            "enabled": enabled(),
+            "active": _LOCK_FILE is not None,
+            "disabled_reason": _DISABLED,
+            "plans": plans,
+            "deltas": deltas,
+            "bytes": size,
+            "budget_bytes": budget_bytes(),
+            **dict(_STATS),
+        }
+
+
+def scan(path: str) -> dict:
+    """Read-only file-level view of an ARBITRARY warm dir -- no binding,
+    no persistent flock (`spgemm_tpu.cli warm --stat` inspects a live
+    daemon's dir without stealing it): entry counts, bytes, and whether
+    a live process currently holds the dir's lock."""
+    out = {"dir": path, "exists": os.path.isdir(path), "plans": 0,
+           "deltas": 0, "bytes": 0, "locked": False,
+           "budget_bytes": budget_bytes()}
+    if not out["exists"]:
+        return out
+    for name in os.listdir(path):
+        if not name.endswith(".npz"):
+            continue
+        try:
+            out["bytes"] += os.path.getsize(os.path.join(path, name))
+        except OSError:
+            continue
+        if name.startswith("plan-"):
+            out["plans"] += 1
+        elif name.startswith("delta-"):
+            out["deltas"] += 1
+    lock_path = os.path.join(path, "lock")
+    if os.path.exists(lock_path):
+        import fcntl  # noqa: PLC0415
+        try:
+            probe = open(lock_path, "a+")
+        except OSError:
+            return out
+        try:
+            fcntl.flock(probe.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            out["locked"] = True
+        finally:
+            probe.close()  # drops the probe lock if we took it
+    return out
+
+
+def clear(path: str | None = None) -> int:
+    """Delete every warm entry (and the xla cache subdir) under `path`
+    or the bound dir.  Refuses while another live process holds the
+    dir's flock.  Returns the number of entries removed."""
+    target = path
+    if target is None:
+        with _LOCK:
+            target = _DIR
+    if target is None or not os.path.isdir(target):
+        return 0
+    with _LOCK:
+        own = _LOCK_FILE is not None and _DIR == target
+    if not own:
+        import fcntl  # noqa: PLC0415
+        try:
+            probe = open(os.path.join(target, "lock"), "a+")
+        except OSError:
+            probe = None
+        if probe is not None:
+            try:
+                fcntl.flock(probe.fileno(),
+                            fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                probe.close()
+                raise RuntimeError(
+                    f"warm dir {target} is in use by a live process; "
+                    "stop it before clearing") from None
+            probe.close()  # drops the probe lock
+    removed = 0
+    for name in os.listdir(target):
+        if name.endswith(".npz"):
+            try:
+                os.unlink(os.path.join(target, name))
+                removed += 1
+            except OSError:
+                pass
+    xla_dir = os.path.join(target, "xla")
+    if os.path.isdir(xla_dir):
+        import shutil  # noqa: PLC0415
+        shutil.rmtree(xla_dir, ignore_errors=True)
+    with _LOCK:
+        _SAVED_DELTA.clear()
+    return removed

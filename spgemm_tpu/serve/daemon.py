@@ -32,6 +32,14 @@ Reliability model (the part the reference cannot have):
     reached a terminal state, and a job submitted with a checkpoint_dir
     resumes its chain from the newest complete pass
     (utils/checkpoint.latest_pass survives a truncated newest file).
+  * Warm start (ops/warmstore, SPGEMM_TPU_WARM): the plan cache and the
+    delta store's retained results persist into <socket>.warm/ -- loaded
+    lazily at startup, flushed after each terminal job event and at
+    shutdown -- and JAX's persistent compilation cache points at the
+    same dir, so a restarted daemon's first submit is a warm plan + a
+    delta recompute + cached executables instead of minutes of cold
+    planning and jit.  Corrupt/skewed entries and a warm dir locked by
+    another live daemon are counted cold fallbacks, never failures.
 
 Per-job observability: each job runs under an ENGINE PhaseScope
 (utils/timers), so its status detail carries exactly its own phases_s and
@@ -54,6 +62,7 @@ from spgemm_tpu.obs import events as obs_events
 from spgemm_tpu.obs import metrics as obs_metrics
 from spgemm_tpu.obs import profile as obs_profile
 from spgemm_tpu.obs import trace as obs_trace
+from spgemm_tpu.ops import warmstore
 from spgemm_tpu.serve import protocol
 from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
                                     QueueFull)
@@ -158,7 +167,8 @@ class Daemon:
     def __init__(self, socket_path: str | None = None, *, runner=None,
                  probe=None, queue_cap: int | None = None,
                  job_timeout_s: float | None = None,
-                 wedge_grace_s: float | None = None, journal: bool = True):
+                 wedge_grace_s: float | None = None, journal: bool = True,
+                 persist_compile_cache: bool = False):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.journal_path = self.socket_path + ".journal"
         # postmortem flight dumps (watchdog reap / wedge / degrade) land
@@ -167,6 +177,11 @@ class Daemon:
         # structured event log (obs/events.py): JSONL next to the journal,
         # rotated at SPGEMM_TPU_OBS_EVENTS_MAX_KB
         self.events_path = self.socket_path + ".events.jsonl"
+        # warm-start store (ops/warmstore): persisted plans + delta
+        # entries next to the journal, so a restarted daemon is hot in
+        # seconds (SPGEMM_TPU_WARM_DIR overrides the journal-adjacent
+        # default; SPGEMM_TPU_WARM=0 disables persistence entirely)
+        self.warm_dir = self.socket_path + ".warm"
         self._runner = runner or run_chain_job
         self._probe = probe
         self._cap = queue_cap if queue_cap is not None \
@@ -180,6 +195,11 @@ class Daemon:
         self._wedge_grace_s = wedge_grace_s if wedge_grace_s is not None \
             else knobs.get("SPGEMM_TPU_SERVE_WEDGE_GRACE_S")
         self._journal_enabled = journal
+        # main() sets this for the real CLI daemon: jax.config's
+        # compilation-cache dir is PROCESS-GLOBAL state, so an in-process
+        # test daemon must never redirect the host process's compiles
+        # into its (soon-deleted) tmp dir
+        self._persist_compile_cache = persist_compile_cache
         self._journal_terminal_events = 0  # spgemm-lint: guarded-by(_lock)
         self._journal_compactions = 0      # spgemm-lint: guarded-by(_lock)
         # daemon-lifetime terminal outcomes (stats + the Prometheus
@@ -320,6 +340,16 @@ class Daemon:
                     f"a daemon is already serving on {self.socket_path}")
         obs_events.LOG.configure(self.events_path)
         obs_events.emit("daemon_start", socket=self.socket_path)
+        # warm start: bind the journal-adjacent store (lock contention or
+        # SPGEMM_TPU_WARM=0 leaves it cold -- configure() events both),
+        # and point JAX's persistent compilation cache at its xla/ subdir
+        # so re-jit of executables an earlier daemon compiled on the same
+        # jit-static knob vector is a disk hit.  Loading stays LAZY: the
+        # first fingerprint match deserializes its entry, startup only
+        # counts files -- binding never blocks on a full deserialize.
+        if warmstore.configure(self.warm_dir) \
+                and self._persist_compile_cache:
+            warmstore.configure_compilation_cache()
         self._journal_replay()
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
@@ -358,6 +388,12 @@ class Daemon:
         ex = self._executor
         if ex is not None:
             ex.join(timeout=5.0)  # wedged executor: daemon flag covers it
+        # final warm flush + lock release: whatever the terminal-event
+        # flushes missed (an estimator plan whose join landed late, the
+        # newest delta versions) persists before the process dies, and
+        # the dir's flock frees for the successor daemon
+        warmstore.flush()
+        warmstore.release()
         # drain the async event-log writer so a clean shutdown leaves the
         # JSONL complete (best-effort, like the sink itself)
         obs_events.LOG.flush(timeout=2.0)
@@ -379,6 +415,7 @@ class Daemon:
         self._executor.start()
 
     def _executor_loop(self, gen: int) -> None:
+        from spgemm_tpu.ops import plancache  # noqa: PLC0415
         from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
 
         while not self._stop.is_set() and gen == self._executor_gen:
@@ -393,8 +430,11 @@ class Daemon:
             scope = ENGINE.scope()
             # stashed on the job BEFORE it becomes _current: the watchdog
             # reads it to attach per-job detail when reaping, and must
-            # never see a current job without its scope
+            # never see a current job without its scope (the plan-cache
+            # baseline rides along for the same reason: per-job cache
+            # figures diff against pickup, like the PhaseScope does)
             job.scope, job.scope_degraded = scope, degraded
+            job.cache_base = plancache.baseline()
             self._current = job
             try:
                 # every span this job's work emits (executor thread + the
@@ -424,19 +464,23 @@ class Daemon:
                 log.warning("job %s failed: %r", job.id, e)
                 if job.finish("failed", error={
                         "code": protocol.E_JOB_ERROR, "message": repr(e)},
-                        detail=self._job_detail(scope, degraded, job.id),
+                        detail=self._job_detail(scope, degraded, job.id,
+                                                job.cache_base),
                         on_commit=lambda: self._journal_append(
                             {"event": "failed", "id": job.id})):
                     self._observe_terminal(job, "error")
                     obs_events.emit("job_failed", job_id=job.id,
                                     error=repr(e))
+                warmstore.flush()  # terminal event: persist what the job warmed
             else:
                 if job.finish("done",
-                              detail=self._job_detail(scope, degraded, job.id),
+                              detail=self._job_detail(scope, degraded, job.id,
+                                                      job.cache_base),
                               on_commit=lambda: self._journal_append(
                                   {"event": "done", "id": job.id})):
                     self._observe_terminal(job, "done")
                     obs_events.emit("job_done", job_id=job.id)
+                warmstore.flush()  # terminal event: persist what the job warmed
             finally:
                 # detach the per-job collector: a wedged executor that
                 # unwedges hours later closes the OLD job's scope here --
@@ -450,15 +494,25 @@ class Daemon:
                     self._current = None
 
     @staticmethod
-    def _job_detail(scope, degraded: bool, job_id: str | None = None) -> dict:
+    def _job_detail(scope, degraded: bool, job_id: str | None = None,
+                    cache_base: dict | None = None) -> dict:
         """The per-job status detail: the same phases_s + engine counters
-        bench.py emits, scoped to this job alone (PhaseScope diff)."""
+        bench.py emits, scoped to this job alone (PhaseScope diff).
+        cache_base: the plan-cache counter baseline captured at pickup --
+        the detail's `plan_cache` block then reports THIS job's
+        hit/miss/eviction deltas, not process-lifetime totals."""
+        from spgemm_tpu.ops import plancache  # noqa: PLC0415
+        try:
+            cache_scoped = plancache.stats(since=cache_base)
+        except ValueError as e:
+            cache_scoped = {"error": str(e)}
         counters = scope.counter_snapshot()
         # per-job HBM high-water mark (obs/profile window keyed by job
         # id); None on backends without memory_stats -> key omitted,
         # never a zero that reads as "no memory used"
         hbm_peak = obs_profile.memory_job_peak(job_id)
         return {"phases_s": scope.snapshot(), "degraded": degraded,
+                "plan_cache": cache_scoped,
                 **({"hbm_peak_bytes": hbm_peak}
                    if hbm_peak is not None else {}),
                 "plan_cache_hits": counters.get("plan_cache_hits", 0),
@@ -482,7 +536,8 @@ class Daemon:
         scope = job.scope
         if scope is None:
             return None
-        return self._job_detail(scope, job.scope_degraded, job.id)
+        return self._job_detail(scope, job.scope_degraded, job.id,
+                                job.cache_base)
 
     # ------------------------------------------------------ observability --
     def _observe_terminal(self, job: Job, outcome: str) -> None:
@@ -865,6 +920,10 @@ class Daemon:
             delta_stats = delta.stats()
         except ValueError as e:
             delta_stats = {"error": str(e)}
+        try:
+            warm_stats = warmstore.stats()
+        except ValueError as e:
+            warm_stats = {"error": str(e)}
         with self._lock:
             degraded = self.degraded
             degrade_reason = self.degrade_reason
@@ -891,6 +950,7 @@ class Daemon:
             flight_dir=self.flight_dir,
             plan_cache=cache,
             delta=delta_stats,
+            warm=warm_stats,
             socket=self.socket_path,
         )
 
@@ -995,7 +1055,8 @@ def main(argv: list[str] | None = None) -> int:
         from spgemm_tpu.utils.backend_probe import failover_to_cpu  # noqa: PLC0415
         degraded_at_start = failover_to_cpu("spgemmd")
     daemon = Daemon(args.socket, queue_cap=args.queue_cap,
-                    journal=not args.no_journal)
+                    journal=not args.no_journal,
+                    persist_compile_cache=True)
     if degraded_at_start:
         # the device was dead before we ever owned it: CPU failover path
         # from the first job, reported in stats like a mid-flight degrade.

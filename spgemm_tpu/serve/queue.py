@@ -76,6 +76,10 @@ class Job:
         # status still carries its per-job phases/counters detail
         self.scope = None
         self.scope_degraded = False
+        # plan-cache counter baseline captured at pickup (ops/plancache.
+        # baseline): per-job detail diffs against it, so a second job's
+        # hit/miss figures never inherit the first's process totals
+        self.cache_base = None
         self._lock = threading.Lock()
         self._terminal = threading.Event()
 

@@ -13,7 +13,15 @@ tiles mutated in one operand, and asserts the serving contract:
     -- the delta-recompute proof (ops/delta): a mostly-unchanged submit
     re-folds only the output rows the dirty tiles reach;
   * stats reports a healthy (non-degraded) daemon;
-  * shutdown is clean (daemon exits 0, socket unlinked).
+  * shutdown is clean (daemon exits 0, socket unlinked);
+  * RESTART LEG (the warm-start proof, ops/warmstore): a second daemon
+    on the same socket + warm dir re-serves the mutated chain, and its
+    first-contact job must report `warm_hits >= 1` (every plan came from
+    disk, not the symbolic planner -- the on-disk tier of the plan
+    cache), zero `plan_cache` scoped hits but warm-loaded plans, and a
+    DELTA recompute (`delta_rows == 0 < total_rows`, zero
+    `delta_full_fallbacks`) against the rehydrated retained result --
+    bit-exact again, clean shutdown again.
 
 Any step failing exits nonzero.  This process itself stays jax-free (the
 oracle and the generator are pure numpy) -- only the daemon touches a
@@ -58,10 +66,16 @@ def main() -> int:
     want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
         mats[0].rows, mats[-1].cols, k, want).prune_zeros())
 
+    # the restart leg asserts against the socket-adjacent warm dir, so an
+    # operator-exported SPGEMM_TPU_WARM*/WARM_DIR must not redirect (or
+    # disable) the daemons' persistence under the harness
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("SPGEMM_TPU_WARM")}
     proc = subprocess.Popen(
         [sys.executable, "-m", "spgemm_tpu.cli", "serve",
          "--socket", sock, "--device", "cpu", "-v"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
     try:
         deadline = time.time() + 120
         while not os.path.exists(sock):
@@ -138,11 +152,71 @@ def main() -> int:
             return _fail(proc, f"daemon exited {rc} after shutdown")
         if os.path.exists(sock):
             return _fail(None, "socket not unlinked on clean shutdown")
+
+        # ---- restart leg: the warm-start proof (ops/warmstore) ----
+        warm_dir = sock + ".warm"
+        if not any(n.endswith(".npz") for n in os.listdir(warm_dir)):
+            return _fail(None, f"first daemon left no warm entries in "
+                               f"{warm_dir}")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+             "--socket", sock, "--device", "cpu", "-v"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        deadline = time.time() + 120
+        while not os.path.exists(sock):
+            if proc.poll() is not None:
+                return _fail(proc, "restarted daemon exited before "
+                                   "binding its socket")
+            if time.time() > deadline:
+                return _fail(proc, "restarted daemon never bound its "
+                                   "socket")
+            time.sleep(0.1)
+        out4 = os.path.join(tmp, "matrix.4")
+        resp = client.submit(folder, sock, {"output": out4})
+        resp = client.wait(resp["id"], sock, timeout=300)
+        job4 = resp["job"]
+        if job4["state"] != "done":
+            return _fail(proc, f"post-restart job ended {job4['state']}: "
+                               f"{job4['error']}")
+        if open(out4, "rb").read() != want3_bytes:
+            return _fail(proc, "post-restart output does not match the "
+                               "oracle bytes")
+        det = job4["detail"]
+        warm_hits = det.get("warm_hits", 0)
+        if warm_hits < 1:
+            return _fail(proc, f"post-restart job reported warm_hits="
+                               f"{warm_hits}; the warm store served "
+                               "nothing (want >= 1: first contact must "
+                               "be a cache hit from disk)")
+        if det.get("delta_full_fallbacks", 0) != 0:
+            return _fail(proc, "post-restart job took a delta full "
+                               "fallback; the rehydrated retained result "
+                               "was not served "
+                               f"(fallbacks={det.get('delta_full_fallbacks')})")
+        d4_rows = det.get("delta_rows", -1)
+        t4_rows = det.get("total_rows", 0)
+        if not (d4_rows == 0 and t4_rows > 0):
+            return _fail(proc, "post-restart submit of the unchanged "
+                               "input should be a clean delta "
+                               f"(0 recomputed rows), got delta_rows="
+                               f"{d4_rows} total_rows={t4_rows}")
+        client.shutdown(sock)
+        try:
+            rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            return _fail(proc, "restarted daemon did not exit after "
+                               "shutdown")
+        if rc != 0:
+            return _fail(proc, f"restarted daemon exited {rc} after "
+                               "shutdown")
     finally:
         if proc.poll() is None:
             proc.kill()
     print(f"serve-smoke: OK (3 jobs bit-exact vs oracle, warm hits={hits}, "
-          f"delta rows {delta_rows}/{total_rows}, clean shutdown)")
+          f"delta rows {delta_rows}/{total_rows}; restart leg: "
+          f"warm_hits={warm_hits}, clean delta {d4_rows}/{t4_rows}, "
+          "clean shutdown x2)")
     return 0
 
 

@@ -145,6 +145,36 @@ _KNOBS = (
          "(join_fallback phase, est_fallbacks counter); above 1 forces "
          "the fallback everywhere.",
          "ops/estimate.py", default="0.5", minimum=0),
+    Knob("SPGEMM_TPU_WARM", "bool01",
+         "Persistent warm start (ops/warmstore.py): 1 = the structure-"
+         "keyed plan cache and the delta store's retained results are "
+         "serialized into the warm dir (spgemmd: <socket>.warm/, or "
+         "SPGEMM_TPU_WARM_DIR) and reloaded lazily on fingerprint match "
+         "after a restart, and spgemmd points JAX's persistent "
+         "compilation cache at the same dir -- restart-to-first-result "
+         "drops from a cold plan + cold jit + full recompute to a disk "
+         "hit; 0 = no persistence anywhere (the whole-engine A/B: "
+         "bit-identical either way, persistence only short-circuits "
+         "planning and retention, never fold order).  Any corrupt, "
+         "version-skewed, or knob-vector-mismatched entry is a loudly "
+         "counted cold fallback (warm_corrupt), never a crash or wrong "
+         "bits.",
+         "ops/warmstore.py", default="1"),
+    Knob("SPGEMM_TPU_WARM_DIR", "path",
+         "Warm-start store directory (unset: no persistence for run-once "
+         "processes; spgemmd defaults to <socket>.warm/ next to its job "
+         "journal).  Safe to share across restarts but not across LIVE "
+         "processes: a flock guards the dir, and a process that cannot "
+         "take it runs cold (counted) instead of corrupting a concurrent "
+         "daemon's entries.",
+         "ops/warmstore.py"),
+    Knob("SPGEMM_TPU_WARM_MAX_MB", "int",
+         "Warm store on-disk budget, MiB: after each flush the oldest "
+         "plan/delta entries are pruned until the store fits (the JAX "
+         "compilation-cache subdir manages its own size and is not "
+         "counted).  A pruned entry just makes the next same-structure "
+         "contact a counted cold fallback.",
+         "ops/warmstore.py", default="256", minimum=1),
     Knob("SPGEMM_TPU_HYBRID_GATE", "enum",
          "Hybrid speed-gate policy: auto = measured per-shape crossover, "
          "proof = route on the exactness proof alone (unset: auto on TPU, "
@@ -317,6 +347,17 @@ def get(name: str):
         if raw is None:
             return None
     return _parse(kb, raw)
+
+
+def jit_static_vector() -> tuple:
+    """Every jit-static knob's current (name, value) pair, in registry
+    order -- THE canonical staticity vector: the plan-cache fingerprint
+    (ops/spgemm), the compile records (obs/profile), and the warm-start
+    store's on-disk validation (ops/warmstore) all key on this one
+    definition, so the three surfaces can never drift on what "same
+    compiled configuration" means."""
+    return tuple((kb.name, str(get(kb.name)))
+                 for kb in REGISTRY.values() if kb.jit_static)
 
 
 def pin_unless_exported(name: str, value: str):

@@ -21,6 +21,21 @@ assert not jax._src.xla_bridge._backends, (
     "a jax backend initialized before conftest -- platform pinning failed")
 jax.config.update("jax_platforms", "cpu")
 
+# persistent XLA compilation cache, sharing bench.py's dir: the tier-1
+# suite is compile-dominated on CPU, and every re-run (a CI retry, the
+# round driver's verify) re-compiled hundreds of identical executables
+# from scratch -- serving them from disk roughly halves the
+# compile-heavy files' wall (test_ring: 20 s cold -> 9.6 s warm).
+# Correctness is XLA's own content-hash cache contract, and the compile
+# ACCOUNTING tests still hold: ProfiledJit's AOT lower().compile()
+# records land (with cost analyses) whether the backend compiled or
+# loaded.  The warm-start layer (ops/warmstore) wires the same cache
+# under spgemmd -- this is that tentpole applied to the dev loop.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.expanduser("~/.cache/jax_bench"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -35,10 +50,16 @@ def _fresh_delta_store():
     would be answered from the retained result (content digests are
     value-exact, so results stay CORRECT -- but dispatch-count and
     phase assertions would observe the delta path instead of the engine
-    under test)."""
-    from spgemm_tpu.ops import delta
+    under test).  The warm store (ops/warmstore) is the same hazard one
+    level down -- an in-process Daemon.start() binds the process-wide
+    store to its socket-adjacent dir, and a later test's plan/delta
+    lookups would otherwise be answered from THAT test's disk entries --
+    so it unbinds per test too (reset releases the flock; on-disk files
+    are the owning test's tmp dir and die with it)."""
+    from spgemm_tpu.ops import delta, warmstore
 
     delta.clear()
+    warmstore.reset()
     yield
 
 

@@ -75,3 +75,13 @@ def bad_obs_knob_reads():
     ev = os.environ.get("SPGEMM_TPU_OBS_EVENTS", "1")  # seeded KNB
     cap = os.getenv("SPGEMM_TPU_OBS_EVENTS_MAX_KB")  # seeded KNB
     return ev, cap
+
+
+def bad_warm_knob_reads():
+    # the warm-start persistence knobs are registry knobs like any
+    # other: raw reads are KNB findings (registered in utils/knobs.py,
+    # read via knobs.get in ops/warmstore.py)
+    on = os.environ.get("SPGEMM_TPU_WARM", "1")  # seeded KNB
+    d = os.getenv("SPGEMM_TPU_WARM_DIR")  # seeded KNB
+    mb = environ["SPGEMM_TPU_WARM_MAX_MB"]  # seeded KNB
+    return on, d, mb

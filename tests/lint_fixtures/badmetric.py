@@ -43,3 +43,18 @@ def bad_profile_layer_names():
     timers.incr("spgemm_compiles_total")  # MET: undeclared profile counter
     with timers.phase("compile_wait"):  # MET: undeclared profile phase
         pass
+
+
+def bad_warm_layer_names():
+    # the warm-start layer's series ride the same registries: a
+    # singular near-miss of the declared counter and an ad-hoc load
+    # phase are findings
+    timers.incr("warm_hit")  # MET: undeclared warm counter
+    with timers.phase("warm_loading"):  # MET: undeclared warm phase
+        pass
+
+
+def legal_warm_names(x):
+    with timers.phase("warm_load"):  # legal: declared warm phase
+        timers.incr("warm_hits")  # legal: declared warm counter
+        return x

@@ -115,7 +115,7 @@ def test_knb_fixture_each_violation_caught():
     the same fixture (how harnesses and tests drive knob values) must NOT
     be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 16
+    assert [f.rule for f in findings] == ["KNB"] * 19
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
                    "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
@@ -128,7 +128,9 @@ def test_knb_fixture_each_violation_caught():
                    "SPGEMM_TPU_EST_CONFIDENCE",
                    "SPGEMM_TPU_DELTA", "SPGEMM_TPU_DELTA_RETAIN",
                    "SPGEMM_TPU_OBS_EVENTS",
-                   "SPGEMM_TPU_OBS_EVENTS_MAX_KB"):
+                   "SPGEMM_TPU_OBS_EVENTS_MAX_KB",
+                   "SPGEMM_TPU_WARM", "SPGEMM_TPU_WARM_DIR",
+                   "SPGEMM_TPU_WARM_MAX_MB"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -211,22 +213,29 @@ def test_met_fixture_each_violation_caught():
     declared names and ad-hoc PhaseTimers instances stay legal."""
     findings = lint_file(os.path.join(FIXTURES, "badmetric.py"))
     met = [f for f in findings if f.rule == "MET"]
-    assert len(met) == 5 and findings == met
+    assert len(met) == 7 and findings == met
     flagged = [f.line for f in met]
     for needle in ("MET: undeclared phase name",
                    "MET: undeclared counter name",
                    "MET: computed metric name",
                    "MET: undeclared profile counter",
-                   "MET: undeclared profile phase"):
+                   "MET: undeclared profile phase",
+                   "MET: undeclared warm counter",
+                   "MET: undeclared warm phase"):
         assert _fixture_lines("badmetric.py", needle)[0] in flagged
     msgs = " ".join(f.message for f in met)
     assert "made_up_phase" in msgs and "made_up_counter" in msgs
     # the deep-profiling near-misses: the FAMILY name is not the declared
     # counter name, and an ad-hoc compile phase does not exist
     assert "spgemm_compiles_total" in msgs and "compile_wait" in msgs
+    # the warm-start near-misses: the singular of the declared counter
+    # and an ad-hoc load phase
+    assert "warm_hit" in msgs and "warm_loading" in msgs
     assert "ENGINE_PHASES" in msgs and "ENGINE_COUNTERS" in msgs
     for needle in ("legal: declared phase", "legal: declared counter",
-                   "legal: not the ENGINE registry"):
+                   "legal: not the ENGINE registry",
+                   "legal: declared warm phase",
+                   "legal: declared warm counter"):
         assert _fixture_lines("badmetric.py", needle)[0] not in flagged
 
 
@@ -261,13 +270,14 @@ def test_met_registry_covers_live_call_sites():
     for name in ("plan", "plan_wait", "numeric_dispatch", "assembly",
                  "ring_fold", "dcn_exchange", "serve_execute",
                  "serve_queue_wait", "estimate", "join_fallback",
-                 "delta_diff", "delta_splice"):
+                 "delta_diff", "delta_splice", "warm_load", "warm_flush"):
         assert name in ENGINE_PHASES
     for name in ("dispatches", "plan_cache_hits", "plan_cache_misses",
                  "plan_cache_evictions", "ring_steps", "serve_reaps",
                  "serve_degrades", "est_hits", "est_fallbacks",
                  "delta_rows_recomputed", "delta_rows_total",
-                 "delta_full_fallbacks", "compiles"):
+                 "delta_full_fallbacks", "compiles", "warm_hits",
+                 "warm_misses", "warm_corrupt"):
         assert name in ENGINE_COUNTERS
 
 
@@ -549,14 +559,15 @@ def test_json_report_fixture_run():
     report = json.loads(rc.stdout)
     assert report["clean"] is False
     # badknob: 3 classic + 2 planner-knob + 4 serve-knob + 3
-    # estimator-knob + 2 delta-knob + 2 obs-events-knob reads;
-    # badbackend: 3 import-time touches; badplanner: 2 @host_only-body
-    # touches; FLD: 5 per-module + 2 interprocedural (callchain) + 1
-    # ops/estimate + 1 ops/delta numeric-scope; badthread/badexcept/
-    # stalesup: 3 each; badmetric: undeclared phase + undeclared counter
-    # + computed name + 2 deep-profiling near-misses
-    assert report["counts"] == {"FLD": 9, "KNB": 16, "BKD": 5, "THR": 3,
-                                "EXC": 3, "MET": 5, "DOC": 1, "SUP": 3,
+    # estimator-knob + 2 delta-knob + 2 obs-events-knob + 3 warm-knob
+    # reads; badbackend: 3 import-time touches; badplanner: 2
+    # @host_only-body touches; FLD: 5 per-module + 2 interprocedural
+    # (callchain) + 1 ops/estimate + 1 ops/delta numeric-scope;
+    # badthread/badexcept/stalesup: 3 each; badmetric: undeclared phase
+    # + undeclared counter + computed name + 2 deep-profiling + 2
+    # warm-layer near-misses
+    assert report["counts"] == {"FLD": 9, "KNB": 19, "BKD": 5, "THR": 3,
+                                "EXC": 3, "MET": 7, "DOC": 1, "SUP": 3,
                                 "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:

@@ -250,7 +250,10 @@ def test_heartbeating_executor_is_slow_not_wedged(tmp_path, make_daemon):
             job.touch()
             time.sleep(0.05)
 
-    d = make_daemon(runner=runner, job_timeout_s=0.2, wedge_grace_s=0.3,
+    # grace 0.6 s vs 0.05 s beats: an order of magnitude of margin, so a
+    # shared-host scheduling stall of the runner thread cannot flake a
+    # heartbeating executor into a wedge verdict (observed at 0.3 s)
+    d = make_daemon(runner=runner, job_timeout_s=0.2, wedge_grace_s=0.6,
                     probe=lambda: "should-never-run")
     try:
         j1 = client.submit(folder, d.socket_path)

@@ -1,0 +1,483 @@
+"""Persistent warm start (PR 11 tentpole, ops/warmstore).
+
+The standing contracts:
+  * a persisted plan replays byte-identically: the codec round-trips the
+    exact join, every padded round, and the assembly permutation;
+  * the warm tier is invisible to correctness: warm on/off is a
+    bit-identical whole-engine A/B (persistence short-circuits planning
+    and retention, never fold order);
+  * a restarted process's first same-structure contact is a warm hit
+    (plan) and a clean delta (retained result), not a full fallback;
+  * EVERY doubt -- truncated file, schema skew, jit-static knob vector
+    mismatch, foreign identity, a dir locked by a live process -- is a
+    counted cold fallback, never a crash and never wrong bits (the
+    utils/checkpoint.latest_pass discipline);
+  * the on-disk store is bounded (SPGEMM_TPU_WARM_MAX_MB, oldest
+    pruned).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.ops import delta, plancache, warmstore
+from spgemm_tpu.ops.spgemm import plan as plan_spgemm
+from spgemm_tpu.ops.symbolic import (PLAN_CODEC_VERSION, plan_from_arrays,
+                                     plan_to_arrays)
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_block_sparse
+from spgemm_tpu.utils.semantics import spgemm_oracle
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stores():
+    warmstore.reset()
+    plancache.clear()
+    delta.clear()
+    yield
+    warmstore.reset()
+    plancache.clear()
+    delta.clear()
+
+
+class _Structure:
+    """coords/nnzb/k/val_bound stand-in: all ops/spgemm.plan reads."""
+
+    def __init__(self, n_rows: int, per_row: int, seed: int, k: int = 8):
+        rng = np.random.default_rng(seed)
+        rows = np.repeat(np.arange(n_rows, dtype=np.int64), per_row)
+        cols = rng.integers(0, n_rows, size=len(rows), dtype=np.int64)
+        self.coords = np.unique(np.stack([rows, cols], axis=1), axis=0)
+        self.nnzb = len(self.coords)
+        self.k = k
+        self.val_bound = 0
+
+
+def _host_plan(seed: int = 0, n_rows: int = 20):
+    a = _Structure(n_rows, 3, seed)
+    b = _Structure(n_rows, 3, seed + 1)
+    p = plan_spgemm(a, b, backend="xla", platform="cpu")
+    p.ensure_exact()
+    return p
+
+
+def _assert_plans_equal(p1, p2):
+    assert p1.fingerprint == p2.fingerprint
+    assert (p1.backend, p1.platform, p1.k) == (p2.backend, p2.platform,
+                                               p2.k)
+    assert (p1.a_nnzb, p1.b_nnzb, p1.batch) == (p2.a_nnzb, p2.b_nnzb,
+                                                p2.batch)
+    assert p1.round_size == p2.round_size
+    assert p1.split_fanout == p2.split_fanout
+    assert np.array_equal(p1.join.keys, p2.join.keys)
+    assert np.array_equal(p1.join.pair_ptr, p2.join.pair_ptr)
+    assert np.array_equal(p1.join.pair_a, p2.join.pair_a)
+    assert np.array_equal(p1.join.pair_b, p2.join.pair_b)
+    assert len(p1.rounds) == len(p2.rounds)
+    for r1, r2 in zip(p1.rounds, p2.rounds):
+        assert np.array_equal(r1.key_index, r2.key_index)
+        assert np.array_equal(r1.pa, r2.pa)
+        assert np.array_equal(r1.pb, r2.pb)
+        assert r1.max_fanout == r2.max_fanout
+    assert (p1.take is None) == (p2.take is None)
+    if p1.take is not None:
+        assert np.array_equal(p1.take, p2.take)
+    assert np.array_equal(p1._a_coords, p2._a_coords)
+    assert np.array_equal(p1._b_coords, p2._b_coords)
+
+
+# ------------------------------------------------------------------ codec
+
+
+def test_plan_codec_roundtrip():
+    p = _host_plan()
+    arrays = plan_to_arrays(p)
+    assert arrays is not None
+    assert int(arrays["codec"]) == PLAN_CODEC_VERSION
+    _assert_plans_equal(p, plan_from_arrays(arrays,
+                                            fingerprint=p.fingerprint))
+
+
+def test_plan_codec_refuses_version_skew():
+    arrays = plan_to_arrays(_host_plan())
+    arrays["codec"] = np.int64(PLAN_CODEC_VERSION + 1)
+    with pytest.raises(ValueError, match="version skew"):
+        plan_from_arrays(arrays)
+
+
+def test_deferred_plan_is_not_encodable():
+    """An estimator-routed plan whose exact join has not landed has
+    nothing worth persisting -- the codec must refuse, not half-write."""
+    p = _host_plan()
+    p._exact_builder = lambda plan: None  # re-arm deferral artificially
+    assert plan_to_arrays(p) is None
+
+
+# -------------------------------------------------------- warm plan tier
+
+
+def test_warm_plan_survives_process_cache_clear(monkeypatch, tmp_path):
+    """plancache.clear() simulates process death: the second plan() must
+    be served from disk (warm hit), byte-identical to the original."""
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    p1 = _host_plan(seed=11)
+    warmstore.flush()
+    assert warmstore.stats()["plans"] == 1
+    plancache.clear()
+    p2 = _host_plan(seed=11)
+    st = warmstore.stats()
+    assert st["plan_hits"] == 1 and st["corrupt"] == 0
+    _assert_plans_equal(p1, p2)
+    # and the warm-loaded object is now the in-process L1 entry
+    p3 = _host_plan(seed=11)
+    assert p3 is p2
+
+
+def test_warm_off_is_exactly_cold(monkeypatch, tmp_path):
+    """SPGEMM_TPU_WARM=0 with a populated dir sitting right there must
+    touch nothing -- the whole-engine A/B contract."""
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    _host_plan(seed=12)
+    warmstore.flush()
+    warmstore.reset()
+    monkeypatch.setenv("SPGEMM_TPU_WARM", "0")
+    plancache.clear()
+    _host_plan(seed=12)
+    st = warmstore.stats()
+    assert not st["active"]
+    assert st["plan_hits"] == 0 and st["plan_misses"] == 0
+
+
+# ------------------------------------- corruption / skew / lock fallbacks
+
+
+def _seed_one_plan(tmp_path):
+    p = _host_plan(seed=13)
+    warmstore.flush()
+    files = [n for n in os.listdir(tmp_path) if n.startswith("plan-")]
+    assert len(files) == 1
+    return p, os.path.join(str(tmp_path), files[0])
+
+
+def test_truncated_entry_is_counted_cold_fallback(monkeypatch, tmp_path):
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    p, path = _seed_one_plan(tmp_path)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 3])  # torn write
+    plancache.clear()
+    p2 = _host_plan(seed=13)  # must re-plan cold, not crash
+    st = warmstore.stats()
+    assert st["corrupt"] == 1 and st["plan_hits"] == 0
+    _assert_plans_equal(p, p2)  # the cold re-plan is the same plan
+
+
+def test_schema_skew_is_counted_cold_fallback(monkeypatch, tmp_path):
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    p, path = _seed_one_plan(tmp_path)
+    with np.load(path, allow_pickle=False) as z:
+        payload = {name: z[name] for name in z.files}
+    payload["schema"] = np.int64(warmstore.SCHEMA_VERSION + 1)
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **payload)
+    plancache.clear()
+    _host_plan(seed=13)
+    st = warmstore.stats()
+    assert st["corrupt"] == 1 and st["plan_hits"] == 0
+
+
+def test_knob_vector_mismatch_is_counted_cold_fallback(monkeypatch,
+                                                       tmp_path):
+    """A hand-copied warm dir from a different jit-static config: the
+    fingerprint normally diverges too, but the stored vector is the
+    defense in depth -- tamper the file onto the current fingerprint and
+    the envelope check must still refuse it."""
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    p, path = _seed_one_plan(tmp_path)
+    with np.load(path, allow_pickle=False) as z:
+        payload = {name: z[name] for name in z.files}
+    payload["knobs"] = np.array("(('SPGEMM_TPU_MXU_R', '999'),)")
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **payload)
+    plancache.clear()
+    _host_plan(seed=13)
+    st = warmstore.stats()
+    assert st["corrupt"] == 1 and st["plan_hits"] == 0
+
+
+def test_locked_dir_runs_cold_not_crashed(monkeypatch, tmp_path):
+    """Two concurrent daemons pointed at one warm dir: the loser of the
+    flock must run cold (counted, evented), never corrupt the winner."""
+    import fcntl
+
+    lock_path = os.path.join(str(tmp_path), "lock")
+    holder = open(lock_path, "a+")
+    fcntl.flock(holder.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+    try:
+        monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+        assert warmstore.configure() is False
+        assert not warmstore.active()
+        assert "locked" in (warmstore.disabled_reason() or "")
+        # the engine path stays fully functional, just cold
+        _host_plan(seed=14)
+        st = warmstore.stats()
+        assert st["plans"] == 0 and st["plan_hits"] == 0
+    finally:
+        holder.close()
+    # holder gone: a reconfigure wins the lock and persistence resumes
+    warmstore.reset()
+    assert warmstore.configure() is True
+    assert warmstore.active()
+
+
+def test_winner_holds_the_flock(monkeypatch, tmp_path):
+    """The configured store actually owns the dir: a second flock
+    attempt (another process's configure) must fail while it lives."""
+    import fcntl
+
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    assert warmstore.configure() is True
+    probe = open(os.path.join(str(tmp_path), "lock"), "a+")
+    try:
+        with pytest.raises(OSError):
+            fcntl.flock(probe.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+    finally:
+        probe.close()
+
+
+# ------------------------------------------------------------ size budget
+
+
+def test_budget_prunes_oldest_entries(monkeypatch, tmp_path):
+    """The prune is a file-level policy (oldest npz first, xla/ and the
+    lock excluded) -- drive it with entry-shaped files of known size and
+    age, plus one real freshest plan that must survive."""
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    monkeypatch.setenv("SPGEMM_TPU_WARM_MAX_MB", "1")
+    rng = np.random.default_rng(0)
+    names = [f"plan-{'%02d' % i * 20}.npz" for i in range(5)]
+    for i, name in enumerate(names):  # 5 x 300 KB, oldest first
+        path = os.path.join(str(tmp_path), name)
+        open(path, "wb").write(rng.bytes(300 << 10))
+        os.utime(path, (1_000_000 + i, 1_000_000 + i))
+    p = _host_plan(seed=100)
+    warmstore.save_plan(p)  # the freshest entry: newest mtime
+    assert warmstore.stats()["bytes"] > 1 << 20  # over budget pre-prune
+    warmstore.flush()
+    st = warmstore.stats()
+    assert st["pruned"] >= 1
+    assert st["bytes"] <= 1 << 20
+    survivors = set(os.listdir(tmp_path))
+    assert f"plan-{p.fingerprint}.npz" in survivors  # newest kept
+    assert names[0] not in survivors                 # oldest went first
+    assert "lock" in survivors                       # never pruned
+
+
+# ----------------------------------------------------------- delta entries
+
+
+def test_delta_entry_roundtrip_host_only(monkeypatch, tmp_path):
+    """save_delta/load_delta round-trip both provenance kinds and the
+    result planes, without touching a device (warmstore is jax-free)."""
+    from types import SimpleNamespace
+
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    rng = np.random.default_rng(0)
+    res = SimpleNamespace(
+        rows=16, cols=16, k=4,
+        coords=np.array([[0, 0], [1, 1]], np.int64),
+        hi=rng.integers(0, 1 << 32, (3, 4, 4)).astype(np.uint32),
+        lo=rng.integers(0, 1 << 32, (3, 4, 4)).astype(np.uint32),
+        val_bound=None)
+    digs = np.array([b"x" * 32, b"y" * 32], dtype="S32")
+    entry = delta.DeltaEntry(
+        key="fp|dev[0]x[0]", version=7,
+        a_src=("digest", np.array([0, 1], np.int64), digs),
+        b_src=("tag", "otherkey", 3), result=res, out_rows=2)
+    assert warmstore.save_delta(entry.key, entry)
+    raw = warmstore.load_delta(entry.key)
+    assert raw is not None
+    assert raw["version"] == 7 and raw["out_rows"] == 2
+    kind, rows, got_digs = raw["a_src"]
+    assert kind == "digest"
+    assert np.array_equal(rows, entry.a_src[1])
+    assert np.array_equal(got_digs, digs)
+    assert raw["b_src"] == ("tag", "otherkey", 3)
+    got = raw["result"]
+    assert (got["rows"], got["cols"], got["k"]) == (16, 16, 4)
+    assert got["val_bound"] is None
+    assert np.array_equal(got["hi"], res.hi)
+    assert np.array_equal(got["lo"], res.lo)
+    assert np.array_equal(got["coords"], res.coords)
+    # a different key never aliases (miss, not a foreign entry)
+    assert warmstore.load_delta("fp|dev[1]x[1]") is None
+
+
+def test_seed_entry_fences_the_version_counter():
+    """A rehydrated entry's version must fence the global source: the
+    next handed-out version is strictly greater, so restored lineages
+    can never alias fresh ones."""
+    from types import SimpleNamespace
+
+    entry = delta.DeltaEntry(key="k", version=1000, a_src=("opaque",),
+                             b_src=("opaque",),
+                             result=SimpleNamespace(), out_rows=0)
+    delta.seed_entry(entry)
+    assert delta.lookup("k") is entry
+    assert delta._next_version() > 1000
+
+
+def test_configure_fences_versions_over_all_disk_entries(monkeypatch,
+                                                         tmp_path):
+    """Bind-time version fence (review hardening): a fresh process must
+    never re-issue a version some surviving on-disk tag REFERENCES --
+    even when the referenced producer's own entry was pruned or corrupt
+    -- or a rehydrated consumer would read a fresh producer tag as
+    already-consumed and splice stale rows.  The fence runs at
+    configure(), before any multiply can mint a version."""
+    from types import SimpleNamespace
+
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    res = SimpleNamespace(
+        rows=4, cols=4, k=2, coords=np.zeros((0, 2), np.int64),
+        hi=np.zeros((1, 2, 2), np.uint32),
+        lo=np.zeros((1, 2, 2), np.uint32), val_bound=0)
+    digs = np.zeros(0, dtype="S32")
+    entry = delta.DeltaEntry(
+        key="consumer", version=500,
+        a_src=("tag", "producer", 499),  # references a PRUNED producer
+        b_src=("digest", np.zeros(0, np.int64), digs),
+        result=res, out_rows=0)
+    assert warmstore.configure() is True
+    assert warmstore.save_delta(entry.key, entry)
+    # process death: in-memory state gone (the monotonic counter resets
+    # with the process), disk survives
+    warmstore.reset()
+    delta.clear()
+    monkeypatch.setattr(delta, "_VERSION", 0)
+    assert warmstore.configure() is True  # the fence runs here
+    assert delta._next_version() > 500
+
+
+def test_warm_restart_is_clean_delta_end_to_end(monkeypatch, tmp_path):
+    """The acceptance path in-process: execute, flush, simulate process
+    death (clear every in-memory store), execute again -- the second run
+    must be a delta hit with ZERO recomputed rows (the digests prove the
+    operands unchanged), bit-exact vs the oracle."""
+    from spgemm_tpu.ops.spgemm import spgemm_device
+
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    rng = np.random.default_rng(42)
+    a = random_block_sparse(10, 8, 2, 0.5, rng, "full")
+    b = random_block_sparse(10, 8, 2, 0.5, rng, "full")
+    spgemm_device(a, b).block_until_ready()
+    warmstore.flush()
+    st = warmstore.stats()
+    assert st["plans"] == 1 and st["deltas"] == 1
+    # process death: every in-memory store gone, disk survives
+    plancache.clear()
+    delta.clear()
+    warmstore.reset()
+    got = spgemm_device(a, b).to_host()
+    dst = delta.stats()
+    assert dst["hits"] == 1 and dst["full_fallbacks"] == 0, dst
+    assert dst["rows_recomputed"] == 0 and dst["rows_total"] > 0
+    wst = warmstore.stats()
+    assert wst["plan_hits"] == 1 and wst["delta_hits"] == 1
+    want = spgemm_oracle(a.to_dict(), b.to_dict(), a.k)
+    got_d = got.to_dict()
+    assert set(got_d) == set(want)
+    for key in want:
+        assert np.array_equal(got_d[key], want[key])
+
+
+def test_warm_restart_mutated_input_recomputes_dirty_rows(monkeypatch,
+                                                          tmp_path):
+    """Restart + a VALUE mutation: the rehydrated entry's digests find
+    the dirty row, only its reach re-folds, and the splice against the
+    re-uploaded retained planes is bit-exact."""
+    from spgemm_tpu.ops.spgemm import spgemm_device
+
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "1")
+    rng = np.random.default_rng(43)
+    a = random_block_sparse(12, 8, 2, 0.5, rng, "full")
+    b = random_block_sparse(12, 8, 2, 0.5, rng, "full")
+    spgemm_device(a, b).block_until_ready()
+    warmstore.flush()
+    plancache.clear()
+    delta.clear()
+    warmstore.reset()
+    tiles = a.tiles.copy()
+    tiles[0, 0, 0] += np.uint64(1)  # one tile-row goes dirty
+    a2 = BlockSparseMatrix(rows=a.rows, cols=a.cols, k=a.k,
+                           coords=a.coords, tiles=tiles)
+    got = spgemm_device(a2, b).to_host()
+    dst = delta.stats()
+    assert dst["hits"] == 1 and dst["full_fallbacks"] == 0, dst
+    assert 0 < dst["rows_recomputed"] < dst["rows_total"]
+    want = spgemm_oracle(a2.to_dict(), b.to_dict(), a.k)
+    got_d = got.to_dict()
+    assert set(got_d) == set(want)
+    for key in want:
+        assert np.array_equal(got_d[key], want[key])
+
+
+# ------------------------------------------------- plancache scope stats
+
+
+def test_plancache_stats_scope_deltas():
+    """stats(since=baseline) reports the scope's own hit/miss/eviction
+    deltas -- the per-job detail fix (a second job must not inherit the
+    first's process-lifetime totals)."""
+    a, b = _Structure(16, 3, 1), _Structure(16, 3, 2)
+    plan_spgemm(a, b, backend="xla", platform="cpu")  # job 1: one miss
+    base = plancache.baseline()
+    plan_spgemm(a, b, backend="xla", platform="cpu")  # job 2: one hit
+    scoped = plancache.stats(since=base)
+    assert scoped["hits"] == 1 and scoped["misses"] == 0
+    lifetime = plancache.stats()
+    assert lifetime["misses"] >= 1  # totals still available unscoped
+
+
+# --------------------------------------------------------------- CLI glue
+
+
+def test_cli_warm_stat_and_clear(monkeypatch, tmp_path, capsys):
+    from spgemm_tpu import cli
+
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    _host_plan(seed=21)
+    warmstore.flush()
+    warmstore.reset()  # drop our flock so --clear may take it
+    assert cli.run(["warm", "--stat", "--json"]) == 0
+    import json
+
+    info = json.loads(capsys.readouterr().out)
+    assert info["plans"] == 1 and info["bytes"] > 0
+    assert not info["locked"]
+    assert cli.run(["warm", "--clear"]) == 0
+    assert "cleared 1" in capsys.readouterr().out
+    assert warmstore.scan(str(tmp_path))["plans"] == 0
+
+
+def test_cli_warm_clear_refuses_live_dir(monkeypatch, tmp_path):
+    """--clear against a dir a LIVE process holds (a foreign flock --
+    flock is per open-file-description, so a raw second handle models
+    another process) must refuse and leave the entries intact."""
+    import fcntl
+
+    from spgemm_tpu import cli
+
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    _host_plan(seed=22)
+    warmstore.flush()
+    warmstore.reset()  # our own handle gone; a "daemon" takes the dir
+    holder = open(os.path.join(str(tmp_path), "lock"), "a+")
+    fcntl.flock(holder.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+    try:
+        assert cli.run(["warm", "--clear"]) == 1  # refused, files intact
+        assert warmstore.scan(str(tmp_path))["plans"] == 1
+    finally:
+        holder.close()
