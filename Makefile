@@ -70,7 +70,11 @@ bench:
 # bit-exact vs the oracle, clean shutdown; then a RESTART leg -- a second
 # daemon on the same socket + warm dir re-serves the chain and its first
 # contact must come from the persistent warm store (warm_hits >= 1, zero
-# delta full fallbacks, a clean 0-row delta); exits nonzero on any step.
+# delta full fallbacks, a clean 0-row delta); then a CONCURRENCY leg -- a
+# 2-slice pool daemon (SPGEMM_TPU_SERVE_SLICES=2) takes two same-cost
+# jobs back-to-back, which must OVERLAP (second job's serve_queue_wait
+# well under the first's serve_execute) on two different slices, both
+# bit-exact; exits nonzero on any step.
 serve-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m spgemm_tpu.serve.smoke

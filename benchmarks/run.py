@@ -473,6 +473,42 @@ def config_loader_scaling():
             "speedup_best_vs_1": round(times[1] / best, 2)}
 
 
+def config_pool_scaling():
+    """Device-pool serving throughput (benchmarks/pool_bench.py): a mixed
+    batch (small chains + one large structure) through a 1-slice vs
+    N-slice spgemmd on the 8-vdev CPU config, every result bit-exact vs
+    the oracle in both legs.  The row carries the pool leg's makespan and
+    jobs/minute plus the speedup over the single-executor daemon -- the
+    RESULTS.md view of pool scaling alongside single-job wall.  Runs in
+    subprocesses (pool_bench spawns one cold child per leg), so the
+    suite process's own jax state never warms either side."""
+    child = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "pool_bench.py"),
+         "--small", "3", "--chain", "3", "--small-dim", "6",
+         "--large-dim", "12", "--k", "8"],
+        capture_output=True, text=True, timeout=1800)
+    last = next((ln for ln in reversed(child.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if child.returncode != 0 or last is None:
+        raise RuntimeError(f"pool_bench failed (rc {child.returncode}): "
+                           f"{child.stderr[-500:]}")
+    row = json.loads(last)
+    if "error" in row:
+        raise RuntimeError(f"pool_bench error: {row['error']}")
+    det = row["detail"]
+    return {"config": "pool-scaling", "backend": "spgemmd-pool",
+            "platform": "cpu",
+            "wall_s": det["makespan_pool_s"],
+            "jobs": det["jobs"],
+            "jobs_per_min": det["jobs_per_min_pool"],
+            "jobs_per_min_1slice": det["jobs_per_min_1slice"],
+            "speedup_vs_1slice": det["speedup_vs_1slice"],
+            "slices": det["slices"],
+            "core_limited": det["core_limited"],
+            "host_cores": det["cores"],
+            "value_parity": det["parity"]}
+
+
 CONFIGS = {
     "random-1pct": config_random_1pct,
     "cage12": config_cage12,
@@ -485,6 +521,7 @@ CONFIGS = {
     "webbase-1Mrow": config_webbase_1mrow,
     "ffn": config_ffn,
     "loader-scaling": config_loader_scaling,
+    "pool-scaling": config_pool_scaling,
 }
 
 
@@ -548,16 +585,16 @@ def write_table(rows, path=None):
              "round's `benchmarks/ROUND*_NOTES.md` records the capture "
              "context.",
              "",
-             "| config | backend | platform | wall s | eff. GFLOP/s | plan s (wait) | vs rowshard | parity |",
-             "|---|---|---|---|---|---|---|---|"]
+             "| config | backend | platform | wall s | eff. GFLOP/s | plan s (wait) | jobs/min | vs rowshard | parity |",
+             "|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
         if "error" in r:
             err = r["error"][:60].replace("|", "\\|")
-            lines.append(f"| {r['config']} | — | — | — | — | — | — | ERROR: {err} |")
+            lines.append(f"| {r['config']} | — | — | — | — | — | — | — | ERROR: {err} |")
             continue
         if "skipped" in r:
             note = r["skipped"][:60].replace("|", "\\|")
-            lines.append(f"| {r['config']} | — | — | — | — | — | — | skipped: {note} |")
+            lines.append(f"| {r['config']} | — | — | — | — | — | — | — | skipped: {note} |")
             continue
         par = ""
         if "value_parity" in r:
@@ -591,9 +628,20 @@ def write_table(rows, path=None):
             plan_col = f"{ph['plan']:.4g} ({ph.get('plan_wait', 0.0):.4g})"
             if r.get("plan_cache_hits"):
                 plan_col += f", {r['plan_cache_hits']} cache hit(s)"
+        # pool-scaling throughput column (benchmarks/pool_bench.py): batch
+        # jobs/minute through the sliced daemon + the speedup over the
+        # single-executor A/B -- pool scaling next to single-job wall
+        jobs_col = ""
+        if r.get("jobs_per_min") is not None:
+            jobs_col = f"{r['jobs_per_min']:g}"
+            if r.get("speedup_vs_1slice") is not None:
+                jobs_col += f" ({r['speedup_vs_1slice']:g}x vs 1-slice"
+                if r.get("core_limited"):
+                    jobs_col += f", {r.get('host_cores')}-core host"
+                jobs_col += ")"
         lines.append(f"| {r['config']} | {r['backend']} | {r['platform']} | "
-                     f"{r['wall_s']} | {gf or ''} | {plan_col} | {ratio} | "
-                     f"{par} |")
+                     f"{r['wall_s']} | {gf or ''} | {plan_col} | {jobs_col} "
+                     f"| {ratio} | {par} |")
     sweep = _sweep_section()
     if not sweep:
         # no sweep capture on disk (the evidence dir's sweep.txt is

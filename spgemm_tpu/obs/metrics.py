@@ -82,7 +82,11 @@ ENGINE_COUNTERS: dict[str, str] = {
                 "layer (obs/profile.ProfiledJit) -- per-job attribution "
                 "of the cold-jit tax",
     "serve_reaps": "spgemmd watchdog job reaps (deadline exceeded)",
-    "serve_degrades": "spgemmd degrade transitions to the CPU path",
+    "serve_degrades": "spgemmd degrade transitions to the CPU path "
+                      "(per-slice under the device pool)",
+    "serve_steals": "spgemmd pool work steals: jobs taken by an idle "
+                    "slice outside their preferred slice class (every "
+                    "preferred slice was busy or degraded)",
     "warm_hits": "warm-start store hits: a plan or delta entry a "
                  "previous process persisted was deserialized and "
                  "served (ops/warmstore)",
@@ -176,9 +180,32 @@ _METRICS = (
            "Seconds since the serving daemon started.",
            "serve/daemon.py"),
     Metric("spgemmd_degraded", "gauge",
-           "1 when the daemon is on the CPU failover path (wedged/dead "
-           "executor), else 0.",
+           "1 when the WHOLE pool is on the CPU failover path (every "
+           "slice wedged/dead; with one slice, exactly the pre-pool "
+           "daemon flag), else 0.  Per-slice degrade state is "
+           "spgemm_slice_degraded.",
            "serve/daemon.py"),
+    Metric("spgemm_slice_busy", "gauge",
+           "1 while the slice's executor holds a job, else 0 -- the "
+           "device-pool utilization signal, per slice.",
+           "serve/daemon.py", labels=("slice",)),
+    Metric("spgemm_slice_degraded", "gauge",
+           "1 when this slice wedged/died and runs the CPU failover "
+           "executor (excluded from placement while any healthy slice "
+           "remains), else 0.",
+           "serve/daemon.py", labels=("slice",)),
+    Metric("spgemm_slice_jobs_total", "counter",
+           "Jobs picked up by this slice's executor since daemon start "
+           "(steals included).",
+           "serve/daemon.py", labels=("slice",)),
+    Metric("spgemm_slice_steals_total", "counter",
+           "Jobs this slice STOLE (its class was not the job's preferred "
+           "placement, but every preferred slice was busy/degraded).",
+           "serve/daemon.py", labels=("slice",)),
+    Metric("spgemmd_tenant_queue_depth", "gauge",
+           "Jobs queued per fair-queuing tenant (tenants with no queued "
+           "or in-flight jobs are retired from the series).",
+           "serve/daemon.py", labels=("tenant",)),
     Metric("spgemmd_queue_depth", "gauge",
            "Jobs currently waiting in the admission FIFO.",
            "serve/daemon.py"),

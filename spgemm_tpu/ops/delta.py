@@ -96,6 +96,27 @@ def enabled() -> bool:
     return knobs.get("SPGEMM_TPU_DELTA")
 
 
+def placement_of(key: str) -> str:
+    """The device-placement bracket of a delta-store key, or "(none)".
+
+    THE one parser for the `|dev[...]x[...]` qualifier
+    ops/spgemm._delta_key appends (the builder): every stats surface that
+    splits entries per placement (stats() below, ops/warmstore's
+    persisted view) goes through here, so a format change cannot desync
+    one view while the other is fixed."""
+    bracket = key.split("|dev", 1)
+    return "dev" + bracket[1] if len(bracket) == 2 else "(none)"
+
+
+def placement_histogram(keys) -> dict:
+    """Count keys per placement bracket (see placement_of)."""
+    out: dict[str, int] = {}
+    for key in keys:
+        name = placement_of(key)
+        out[name] = out.get(name, 0) + 1
+    return out
+
+
 def capacity() -> int:
     """SPGEMM_TPU_DELTA_RETAIN (default 16): retained entries (LRU).
     Each entry pins one multiply's previous result (device arrays, via
@@ -295,6 +316,12 @@ def stats() -> dict:
     the cumulative recomputed/total output-row split, and store health."""
     cap = capacity()
     with _LOCK:
+        # per-placement entry split: keys are placement-qualified
+        # (ops/spgemm._delta_key appends `|dev[...]x[...]`), so under the
+        # spgemmd device pool each slice's retained results show as their
+        # own bracket -- the stats view of "each slice keeps its delta
+        # stream" (entries without a bracket are host/test-seeded)
+        placements = placement_histogram(_STORE)
         return {
             "hits": _STATS["hits"],
             "full_fallbacks": _STATS["full_fallbacks"],
@@ -303,6 +330,7 @@ def stats() -> dict:
             "rows_recomputed": _STATS["rows_recomputed"],
             "rows_total": _STATS["rows_total"],
             "entries": len(_STORE),
+            "placements": placements,
             "capacity": cap,
             "enabled": enabled(),
         }

@@ -107,6 +107,39 @@ def clear() -> None:
         _STATS["hits"] = _STATS["fallbacks"] = 0
 
 
+def pair_mass(a_coords: np.ndarray, b_coords: np.ndarray) -> float:
+    """Predicted tile-pair count (MAC mass / k^3) of one A x B multiply:
+    the sampled estimate where the structure is big enough to sample, the
+    EXACT searchsorted pair count otherwise (small structures join in
+    microseconds -- exact is free).  The device-pool scheduler prices a
+    job with this before routing it (serve/placement): pricing steers
+    placement only, never fold order, so it stays correct -- and cheap --
+    to call on structures the plan estimator would skip."""
+    est = maybe_estimate(a_coords, b_coords)
+    if est is not None:
+        return float(est.est_pairs)
+    if len(a_coords) == 0 or len(b_coords) == 0:
+        return 0.0
+    b_rows = b_coords[:, 0]
+    lo = np.searchsorted(b_rows, a_coords[:, 1], side="left")
+    hi = np.searchsorted(b_rows, a_coords[:, 1], side="right")
+    cnt = hi - lo
+    # spgemm-lint: fld-proof(integer pair-count total for placement pricing only; exact int64 addition is order-free, no wrap-then-mod values involved)
+    return float(cnt.sum())
+
+
+def chain_mass(coords_list: list[np.ndarray]) -> float:
+    """Predicted tile-pair mass of one chain job's FIRST reduction pass
+    (helper2 pairing: (0,1), (2,3), ...; the odd trailing operand carries
+    for free).  The first pass is where a chain's MAC mass concentrates --
+    later passes fold at most half as many operands -- so this is the
+    scheduler's per-job price signal, not a wall-time model."""
+    total = 0.0
+    for i in range(0, len(coords_list) - 1, 2):
+        total += pair_mass(coords_list[i], coords_list[i + 1])
+    return total
+
+
 @dataclass
 class StructureEstimate:
     """Scaled prediction of one A x B output structure from a row sample.

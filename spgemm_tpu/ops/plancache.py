@@ -19,6 +19,13 @@ jax-free by design: this module is imported by the CLI `knobs` listing and
 by planner WORKER threads (chain.py plan-ahead), neither of which may
 touch a backend (the BKD contract -- plans are pure numpy).
 
+Pool sharing: plans are host-side index arrays with no device placement,
+so one cache serves every slice executor of the spgemmd device pool
+concurrently (the lock below is the whole synchronization story) -- a
+structure planned on one slice is a hit on every other, which is exactly
+the amortization the pool wants.  Placement-dependent state lives in
+ops/delta, whose keys are placement-qualified (ops/spgemm._delta_key).
+
 Knobs (central registry, utils/knobs.py):
   SPGEMM_TPU_PLAN_CACHE     0|1 (default 1) -- memoization on/off.
   SPGEMM_TPU_PLAN_CACHE_CAP int >= 1 (default 32) -- LRU capacity; plans

@@ -615,8 +615,17 @@ def configure_compilation_cache() -> bool:
 def stats() -> dict:
     """Live store state for `spgemm_tpu.cli warm --stat`, `cli knobs`,
     spgemmd stats, and the Prometheus scrape."""
+    from spgemm_tpu.ops import delta  # noqa: PLC0415 -- shared bracket parser only
     with _LOCK:
         plans, deltas, size = _scan_locked()
+        # DISTINCT delta keys this process persisted, split by the
+        # device-placement bracket ops/spgemm._delta_key appends (parsed
+        # by the one shared helper, delta.placement_histogram): under
+        # the spgemmd device pool each slice's retained results persist
+        # independently, and this is the per-slice view of that (derived
+        # from the saved-key memo, so a re-flush of the same key never
+        # inflates it; best-effort -- budget pruning is not subtracted)
+        placements = delta.placement_histogram(_SAVED_DELTA)
         return {
             "dir": _DIR,
             "enabled": enabled(),
@@ -626,6 +635,7 @@ def stats() -> dict:
             "deltas": deltas,
             "bytes": size,
             "budget_bytes": budget_bytes(),
+            "delta_placements": placements,
             **dict(_STATS),
         }
 

@@ -1,13 +1,172 @@
-"""Mesh construction helpers."""
+"""Mesh construction helpers + the device-pool slice table.
+
+The serving daemon's scaling story (ARCHITECTURE.md "L4 serving") is the
+inverse of the reference's: one resident process owns ALL visible devices,
+and `slice_pool` carves them into named slices -- one executor per slice,
+so an 8-chip host serves eight cheap jobs concurrently instead of
+serializing them behind one device owner while seven chips idle.
+
+Slice-spec grammar (`SPGEMM_TPU_SERVE_SLICES`):
+
+  spec     := "auto" | term ("+" term)*
+  term     := [COUNT "x"] WIDTH ["*"]
+
+`COUNTxWIDTH` is COUNT slices of WIDTH devices each; a bare `COUNT` is
+COUNT single-device slices; a trailing `*` marks the term's slices as the
+DEFAULT placement (first-contact jobs with no estimate land there).
+Examples on 8 devices: `1x4+4` = one 4-device slice (devices 0-3) plus
+four single-device slices (devices 4-7); `8` = eight singles; `1` = one
+single-device slice -- the exact pre-pool single-executor daemon.
+`auto` = one single-device slice per visible device plus one full-mesh
+slice (the full-mesh slice OVERLAPS the singles; the daemon's placement
+treats any two slices sharing a device as mutually exclusive at
+dispatch).  Without a `*`, the narrowest slice class is the default.
+
+Spec parsing is jax-free on purpose (the daemon parses at startup and
+tests parse with an injected device count); only `slice_devices` /
+`slice_mesh` touch the backend, resolving positions into live devices.
+"""
 
 from __future__ import annotations
 
-import jax
-from jax.sharding import Mesh
+import re
+from dataclasses import dataclass
 
 
-def default_mesh(n_devices: int | None = None, axis: str = "keys") -> Mesh:
+class SliceSpecError(ValueError):
+    """An unparsable/overcommitted slice spec; names the spec."""
+
+
+@dataclass(frozen=True)
+class DeviceSlice:
+    """One named slice of the visible device list.
+
+    device_ids are POSITIONS into jax.devices() (not platform ids), so
+    the table is buildable -- and testable -- without a backend.
+    """
+
+    name: str
+    index: int
+    device_ids: tuple[int, ...]
+    default: bool = False
+
+    @property
+    def width(self) -> int:
+        return len(self.device_ids)
+
+    def overlaps(self, other: "DeviceSlice") -> bool:
+        return bool(set(self.device_ids) & set(other.device_ids))
+
+
+_TERM_RE = re.compile(r"^(?:(\d+)x)?(\d+)(\*)?$")
+
+
+def parse_slice_spec(spec: str,
+                     n_devices: int | None = None) -> list[tuple[int, bool]]:
+    """Parse a slice spec into [(width, is_default), ...] in declaration
+    order.  `auto` needs n_devices; explicit specs are validated against
+    n_devices only when it is known (the daemon may trust an explicit
+    spec before the backend is safe to count)."""
+    spec = (spec or "").strip()
+    if not spec:
+        raise SliceSpecError("empty slice spec (SPGEMM_TPU_SERVE_SLICES)")
+    if spec == "auto":
+        if n_devices is None:
+            raise SliceSpecError(
+                "slice spec 'auto' needs the visible device count")
+        out = [(1, True)] * n_devices
+        if n_devices > 1:
+            out.append((n_devices, False))
+        return out
+    widths: list[tuple[int, bool]] = []
+    for term in spec.split("+"):
+        m = _TERM_RE.match(term.strip())
+        if m is None:
+            raise SliceSpecError(
+                f"bad slice-spec term {term.strip()!r} in "
+                f"SPGEMM_TPU_SERVE_SLICES={spec!r} (grammar: [COUNTx]WIDTH"
+                f"[*] terms joined by '+', or 'auto')")
+        count_s, width_s, star = m.groups()
+        if count_s is None:
+            # bare N = N single-device slices (the `1x4+4` idiom)
+            count, width = int(width_s), 1
+        else:
+            count, width = int(count_s), int(width_s)
+        if count < 1 or width < 1:
+            raise SliceSpecError(
+                f"slice-spec term {term.strip()!r} must have count and "
+                f"width >= 1 (SPGEMM_TPU_SERVE_SLICES={spec!r})")
+        widths += [(width, star is not None)] * count
+    total = sum(w for w, _ in widths)
+    if n_devices is not None and total > n_devices:
+        raise SliceSpecError(
+            f"slice spec {spec!r} needs {total} devices but only "
+            f"{n_devices} are visible")
+    return widths
+
+
+def slice_pool(spec: str | None = None,
+               n_devices: int | None = None) -> list[DeviceSlice]:
+    """The slice table for a spec (default: the SPGEMM_TPU_SERVE_SLICES
+    knob).  Devices are assigned to terms in declaration order; `auto`
+    builds per-device singles plus one overlapping full-mesh slice.
+    Exactly one slice class is default (see module doc): the spec's `*`
+    term, else the narrowest width present."""
+    from spgemm_tpu.utils import knobs  # noqa: PLC0415
+
+    if spec is None:
+        spec = knobs.get("SPGEMM_TPU_SERVE_SLICES")
+    spec = (spec or "").strip()
+    if spec == "auto":
+        if n_devices is None:
+            raise SliceSpecError(
+                "slice spec 'auto' needs the visible device count")
+        slices = [DeviceSlice(f"s{i}w1", i, (i,), default=True)
+                  for i in range(n_devices)]
+        if n_devices > 1:
+            slices.append(DeviceSlice(f"s{n_devices}w{n_devices}",
+                                      n_devices, tuple(range(n_devices))))
+        return slices
+    widths = parse_slice_spec(spec, n_devices)
+    any_default = any(d for _, d in widths)
+    min_width = min(w for w, _ in widths)
+    slices: list[DeviceSlice] = []
+    pos = 0
+    for i, (width, is_default) in enumerate(widths):
+        ids = tuple(range(pos, pos + width))
+        pos += width
+        default = is_default if any_default else width == min_width
+        slices.append(DeviceSlice(f"s{i}w{width}", i, ids, default=default))
+    return slices
+
+
+def slice_devices(sl: DeviceSlice) -> list:
+    """The live jax devices of a slice (positions -> devices; raises if
+    the spec overcommits the actually-visible device list)."""
+    import jax  # noqa: PLC0415
+
+    devs = jax.devices()
+    if sl.device_ids and max(sl.device_ids) >= len(devs):
+        raise SliceSpecError(
+            f"slice {sl.name} needs device position {max(sl.device_ids)} "
+            f"but only {len(devs)} devices are visible")
+    return [devs[i] for i in sl.device_ids]
+
+
+def slice_mesh(sl: DeviceSlice, axis: str = "keys"):
+    """A 1-D named mesh over a slice's devices: slice width stays
+    transparent to mesh-consuming engine layers (parallel/ring,
+    parallel/rowshard take a mesh, not a device count)."""
+    import jax  # noqa: PLC0415
+
+    devs = slice_devices(sl)
+    return jax.make_mesh((len(devs),), (axis,), devices=devs)
+
+
+def default_mesh(n_devices: int | None = None, axis: str = "keys"):
     """1-D mesh over the first n visible devices (all by default)."""
+    import jax  # noqa: PLC0415
+
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]

@@ -5,17 +5,24 @@ compute the chain, write `matrix`, exit) and the CLI mirrors that shape --
 every invocation pays cold JAX import, cold jit, a cold crossover gate and
 a cold plan cache (~145x over a warm plan-cache hit at 20k keys).  The
 serving layer turns those per-job costs into per-fleet costs, the JITSPMM
-argument applied at process scope: one long-lived single-device-owner
+argument applied at process scope: one long-lived device-pool-owner
 process executes every job, so compiled executables, the structure-keyed
 plan cache (ops/plancache) and the crossover measurement cache persist
-across jobs.
+across jobs -- and the pool scheduler (SPGEMM_TPU_SERVE_SLICES) keeps
+every chip busy: one executor per device slice, estimator-priced
+placement, per-tenant fair queuing, work stealing.
 
 Modules:
-  protocol.py -- versioned newline-delimited JSON over a unix socket.
-  queue.py    -- bounded FIFO with admission control + per-job deadlines.
-  daemon.py   -- executor thread, watchdog (backend_probe-based wedge
-                 detection, degrade-to-CPU), on-disk job journal.
-  client.py   -- client library + the CLI `serve`/`submit`/`status`
-                 subcommand handlers.
-  smoke.py    -- `make serve-smoke`: end-to-end daemon proof on CPU.
+  protocol.py  -- versioned newline-delimited JSON over a unix socket
+                  (v2: optional submit `tenant`).
+  queue.py     -- bounded per-tenant fair queue with admission control,
+                  per-tenant in-flight caps + per-job deadlines.
+  placement.py -- estimator-priced job routing (price book keyed by the
+                  input folder's stat signature).
+  daemon.py    -- per-slice executors, placement scheduler, watchdog
+                  (backend_probe-based wedge detection, per-slice
+                  degrade-to-CPU), on-disk job journal.
+  client.py    -- client library + the CLI `serve`/`submit`/`status`
+                  subcommand handlers.
+  smoke.py     -- `make serve-smoke`: end-to-end daemon proof on CPU.
 """

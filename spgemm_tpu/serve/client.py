@@ -22,6 +22,15 @@ from spgemm_tpu.serve import protocol
 # slice boundary instead of holding it until the job terminates
 WAIT_SLICE_S = 15.0
 
+# client-side backoff between wait slices: a job still running after a
+# full server-side slice is a LONG job, so hundreds of idle waiters must
+# not hammer the accept loop with immediate reconnects -- each expired
+# slice doubles the pre-reconnect sleep from WAIT_BACKOFF_S up to
+# WAIT_BACKOFF_MAX_S (the added completion-detection latency is bounded
+# by the cap)
+WAIT_BACKOFF_S = 0.05
+WAIT_BACKOFF_MAX_S = 2.0
+
 
 class ServeError(Exception):
     """A structured daemon-side error response; carries the wire code."""
@@ -35,13 +44,18 @@ class ServeError(Exception):
 def request(msg: dict, socket_path: str | None = None,
             timeout: float | None = None) -> dict:
     """One request, one response.  Raises ConnectionError flavors when no
-    daemon is listening; raises ServeError on an error response."""
+    daemon is listening; raises ServeError on an error response.
+
+    Requests advertise the LOWEST protocol version that carries their
+    features (v1 unless the caller stamped a higher `v` -- submit does,
+    when a tenant rides along): a v2 daemon accepts v1 requests, so the
+    upgraded client keeps working against a still-v1 daemon during a
+    rolling upgrade instead of tripping its strict version check."""
     path = socket_path or protocol.default_socket_path()
     with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
         sock.settimeout(timeout)
         sock.connect(path)
-        sock.sendall(protocol.encode(
-            {"v": protocol.PROTOCOL_VERSION, **msg}))
+        sock.sendall(protocol.encode({"v": 1, **msg}))
         for line in protocol.read_lines(sock):
             resp = json.loads(line)
             if not resp.get("ok"):
@@ -54,7 +68,8 @@ def request(msg: dict, socket_path: str | None = None,
 
 
 def submit(folder: str, socket_path: str | None = None,
-           options: dict | None = None, timeout: float | None = None) -> dict:
+           options: dict | None = None, timeout: float | None = None,
+           tenant: str | None = None) -> dict:
     # paths resolve CLIENT-side: the daemon's cwd is not the submitter's,
     # so a relative folder/output/checkpoint_dir sent verbatim would be
     # checked (and written!) against the wrong tree -- and journal replay
@@ -63,9 +78,15 @@ def submit(folder: str, socket_path: str | None = None,
     for key in ("output", "checkpoint_dir"):
         if options.get(key):
             options[key] = os.path.abspath(options[key])
-    return request({"op": "submit", "folder": os.path.abspath(folder),
-                    "options": options},
-                   socket_path, timeout=timeout)
+    msg = {"op": "submit", "folder": os.path.abspath(folder),
+           "options": options}
+    if tenant is not None:
+        # the optional fair-queuing identity needs protocol v2; without
+        # it the request stays fully v1-shaped (version stamp included),
+        # so legacy daemons keep serving upgraded clients
+        msg["tenant"] = tenant
+        msg["v"] = protocol.PROTOCOL_VERSION
+    return request(msg, socket_path, timeout=timeout)
 
 
 def status(job_id: str, socket_path: str | None = None) -> dict:
@@ -75,8 +96,13 @@ def status(job_id: str, socket_path: str | None = None) -> dict:
 def wait(job_id: str, socket_path: str | None = None,
          timeout: float | None = None) -> dict:
     """Block until the job is terminal or timeout elapses (None = until
-    terminal), polling in WAIT_SLICE_S server-side waits."""
+    terminal), polling in WAIT_SLICE_S server-side waits with exponential
+    client-side backoff between them (WAIT_BACKOFF_S doubling to
+    WAIT_BACKOFF_MAX_S): a fleet of idle waiters on long jobs costs the
+    accept loop one reconnect per waiter per ~cap seconds, not a
+    reconnect storm per slice."""
     deadline = None if timeout is None else time.time() + timeout
+    backoff = 0.0
     while True:
         slice_s = WAIT_SLICE_S if deadline is None else \
             min(WAIT_SLICE_S, max(0.0, deadline - time.time()))
@@ -87,6 +113,14 @@ def wait(job_id: str, socket_path: str | None = None,
             return resp
         if deadline is not None and time.time() >= deadline:
             return resp  # caller sees the non-terminal snapshot
+        # still running after a whole server-side slice: back off before
+        # reconnecting (never past the caller's deadline)
+        backoff = min(WAIT_BACKOFF_MAX_S,
+                      backoff * 2 if backoff else WAIT_BACKOFF_S)
+        sleep_s = backoff if deadline is None else \
+            min(backoff, max(0.0, deadline - time.time()))
+        if sleep_s > 0:
+            time.sleep(sleep_s)
 
 
 def stats(socket_path: str | None = None) -> dict:
@@ -140,6 +174,11 @@ def main_submit(argv: list[str] | None = None) -> int:
     p.add_argument("--timeout", type=float, default=None, metavar="S",
                    help="per-job deadline override (default: "
                         "SPGEMM_TPU_SERVE_JOB_TIMEOUT)")
+    p.add_argument("--tenant", default=None, metavar="NAME",
+                   help="fair-queuing tenant identity (optional; the "
+                        "daemon round-robins across tenants and may cap "
+                        "per-tenant in-flight jobs, "
+                        "SPGEMM_TPU_SERVE_TENANT_INFLIGHT)")
     p.add_argument("--failover", action="store_true",
                    help="run the job with chain failover enabled")
     p.add_argument("--wait", action="store_true",
@@ -153,7 +192,8 @@ def main_submit(argv: list[str] | None = None) -> int:
         ("timeout_s", args.timeout),
         ("failover", args.failover or None)) if v is not None}
     try:
-        resp = submit(args.folder, args.socket, options)
+        resp = submit(args.folder, args.socket, options,
+                      tenant=args.tenant)
         if args.wait:
             resp = wait(resp["id"], args.socket)
     except (ServeError, OSError) as e:

@@ -1,30 +1,53 @@
-"""spgemmd: the resident single-device-owner daemon.
+"""spgemmd: the resident device-pool-owner daemon.
 
-One long-lived process owns the device and executes every submitted chain
-job on ONE executor thread, so everything expensive stays warm across
-jobs: the jit executable cache (XLA compiles once per shape class), the
-structure-keyed plan cache (ops/plancache -- a repeated input skips the
-symbolic planner entirely), and the crossover measurement cache
-(ops/crossover).  The run-once CLI pays all of those per invocation.
+One long-lived process owns the visible devices and executes submitted
+chain jobs on a POOL of executor threads -- one per device slice
+(parallel/mesh.slice_pool, SPGEMM_TPU_SERVE_SLICES; the default `1` is a
+single single-device executor, exactly the pre-pool daemon) -- so
+everything expensive stays warm across jobs: the jit executable cache
+(XLA compiles once per shape class), the structure-keyed plan cache
+(ops/plancache -- a repeated input skips the symbolic planner entirely),
+and the crossover measurement cache (ops/crossover).  The run-once CLI
+pays all of those per invocation.
+
+Device-pool scheduling (the estimator-priced placement half):
+
+  * Every admitted job is priced at admission (serve/placement.route):
+    a re-submitted folder routes on the estimator's recorded pair mass
+    (cheap jobs -> the narrowest slice class, webbase-class -> the
+    widest), a first-contact job takes the spec's default slice, and an
+    idle slice STEALS the head job when every preferred slice is busy or
+    degraded -- all chips stay busy while big jobs keep the wide slice.
+  * Single-device slices run the resident engine committed to their
+    device; multi-device slices run the bit-exact output-space-sharded
+    multiply (parallel/rowshard over the slice's mesh), so slice width
+    never changes bits -- only wall.
+  * Per-tenant fair queuing (serve/queue.py): submits may carry a
+    `tenant` (protocol v2, optional -- v1 clients map to the default
+    tenant), dispatch serves tenants deficit-round-robin, and
+    SPGEMM_TPU_SERVE_TENANT_INFLIGHT caps one tenant's in-flight jobs
+    with a structured tenant-cap error, never a hang.
 
 Reliability model (the part the reference cannot have):
 
   * The observed accelerator failure mode is a HANG, never an exception
     (utils/backend_probe) -- so a wedged executor thread cannot be joined,
-    interrupted, or trusted again.  The watchdog detects it (a running job
-    past its deadline whose executor has not moved on within the
-    SPGEMM_TPU_SERVE_WEDGE_GRACE_S window -- sized to exceed one whole
-    multiply, since the heartbeat fires per COMPLETED multiply), reaps the
-    job with a structured error, ABANDONS the wedged thread (daemon flag
-    keeps it from pinning exit), probes the backend from a subprocess (the
-    only safe touch), and spawns a replacement executor pinned to the CPU
-    failover path (chain.oracle_multiply needs no backend at all).  The
-    daemon then reports `degraded` in stats but keeps serving.  A reaped
-    job whose executor is merely SLOW aborts its chain at the next multiply
-    boundary (JobAbandoned rides the heartbeat) -- the executor moves on
-    without computing a failed job to completion, and a wedged thread that
-    unwedges hours later aborts the same way instead of recording the rest
-    of its phases into the replacement executor's ENGINE registry.
+    interrupted, or trusted again.  The watchdog detects it PER SLICE (a
+    running job past its deadline whose slice executor has not moved on
+    within the SPGEMM_TPU_SERVE_WEDGE_GRACE_S window -- sized to exceed
+    one whole multiply, since the heartbeat fires per COMPLETED multiply),
+    reaps the job with a structured error, ABANDONS the wedged thread
+    (daemon flag keeps it from pinning exit), probes the backend from a
+    subprocess (the only safe touch), and spawns a replacement executor
+    for THAT slice pinned to the CPU failover path (chain.oracle_multiply
+    needs no backend at all).  The degraded slice is excluded from
+    placement while any healthy slice remains -- the pool keeps serving
+    on the rest -- and serves host-only when the whole pool is down
+    (`stats` reports per-slice degrade state; the daemon-level `degraded`
+    flag means every slice is down, which with one slice is exactly the
+    old behavior).  A reaped job whose executor is merely SLOW aborts its
+    chain at the next multiply boundary (JobAbandoned rides the
+    heartbeat).
   * A submit beyond SPGEMM_TPU_SERVE_QUEUE_CAP is rejected with a
     structured queue-full error (serve/queue.py), never queued unbounded.
   * Every admitted job is journaled next to the socket
@@ -40,11 +63,15 @@ Reliability model (the part the reference cannot have):
     delta recompute + cached executables instead of minutes of cold
     planning and jit.  Corrupt/skewed entries and a warm dir locked by
     another live daemon are counted cold fallbacks, never failures.
+    Delta retention is placement-qualified (ops/spgemm._delta_key), so
+    each slice's retained results stay on that slice's devices.
 
 Per-job observability: each job runs under an ENGINE PhaseScope
-(utils/timers), so its status detail carries exactly its own phases_s and
-counters (plan/plan_wait/dispatch/assembly, plan_cache_hits/misses...) --
-the same fields bench.py emits, and job 2 never inherits job 1's totals.
+(utils/timers) on its slice's executor thread, and every span it emits
+carries the slice name tag -- its status detail carries exactly its own
+phases_s and counters plus the slice/steal placement record, and job 2
+never inherits job 1's totals even when they ran concurrently on two
+slices.
 """
 
 from __future__ import annotations
@@ -63,9 +90,10 @@ from spgemm_tpu.obs import metrics as obs_metrics
 from spgemm_tpu.obs import profile as obs_profile
 from spgemm_tpu.obs import trace as obs_trace
 from spgemm_tpu.ops import warmstore
-from spgemm_tpu.serve import protocol
+from spgemm_tpu.parallel import mesh as mesh_mod
+from spgemm_tpu.serve import placement, protocol
 from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
-                                    QueueFull)
+                                    QueueFull, TenantCapExceeded)
 from spgemm_tpu.utils import knobs
 
 log = logging.getLogger("spgemm_tpu.serve")
@@ -80,6 +108,13 @@ def run_chain_job(job: Job, degraded: bool = False) -> None:
     """Default executor runner: read the job's folder, reduce the chain,
     write the output file (reference text format).
 
+    Placement: job.device_ids (set by the pool executor at pickup; None =
+    the default device, the single-slice legacy path) selects where the
+    chain runs -- one committed device for a single-device slice, the
+    bit-exact output-space-sharded multiply (parallel/rowshard) over the
+    slice's mesh for a wider one.  Either way the bits match the
+    single-device engine: placement steers wall, never fold order.
+
     degraded=True forces the host-only oracle multiply -- the CPU failover
     path, which needs no accelerator and no XLA backend (a daemon whose
     device wedged must still serve).  Imports stay inside the function:
@@ -90,6 +125,16 @@ def run_chain_job(job: Job, degraded: bool = False) -> None:
 
     n, k = io_text.read_size(job.folder)
     mats = io_text.read_chain(job.folder, 0, n - 1, k)
+    # price the structure for the placement scheduler while the coords
+    # are in hand (one sampled mini-join, ops/estimate.chain_mass): the
+    # NEXT submit of this folder routes on a real estimate instead of
+    # the default slice.  Best-effort -- pricing must never fail a job.
+    try:
+        from spgemm_tpu.ops import estimate  # noqa: PLC0415
+        placement.note_mass(job.folder,
+                            estimate.chain_mass([m.coords for m in mats]))
+    except Exception as e:  # noqa: BLE001 -- pricing is routing-only, never correctness
+        log.warning("placement pricing failed for %s: %r", job.folder, e)
     kwargs: dict = {}
     if not degraded:
         if job.options.get("backend") is not None:
@@ -110,6 +155,39 @@ def run_chain_job(job: Job, degraded: bool = False) -> None:
             raise JobAbandoned(job.id)
 
     multiply = chain.oracle_multiply if degraded else None
+    device_ids = None if degraded else job.device_ids
+    if device_ids and len(device_ids) > 1:
+        # multi-device slice: bit-exact key-space sharding over the
+        # slice's mesh (rowshard) -- each output tile folds whole on one
+        # device, so the non-associative accumulation order is untouched
+        # and the result matches the single-device engine exactly.
+        # backend/round_size ride through; the sharded multiply ignores
+        # kernel-backend selection (its numeric round IS the exact one).
+        from spgemm_tpu.parallel.rowshard import spgemm_sharded  # noqa: PLC0415
+
+        slice_mesh = mesh_mod.slice_mesh(
+            mesh_mod.DeviceSlice(job.slice or "slice", 0,
+                                 tuple(device_ids)))
+        # kernel-backend selection does not apply to the sharded multiply
+        # (its numeric round IS the exact one); failover is a
+        # chain_product-level feature and stays -- a device lost mid-chain
+        # still restarts the pass on the host oracle when requested
+        kwargs.pop("backend", None)
+
+        def multiply(a, b, **kw):  # noqa: ARG001 -- chain passes plan kwargs
+            kw.pop("plan", None)
+            return spgemm_sharded(a, b, mesh=slice_mesh, **kw)
+    elif device_ids and not degraded:
+        # single-device slice: commit the inputs to the slice's device --
+        # jit follows committed placement, so the whole chain (and its
+        # delta-retained results, placement-qualified by _delta_key)
+        # lives on this slice's device
+        from spgemm_tpu.ops.device import DeviceBlockMatrix  # noqa: PLC0415
+
+        dev = mesh_mod.slice_devices(
+            mesh_mod.DeviceSlice(job.slice or "slice", 0,
+                                 tuple(device_ids)))[0]
+        mats = [DeviceBlockMatrix.from_host(m, device=dev) for m in mats]
     result = chain.chain_product(
         mats, multiply=multiply,
         checkpoint_dir=job.options.get("checkpoint_dir"),
@@ -122,14 +200,56 @@ def run_chain_job(job: Job, degraded: bool = False) -> None:
     io_text.write_matrix(job.output, result.prune_zeros())
 
 
+class _Slice:
+    """One pool slice's serving state: the mesh slice plus its executor
+    thread, reap window and degrade flag.
+
+    thread/gen/current/reaped/reaped_at are single-writer handoff slots
+    (watchdog writes, executor compares), lock-free by design -- the
+    ordering argument lives on their access sites, so they stay
+    deliberately un-annotated (the pre-pool daemon's _executor/_current
+    discipline, one copy per slice).  degraded/degrade_reason/jobs_total/
+    steals are daemon-lock-guarded like the old daemon-level flags."""
+
+    def __init__(self, spec: "mesh_mod.DeviceSlice"):
+        self.spec = spec
+        self.name = spec.name
+        self.device_ids = spec.device_ids
+        self.default = spec.default
+        # written only under the OWNING Daemon's _lock (THR checks the
+        # daemon's own spelled self.* accesses; these ride the same
+        # critical sections).  The accept predicate's lock-free reads of
+        # degraded are deliberate: dispatch tolerates a stale value for
+        # one pop -- a just-degraded slice at worst steals one more job
+        # onto its replacement CPU executor, never corrupts state.
+        self.degraded = False
+        self.degrade_reason: str | None = None
+        self.jobs_total = 0
+        self.steals = 0
+        self.thread: threading.Thread | None = None
+        self.gen = 0
+        self.current: Job | None = None   # job the slice's live executor holds
+        self.reaped: Job | None = None    # reaped job awaiting wedge grace
+        self.reaped_at = 0.0
+
+    @property
+    def width(self) -> int:
+        return len(self.device_ids)
+
+
 class Daemon:
-    """The spgemmd server: accept loop + executor + watchdog + journal.
+    """The spgemmd server: accept loop + executor pool + watchdog +
+    journal.
 
     runner/probe are injectable for tests: runner(job, degraded=...) does
-    the actual work (default run_chain_job), probe() is the backend
-    liveness check used when degrading (default
-    utils/backend_probe.probe_default_backend -- subprocess + timeout,
-    because a dead TPU hangs in-process).
+    the actual work (default run_chain_job; the pool passes placement via
+    job.device_ids), probe() is the backend liveness check used when
+    degrading (default utils/backend_probe.probe_default_backend --
+    subprocess + timeout, because a dead TPU hangs in-process).
+    slices/n_devices: the slice spec (default the SPGEMM_TPU_SERVE_SLICES
+    knob) and the visible device count for validating it -- tests inject
+    n_devices so multi-slice pools build without a backend; the real CLI
+    counts devices after its startup probe.
     """
 
     # one compaction per this many terminal journal events: the journal
@@ -168,7 +288,9 @@ class Daemon:
                  probe=None, queue_cap: int | None = None,
                  job_timeout_s: float | None = None,
                  wedge_grace_s: float | None = None, journal: bool = True,
-                 persist_compile_cache: bool = False):
+                 persist_compile_cache: bool = False,
+                 slices: str | None = None, n_devices: int | None = None,
+                 tenant_inflight: int | None = None):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.journal_path = self.socket_path + ".journal"
         # postmortem flight dumps (watchdog reap / wedge / degrade) land
@@ -191,7 +313,7 @@ class Daemon:
         # the slow-vs-wedged window must cover one whole multiply: the
         # heartbeat fires per COMPLETED multiply, so a shorter grace would
         # declare a healthy executor wedged mid-multiply and permanently
-        # degrade the daemon to the CPU oracle path
+        # degrade the slice to the CPU oracle path
         self._wedge_grace_s = wedge_grace_s if wedge_grace_s is not None \
             else knobs.get("SPGEMM_TPU_SERVE_WEDGE_GRACE_S")
         self._journal_enabled = journal
@@ -215,10 +337,22 @@ class Daemon:
         # oldest-first even on filesystems whose mtime granularity ties a
         # reap burst (mtime orders only pre-restart leftovers)
         self._flight_order: list[str] = []  # spgemm-lint: guarded-by(_lock)
-        self.queue = JobQueue(self._cap)
-        # degrade state: written by the watchdog, read by the executor and
-        # every stats request -- the machine-checked half of the old
-        # "# ids, journal file, degrade state" comment on _lock
+        self.queue = JobQueue(self._cap, tenant_inflight=tenant_inflight)
+        # the slice pool: built at construction (jax-free -- positions,
+        # not live devices) so an unstarted daemon still answers stats.
+        # The spec comes from the knob unless injected; n_devices
+        # validates it when known (the CLI passes the post-probe count,
+        # tests inject, 'auto' requires it).
+        self._slice_spec = slices if slices is not None \
+            else knobs.get("SPGEMM_TPU_SERVE_SLICES")
+        self._n_devices = n_devices
+        self.slices: list[_Slice] = [
+            _Slice(s) for s in mesh_mod.slice_pool(self._slice_spec,
+                                                   n_devices)]
+        # daemon-level degrade state: True only when EVERY slice is on
+        # the CPU failover path (with one slice this is exactly the old
+        # single-executor flag).  Written by the watchdog/degrade path,
+        # read by the executors and every stats request.
         self.degraded = False                    # spgemm-lint: guarded-by(_lock)
         self.degrade_reason: str | None = None   # spgemm-lint: guarded-by(_lock)
         self._probe_outcome: str | None = None   # spgemm-lint: guarded-by(_lock)
@@ -227,15 +361,6 @@ class Daemon:
         self._stop = threading.Event()
         self._lock = threading.Lock()  # ids, journal file, degrade state
         self._listener: socket.socket | None = None
-        # _executor/_executor_gen/_current/_reaped are single-writer
-        # handoff slots (watchdog writes, executor compares), lock-free by
-        # design -- the ordering argument lives on their access sites, so
-        # they stay deliberately un-annotated
-        self._executor: threading.Thread | None = None
-        self._executor_gen = 0
-        self._current: Job | None = None  # job the live executor holds
-        self._reaped: Job | None = None   # reaped job awaiting wedge grace
-        self._reaped_at = 0.0
         self._conn_count = 0               # spgemm-lint: guarded-by(_lock)
         self._threads: list[threading.Thread] = []
 
@@ -297,20 +422,26 @@ class Daemon:
             try:
                 job = Job(ev["id"], ev["folder"], ev["output"],
                           ev.get("options", {}),
-                          timeout_s=ev.get("timeout_s", 0.0))
+                          timeout_s=ev.get("timeout_s", 0.0),
+                          tenant=ev.get("tenant", protocol.DEFAULT_TENANT))
             except (KeyError, TypeError) as e:
                 log.warning("journal: skipping malformed record %r (%r)",
                             ev, e)
                 continue
+            # re-price at replay: the folder may have changed (or gone)
+            # since the original admission routed it
+            job.placement = placement.route(job.folder)
             try:
                 self.queue.submit(job)
                 log.info("journal: re-queued unfinished job %s (%s)",
                          job.id, job.folder)
-            except QueueFull:
+            except (QueueFull, TenantCapExceeded) as e:
+                code = protocol.E_TENANT_CAP \
+                    if isinstance(e, TenantCapExceeded) \
+                    else protocol.E_QUEUE_FULL
                 if job.finish("failed", error={
-                        "code": protocol.E_QUEUE_FULL,
-                        "message": "queue full while re-queueing from "
-                                   "journal"},
+                        "code": code,
+                        "message": f"{e} while re-queueing from journal"},
                         on_commit=lambda j=job: self._journal_append(
                             {"event": "failed", "id": j.id})):
                     self._observe_terminal(job, "error")
@@ -324,9 +455,10 @@ class Daemon:
 
     # ---------------------------------------------------------- lifecycle --
     def start(self) -> None:
-        """Bind the socket and start the accept/executor/watchdog threads.
-        Raises RuntimeError if a live daemon already owns the socket (the
-        single-device-owner contract); a stale socket file is unlinked."""
+        """Bind the socket and start the accept/executor-pool/watchdog
+        threads.  Raises RuntimeError if a live daemon already owns the
+        socket (the single-pool-owner contract); a stale socket file is
+        unlinked."""
         if os.path.exists(self.socket_path):
             peer = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             try:
@@ -339,7 +471,8 @@ class Daemon:
                 raise RuntimeError(
                     f"a daemon is already serving on {self.socket_path}")
         obs_events.LOG.configure(self.events_path)
-        obs_events.emit("daemon_start", socket=self.socket_path)
+        obs_events.emit("daemon_start", socket=self.socket_path,
+                        slices=[s.name for s in self.slices])
         # warm start: bind the journal-adjacent store (lock contention or
         # SPGEMM_TPU_WARM=0 leaves it cold -- configure() events both),
         # and point JAX's persistent compilation cache at its xla/ subdir
@@ -358,15 +491,19 @@ class Daemon:
         # blocked accept on Linux, and shutdown semantics vary -- the
         # accept loop re-checks the stop flag every tick instead
         self._listener.settimeout(0.2)
-        self._spawn_executor()
+        for sl in self.slices:
+            self._spawn_executor(sl)
         for target, name in ((self._accept_loop, "spgemmd-accept"),
                              (self._watchdog_loop, "spgemmd-watchdog")):
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
-        log.info("spgemmd serving on %s (queue cap %d, job timeout %s)",
-                 self.socket_path, self._cap,
-                 self._job_timeout_s or "none")
+        log.info("spgemmd serving on %s (%d slice(s): %s; queue cap %d, "
+                 "job timeout %s)",
+                 self.socket_path, len(self.slices),
+                 ",".join(f"{s.name}{'*' if s.default else ''}"
+                          for s in self.slices),
+                 self._cap, self._job_timeout_s or "none")
 
     def serve_forever(self) -> None:
         self.start()
@@ -385,9 +522,10 @@ class Daemon:
                 pass
         for t in self._threads:
             t.join(timeout=5.0)
-        ex = self._executor
-        if ex is not None:
-            ex.join(timeout=5.0)  # wedged executor: daemon flag covers it
+        for sl in self.slices:
+            ex = sl.thread
+            if ex is not None:
+                ex.join(timeout=5.0)  # wedged executor: daemon flag covers it
         # final warm flush + lock release: whatever the terminal-event
         # flushes missed (an estimator plan whose join landed late, the
         # newest delta versions) persists before the process dies, and
@@ -402,49 +540,158 @@ class Daemon:
         except OSError:
             pass
 
+    # ---------------------------------------------------------- placement --
+    def degrade_at_start(self, reason: str) -> None:
+        """Mark the whole pool degraded before serving begins (the CLI's
+        startup-probe-failed path): every slice runs the CPU failover
+        executor from its first job.  No serving thread exists yet, but
+        degrade state is _lock-guarded (THR) -- hold the lock rather than
+        argue the happens-before."""
+        with self._lock:
+            for sl in self.slices:
+                sl.degraded = True
+                sl.degrade_reason = reason
+            self.degraded = True
+            self.degrade_reason = reason
+
+    def _preferred_names(self, job: Job) -> set[str]:
+        """The slice names the job's placement class targets, restricted
+        to healthy slices: small -> the narrowest healthy width class,
+        large -> the widest, default/unknown -> the spec's default slices.
+        Empty when no healthy slice exists (the accept predicate then
+        lets degraded slices serve host-only)."""
+        healthy = [s for s in self.slices if not s.degraded]
+        if not healthy:
+            return set()
+        cls = (job.placement or {}).get("class", "default")
+        if cls == "large":
+            pick = max(s.width for s in healthy)
+            return {s.name for s in healthy if s.width == pick}
+        if cls == "small":
+            pick = min(s.width for s in healthy)
+            return {s.name for s in healthy if s.width == pick}
+        defaults = {s.name for s in healthy if s.default}
+        if defaults:
+            return defaults
+        pick = min(s.width for s in healthy)
+        return {s.name for s in healthy if s.width == pick}
+
+    def _devices_held(self, sl: _Slice) -> bool:
+        """True when another slice holding a job shares a device with sl
+        (overlapping specs, e.g. `auto`'s full-mesh slice): two slices
+        sharing a device are mutually exclusive at dispatch."""
+        ids = set(sl.device_ids)
+        for other in self.slices:
+            if other is not sl and other.current is not None \
+                    and ids & set(other.device_ids):
+                return True
+        return False
+
+    def _accepts(self, sl: _Slice, job: Job) -> bool:
+        """Placement predicate for slice sl's executor (runs under the
+        QUEUE lock -- cheap, lock-free reads of slice handoff slots whose
+        staleness dispatch tolerates): take the job when this slice is in
+        its preferred class, or STEAL it when every preferred slice is
+        busy, degraded or device-blocked -- an idle chip beats a faithful
+        queue position.  A degraded slice serves only when the whole pool
+        is degraded (the single-slice daemon's keep-serving contract).
+
+        Returning True CLAIMS the slice (sl.current = job) while the
+        queue lock is still held: the pop that follows is atomic with the
+        claim, so an overlapping slice (auto's full mesh) probing
+        _devices_held can never dispatch onto a device this job is about
+        to own -- the claim, not the executor's later bookkeeping, is the
+        mutual-exclusion point.  The executor clears a claim it ends up
+        not running (terminal-in-FIFO skip) and re-asserts it at pickup."""
+        if sl.degraded:
+            if any(not s.degraded for s in self.slices):
+                return False
+            job.stolen = False
+            sl.current = job
+            return True
+        if self._devices_held(sl):
+            return False
+        preferred = self._preferred_names(job)
+        if not preferred or sl.name in preferred:
+            job.stolen = False
+            sl.current = job
+            return True
+        for other in self.slices:
+            if other.name in preferred and other.current is None \
+                    and not self._devices_held(other):
+                return False  # a preferred slice is free: leave it the job
+        job.stolen = True
+        sl.current = job
+        return True
+
     # ----------------------------------------------------------- executor --
-    def _spawn_executor(self, degraded: bool | None = None) -> None:
+    def _spawn_executor(self, sl: _Slice,
+                        degraded: bool | None = None) -> None:
         if degraded is not None:
             with self._lock:
-                self.degraded = degraded
-        self._executor_gen += 1
-        gen = self._executor_gen
-        self._executor = threading.Thread(
-            target=self._executor_loop, args=(gen,),
-            name=f"spgemmd-executor-{gen}", daemon=True)
-        self._executor.start()
+                sl.degraded = degraded
+                if degraded:
+                    self.degraded = all(s.degraded for s in self.slices)
+        sl.gen += 1
+        gen = sl.gen
+        sl.thread = threading.Thread(
+            target=self._executor_loop, args=(sl, gen),
+            name=f"spgemmd-executor-{sl.name}-{gen}", daemon=True)
+        sl.thread.start()
 
-    def _executor_loop(self, gen: int) -> None:
+    def _executor_loop(self, sl: _Slice, gen: int) -> None:
         from spgemm_tpu.ops import plancache  # noqa: PLC0415
         from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
 
-        while not self._stop.is_set() and gen == self._executor_gen:
-            job = self.queue.next(timeout=0.2)
+        while not self._stop.is_set() and gen == sl.gen:
+            job = self.queue.next(timeout=0.2,
+                                  accept=lambda j: self._accepts(sl, j))
             if job is None:
                 continue
             if job.state != "queued":  # reaped while still in the FIFO
+                if sl.current is job:
+                    sl.current = None  # release the dispatch claim
                 continue
+            # pickup-time placement: recorded BEFORE start() so the
+            # watchdog's executor-death sweep can attribute the job to
+            # this slice from its first instant.  A lone single-device
+            # slice keeps the legacy default placement (the exact
+            # pre-pool daemon, the SPGEMM_TPU_SERVE_SLICES=1 A/B); any
+            # multi-slice pool -- and a lone WIDE slice (--slices 1x4
+            # must shard, never silently shrink to one device) -- pins
+            # the slice's devices
+            job.slice = sl.name
+            job.device_ids = sl.device_ids \
+                if len(self.slices) > 1 or sl.width > 1 else None
             job.start()
             with self._lock:
-                degraded = self.degraded
+                degraded = sl.degraded
+                sl.jobs_total += 1
+                if job.stolen:
+                    sl.steals += 1
+            if job.stolen:
+                ENGINE.incr("serve_steals")
             scope = ENGINE.scope()
-            # stashed on the job BEFORE it becomes _current: the watchdog
+            # stashed on the job BEFORE it becomes sl.current: the watchdog
             # reads it to attach per-job detail when reaping, and must
             # never see a current job without its scope (the plan-cache
             # baseline rides along for the same reason: per-job cache
             # figures diff against pickup, like the PhaseScope does)
             job.scope, job.scope_degraded = scope, degraded
             job.cache_base = plancache.baseline()
-            self._current = job
+            sl.current = job
             try:
                 # every span this job's work emits (executor thread + the
                 # plan-ahead / OOC workers it spawns, which adopt the
-                # attribution) carries the job id; queue wait is the
-                # first per-job phase so a scraper sees admission latency
+                # attribution) carries the job id AND the slice name;
+                # queue wait is the first per-job phase so a scraper sees
+                # admission latency
                 with obs_trace.RECORDER.tagged(job_id=job.id,
-                                               trace_id=job.id):
+                                               trace_id=job.id,
+                                               slice=sl.name):
                     obs_events.emit("job_start", degraded=degraded,
-                                    folder=job.folder)
+                                    folder=job.folder, slice=sl.name,
+                                    tenant=job.tenant, stolen=job.stolen)
                     # open this job's HBM watermark window (keyed by job
                     # id: a wedged predecessor's late samples land in
                     # ITS window, never this job's)
@@ -464,8 +711,7 @@ class Daemon:
                 log.warning("job %s failed: %r", job.id, e)
                 if job.finish("failed", error={
                         "code": protocol.E_JOB_ERROR, "message": repr(e)},
-                        detail=self._job_detail(scope, degraded, job.id,
-                                                job.cache_base),
+                        detail=self._job_detail(scope, degraded, job),
                         on_commit=lambda: self._journal_append(
                             {"event": "failed", "id": job.id})):
                     self._observe_terminal(job, "error")
@@ -474,8 +720,7 @@ class Daemon:
                 warmstore.flush()  # terminal event: persist what the job warmed
             else:
                 if job.finish("done",
-                              detail=self._job_detail(scope, degraded, job.id,
-                                                      job.cache_base),
+                              detail=self._job_detail(scope, degraded, job),
                               on_commit=lambda: self._journal_append(
                                   {"event": "done", "id": job.id})):
                     self._observe_terminal(job, "done")
@@ -490,18 +735,19 @@ class Daemon:
                 # an abandoned (wedged) executor can unwedge long after a
                 # replacement took over: only clear the slot if it is
                 # still ours, never the successor's current job
-                if self._current is job:
-                    self._current = None
+                if sl.current is job:
+                    sl.current = None
 
     @staticmethod
-    def _job_detail(scope, degraded: bool, job_id: str | None = None,
-                    cache_base: dict | None = None) -> dict:
+    def _job_detail(scope, degraded: bool, job: Job | None = None) -> dict:
         """The per-job status detail: the same phases_s + engine counters
         bench.py emits, scoped to this job alone (PhaseScope diff).
-        cache_base: the plan-cache counter baseline captured at pickup --
-        the detail's `plan_cache` block then reports THIS job's
-        hit/miss/eviction deltas, not process-lifetime totals."""
+        The job's plan-cache block diffs the counter baseline captured at
+        pickup -- so the detail reports THIS job's hit/miss/eviction
+        deltas, not process-lifetime totals."""
         from spgemm_tpu.ops import plancache  # noqa: PLC0415
+        job_id = job.id if job is not None else None
+        cache_base = job.cache_base if job is not None else None
         try:
             cache_scoped = plancache.stats(since=cache_base)
         except ValueError as e:
@@ -515,6 +761,9 @@ class Daemon:
                 "plan_cache": cache_scoped,
                 **({"hbm_peak_bytes": hbm_peak}
                    if hbm_peak is not None else {}),
+                **({"slice": job.slice, "stolen": job.stolen,
+                    "tenant": job.tenant}
+                   if job is not None else {}),
                 "plan_cache_hits": counters.get("plan_cache_hits", 0),
                 "plan_cache_misses": counters.get("plan_cache_misses", 0),
                 # the delta-recompute ratio (ops/delta): output tile-rows
@@ -536,15 +785,15 @@ class Daemon:
         scope = job.scope
         if scope is None:
             return None
-        return self._job_detail(scope, job.scope_degraded, job.id,
-                                job.cache_base)
+        return self._job_detail(scope, job.scope_degraded, job)
 
     # ------------------------------------------------------ observability --
     def _observe_terminal(self, job: Job, outcome: str) -> None:
         """Bookkeeping for a terminal transition THIS daemon committed
         (call only when Job.finish returned True): daemon-lifetime outcome
         totals + the job-wall histogram behind `stats` and the Prometheus
-        surface."""
+        surface, plus the fair queue's per-tenant in-flight release."""
+        self.queue.release(job)
         snap = job.snapshot()
         started = snap["started_at"] or snap["submitted_at"]
         wall = max(0.0, (snap["finished_at"] or time.time()) - started)
@@ -603,100 +852,128 @@ class Daemon:
 
     # ----------------------------------------------------------- watchdog --
     def _watchdog_loop(self) -> None:
-        """Reap overdue jobs; detect executor death and wedging.
+        """Reap overdue jobs; detect executor death and wedging -- per
+        slice.
 
         Death (the thread is gone -- runner raised a BaseException, or a
         test killed it) and wedging (a reaped job's executor still has not
         moved on after the grace window -- the backend-hang signature) both
-        degrade the daemon to the CPU failover path: the device owner
-        cannot be trusted, but host-only service can continue."""
+        degrade THAT SLICE to the CPU failover path: its device cannot be
+        trusted, but the rest of the pool keeps serving, and the degraded
+        slice still serves host-only once every slice is down."""
         while not self._stop.wait(0.05):
-            job = self._current
-            ex = self._executor
-            if ex is not None and not ex.is_alive():
-                # sweep every running job, not just _current: a dying
-                # thread's finally may have cleared the slot already
-                reason = "executor thread died"
-                for orphan in self.queue.running():
-                    if orphan.finish("failed", error={
-                            "code": protocol.E_EXECUTOR_DIED,
-                            "message": "executor thread died mid-job"},
-                            detail=self._reap_detail(orphan),
-                            on_commit=lambda o=orphan: self._journal_append(
-                                {"event": "failed", "id": o.id})):
-                        reason += f" during job {orphan.id}"
-                        self._observe_terminal(orphan, "abandoned")
-                        self._flight_dump(orphan.id)
-                self._degrade(reason)
-                continue
-            if job is not None and self._reaped is not job and job.overdue():
-                # finish() is first-write-wins: a job that completed a
-                # beat before the deadline check stays done (no spurious
-                # failed journal event) and is never treated as a wedge
-                if job.finish("failed", error={
-                        "code": protocol.E_JOB_TIMEOUT,
-                        "message": f"job exceeded its {job.timeout_s:g}s "
-                                   "deadline and was reaped"},
-                        detail=self._reap_detail(job),
-                        on_commit=lambda: self._journal_append(
-                            {"event": "failed", "id": job.id})):
-                    self._reaped, self._reaped_at = job, time.time()
-                    # the reap's postmortem evidence: a counter on the
-                    # Prometheus surface, an instant marker in the span
-                    # timeline, and the flight dump an operator opens
-                    # first
-                    from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
-                    ENGINE.incr("serve_reaps")
-                    obs_trace.RECORDER.instant("serve_reap",
-                                               job_id=job.id)
-                    obs_events.emit("watchdog_reap", job_id=job.id,
-                                    timeout_s=job.timeout_s)
-                    self._observe_terminal(job, "timeout")
-                    self._flight_dump(job.id)
-            reaped = self._reaped
-            if reaped is not None and self._current is reaped:
-                hb = reaped.heartbeat_at or 0.0
-                if hb > self._reaped_at:
-                    # the job heartbeats (chain_product calls touch after
-                    # every multiply): the executor is slow but PROGRESSING
-                    # inside a reaped job, not wedged in a hung backend
-                    # call -- restart the grace window at the newest beat
-                    self._reaped_at = hb
-                elif time.time() - self._reaped_at > self._wedge_grace_s:
-                    self._reaped = None
-                    self._flight_dump(f"{reaped.id}.wedged")
-                    obs_events.emit("watchdog_wedge", job_id=reaped.id,
-                                    grace_s=self._wedge_grace_s)
-                    self._degrade(f"executor wedged on reaped job "
-                                  f"{reaped.id}")
-            elif reaped is not None and self._current is not reaped:
-                self._reaped = None  # executor moved on: slow, not wedged
+            for sl in self.slices:
+                self._watch_slice(sl)
 
-    def _degrade(self, reason: str) -> None:
-        """Abandon the current executor, record why, probe the backend (a
+    def _watch_slice(self, sl: _Slice) -> None:
+        job = sl.current
+        ex = sl.thread
+        if ex is not None and not ex.is_alive():
+            # sweep every running job this slice owns, not just
+            # sl.current: a dying thread's finally may have cleared the
+            # slot already
+            reason = f"executor thread for slice {sl.name} died"
+            for orphan in self.queue.running():
+                if orphan.slice != sl.name:
+                    continue
+                if orphan.finish("failed", error={
+                        "code": protocol.E_EXECUTOR_DIED,
+                        "message": "executor thread died mid-job"},
+                        detail=self._reap_detail(orphan),
+                        on_commit=lambda o=orphan: self._journal_append(
+                            {"event": "failed", "id": o.id})):
+                    reason += f" during job {orphan.id}"
+                    self._observe_terminal(orphan, "abandoned")
+                    self._flight_dump(orphan.id)
+            self._degrade_slice(sl, reason)
+            return
+        if job is not None and sl.reaped is not job and job.overdue():
+            # finish() is first-write-wins: a job that completed a
+            # beat before the deadline check stays done (no spurious
+            # failed journal event) and is never treated as a wedge
+            if job.finish("failed", error={
+                    "code": protocol.E_JOB_TIMEOUT,
+                    "message": f"job exceeded its {job.timeout_s:g}s "
+                               "deadline and was reaped"},
+                    detail=self._reap_detail(job),
+                    on_commit=lambda: self._journal_append(
+                        {"event": "failed", "id": job.id})):
+                sl.reaped, sl.reaped_at = job, time.time()
+                # the reap's postmortem evidence: a counter on the
+                # Prometheus surface, an instant marker in the span
+                # timeline, and the flight dump an operator opens
+                # first
+                from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+                ENGINE.incr("serve_reaps")
+                obs_trace.RECORDER.instant("serve_reap",
+                                           job_id=job.id, slice=sl.name)
+                obs_events.emit("watchdog_reap", job_id=job.id,
+                                timeout_s=job.timeout_s, slice=sl.name)
+                self._observe_terminal(job, "timeout")
+                self._flight_dump(job.id)
+        reaped = sl.reaped
+        if reaped is not None and sl.current is reaped:
+            hb = reaped.heartbeat_at or 0.0
+            if hb > sl.reaped_at:
+                # the job heartbeats (chain_product calls touch after
+                # every multiply): the executor is slow but PROGRESSING
+                # inside a reaped job, not wedged in a hung backend
+                # call -- restart the grace window at the newest beat
+                sl.reaped_at = hb
+            elif time.time() - sl.reaped_at > self._wedge_grace_s:
+                sl.reaped = None
+                self._flight_dump(f"{reaped.id}.wedged")
+                obs_events.emit("watchdog_wedge", job_id=reaped.id,
+                                grace_s=self._wedge_grace_s,
+                                slice=sl.name)
+                self._degrade_slice(sl, f"executor wedged on reaped job "
+                                        f"{reaped.id}")
+        elif reaped is not None and sl.current is not reaped:
+            sl.reaped = None  # executor moved on: slow, not wedged
+
+    def _degrade_slice(self, sl: _Slice, reason: str) -> None:
+        """Abandon the slice's executor, record why, probe the backend (a
         subprocess -- the only safe touch of a possibly-dead device) and
-        spawn a replacement executor pinned to the host-only oracle."""
+        spawn a replacement executor for the slice pinned to the host-only
+        oracle.  The slice is excluded from placement while any healthy
+        slice remains; the daemon-level degraded flag trips only when the
+        whole pool is down."""
         if self._stop.is_set():
             return
         with self._lock:
-            already = self.degraded
-            self.degraded = True
-            self.degrade_reason = reason
+            any_before = any(s.degraded for s in self.slices)
+            already = sl.degraded
+            sl.degraded = True
+            sl.degrade_reason = reason
+            self.degraded = all(s.degraded for s in self.slices)
+            if self.degraded:
+                # the daemon-level reason is set if-and-only-if the
+                # daemon-level flag is (the pre-pool alerting contract):
+                # a healthy pool with one bad slice reports the reason
+                # per-slice, never as a whole-daemon degrade
+                self.degrade_reason = reason
         # service first, diagnostics second: the replacement host-only
         # executor needs nothing from the probe, and the probe subprocess
         # can block for the full SPGEMM_TPU_PROBE_TIMEOUT (default 150 s)
         # against a dead device -- queued jobs must not wait on it, and
         # neither may the watchdog (it still has reaping to do), so the
         # probe runs on its own thread and only feeds stats
-        self._spawn_executor(degraded=True)
+        self._spawn_executor(sl, degraded=True)
         if already:
             return
-        log.warning("degrading to CPU failover path: %s", reason)
+        log.warning("slice %s degrading to CPU failover path: %s",
+                    sl.name, reason)
         from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
         ENGINE.incr("serve_degrades")
-        obs_trace.RECORDER.instant("serve_degrade", job_id=None)
-        obs_events.emit("daemon_degrade", reason=reason)
-        self._flight_dump("degrade")
+        obs_trace.RECORDER.instant("serve_degrade", job_id=None,
+                                   slice=sl.name)
+        obs_events.emit("daemon_degrade", reason=reason, slice=sl.name)
+        # the single-slice daemon keeps its historical dump name; pool
+        # slices get one postmortem each
+        self._flight_dump("degrade" if len(self.slices) == 1
+                          else f"degrade.{sl.name}")
+        if any_before:
+            return  # one probe per healthy->degraded transition is enough
         probe = self._probe
         if probe is None:
             from spgemm_tpu.utils.backend_probe import (  # noqa: PLC0415
@@ -813,6 +1090,16 @@ class Daemon:
                 protocol.E_BAD_REQUEST,
                 f"unknown submit option(s) {', '.join(unknown)} (known: "
                 f"{', '.join(SUBMIT_OPTIONS)})")
+        # the optional fair-queuing identity (protocol v2); absent maps
+        # to the shared default tenant, exactly the v1 behavior.  The
+        # name becomes a Prometheus label value and a stats key, so the
+        # charset/length are validated at admission like option values.
+        tenant = msg.get("tenant", protocol.DEFAULT_TENANT)
+        if not protocol.valid_tenant(tenant):
+            return protocol.error(
+                protocol.E_BAD_REQUEST,
+                f"tenant must be 1-{protocol.TENANT_MAX_LEN} chars of "
+                f"[A-Za-z0-9._:-], got {tenant!r}")
         # option VALUES are validated at admission like option names: a
         # bad round_size/backend must answer bad-request here, not fail
         # the job later with an opaque job-error from inside the runner
@@ -856,7 +1143,12 @@ class Daemon:
         with self._lock:
             job_id = f"job-{self._next_id}"
             self._next_id += 1
-        job = Job(job_id, folder, output, options, timeout_s=timeout_s)
+        job = Job(job_id, folder, output, options, timeout_s=timeout_s,
+                  tenant=tenant)
+        # estimator-priced placement, decided at admission (cheap: a
+        # price-book stat lookup, never a file parse) and carried on the
+        # job for the slice executors' accept predicates
+        job.placement = placement.route(folder)
         # journal BEFORE enqueueing: the executor can pop and terminally
         # finish a job the instant it is queued, and its done/failed
         # journal event (committed inside Job.finish) must never precede
@@ -866,7 +1158,8 @@ class Daemon:
         # job, which is the at-least-once contract restarts already have.
         self._journal_append({"event": "submit", "id": job.id,
                               "folder": folder, "output": output,
-                              "options": options, "timeout_s": timeout_s})
+                              "options": options, "timeout_s": timeout_s,
+                              "tenant": tenant})
         try:
             depth = self.queue.submit(job)
         except QueueFull as e:
@@ -875,8 +1168,16 @@ class Daemon:
                 protocol.E_QUEUE_FULL,
                 f"queue full ({e.cap} jobs queued); retry later or raise "
                 "SPGEMM_TPU_SERVE_QUEUE_CAP", id=None)
+        except TenantCapExceeded as e:
+            self._journal_append({"event": "failed", "id": job.id})
+            return protocol.error(
+                protocol.E_TENANT_CAP,
+                f"tenant {e.tenant!r} already has {e.cap} jobs in flight; "
+                "wait for one to finish or raise "
+                "SPGEMM_TPU_SERVE_TENANT_INFLIGHT", id=None)
         obs_events.emit("job_submit", job_id=job.id, folder=folder,
-                        queued=depth)
+                        queued=depth, tenant=tenant,
+                        placement=job.placement)
         return protocol.ok(id=job.id, state=job.state, queued=depth)
 
     def _op_status(self, msg: dict, wait: bool) -> dict:
@@ -909,6 +1210,27 @@ class Daemon:
         return {"path": self.journal_path, "enabled": self._journal_enabled,
                 "bytes": size, "compactions": compactions}
 
+    def _slice_rows(self) -> list[dict]:
+        """Per-slice serving state for stats (and, flattened, the
+        Prometheus per-slice series): the pool health signal."""
+        with self._lock:
+            rows = []
+            for sl in self.slices:
+                cur = sl.current
+                rows.append({
+                    "name": sl.name,
+                    "devices": list(sl.device_ids),
+                    "width": sl.width,
+                    "default": sl.default,
+                    "degraded": sl.degraded,
+                    "degrade_reason": sl.degrade_reason,
+                    "busy": cur is not None,
+                    "current": cur.id if cur is not None else None,
+                    "jobs_total": sl.jobs_total,
+                    "steals": sl.steals,
+                })
+        return rows
+
     def _op_stats(self) -> dict:
         from spgemm_tpu.ops import delta, plancache  # noqa: PLC0415
 
@@ -924,6 +1246,7 @@ class Daemon:
             warm_stats = warmstore.stats()
         except ValueError as e:
             warm_stats = {"error": str(e)}
+        slices = self._slice_rows()
         with self._lock:
             degraded = self.degraded
             degrade_reason = self.degrade_reason
@@ -943,6 +1266,15 @@ class Daemon:
             # these totals distinguish "healthy and idle" from "just
             # recovered after reaping half the fleet's jobs"
             jobs_terminal=terminal,
+            # the device pool: per-slice health (one wedged slice shows
+            # degraded HERE while the daemon-level flag stays False and
+            # the rest keep serving), the fair queue's per-tenant state,
+            # and the placement price book
+            slices=slices,
+            slices_degraded=sum(1 for s in slices if s["degraded"]),
+            tenants=self.queue.tenants(),
+            tenant_inflight_cap=self.queue.tenant_cap(),
+            placement=placement.stats(),
             journal=self._journal_stats(),
             trace=obs_trace.RECORDER.stats(),
             events=obs_events.LOG.stats(),
@@ -957,8 +1289,9 @@ class Daemon:
     def _op_metrics(self) -> dict:
         """The scrapeable surface: Prometheus text-format 0.0.4 rendered
         from the obs/metrics.py registry -- engine phase/counter series,
-        plan-cache and flight-recorder state, plus the daemon's serving
-        gauges.  The future mesh scheduler is born scrapeable."""
+        plan-cache and flight-recorder state, the daemon's serving gauges,
+        and the pool's per-slice/per-tenant series (spgemm_slice_busy,
+        spgemm_slice_jobs_total{slice=...}, spgemmd_tenant_queue_depth)."""
         samples = obs_metrics.collect_engine()
         with self._lock:
             degraded = self.degraded
@@ -985,6 +1318,17 @@ class Daemon:
                     for state, n in sorted(counts.items())]
         samples += [("spgemmd_jobs_terminal_total", {"outcome": outcome}, n)
                     for outcome, n in sorted(terminal.items())]
+        for row in self._slice_rows():
+            labels = {"slice": row["name"]}
+            samples += [
+                ("spgemm_slice_busy", labels, int(row["busy"])),
+                ("spgemm_slice_degraded", labels, int(row["degraded"])),
+                ("spgemm_slice_jobs_total", labels, row["jobs_total"]),
+                ("spgemm_slice_steals_total", labels, row["steals"]),
+            ]
+        for tenant, row in self.queue.tenants().items():
+            samples.append(("spgemmd_tenant_queue_depth",
+                            {"tenant": tenant}, row["queued"]))
         return protocol.ok(
             content_type="text/plain; version=0.0.4; charset=utf-8",
             text=obs_metrics.render(samples))
@@ -1026,8 +1370,8 @@ def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="spgemm_tpu serve",
         description="spgemmd: resident chain-serving daemon (one process "
-                    "owns the device; jobs reuse its warm jit/plan/"
-                    "crossover caches)")
+                    "owns the device pool; jobs reuse its warm jit/plan/"
+                    "crossover caches across per-slice executors)")
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="unix socket path (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
@@ -1036,6 +1380,10 @@ def main(argv: list[str] | None = None) -> int:
                         "without it the default backend is probed first and "
                         "a dead accelerator starts the daemon degraded on "
                         "CPU instead of hanging")
+    p.add_argument("--slices", default=None, metavar="SPEC",
+                   help="device-pool slice spec override "
+                        "(SPGEMM_TPU_SERVE_SLICES; e.g. '1x4+4', 'auto'; "
+                        "default '1' = the single-executor daemon)")
     p.add_argument("--queue-cap", type=int, default=None,
                    help="override SPGEMM_TPU_SERVE_QUEUE_CAP for this "
                         "daemon")
@@ -1054,18 +1402,30 @@ def main(argv: list[str] | None = None) -> int:
     else:
         from spgemm_tpu.utils.backend_probe import failover_to_cpu  # noqa: PLC0415
         degraded_at_start = failover_to_cpu("spgemmd")
-    daemon = Daemon(args.socket, queue_cap=args.queue_cap,
-                    journal=not args.no_journal,
-                    persist_compile_cache=True)
+    # the slice pool needs the visible device count to validate its spec
+    # ('auto' requires it); the probe/pin above already made this touch
+    # safe, and a degraded-at-start daemon serves host-only anyway
+    try:
+        import jax  # noqa: PLC0415
+        n_devices = len(jax.devices())
+    except Exception as e:  # noqa: BLE001 -- a dead backend must not kill the failover daemon
+        log.warning("device count unavailable (%r); pool runs host-only",
+                    e)
+        n_devices = 1
+        degraded_at_start = True
+    try:
+        daemon = Daemon(args.socket, queue_cap=args.queue_cap,
+                        journal=not args.no_journal,
+                        persist_compile_cache=True,
+                        slices=args.slices, n_devices=n_devices)
+    except mesh_mod.SliceSpecError as e:
+        print(f"spgemmd: {e}", file=sys.stderr)
+        return 1
     if degraded_at_start:
         # the device was dead before we ever owned it: CPU failover path
-        # from the first job, reported in stats like a mid-flight degrade.
-        # No serving thread exists yet, but degrade state is _lock-guarded
-        # (THR) -- hold the lock rather than argue the happens-before,
-        # same as _journal_replay
-        with daemon._lock:
-            daemon.degraded = True
-            daemon.degrade_reason = "startup probe: accelerator unreachable"
+        # from the first job on every slice, reported in stats like a
+        # mid-flight degrade
+        daemon.degrade_at_start("startup probe: accelerator unreachable")
     try:
         daemon.serve_forever()
     except KeyboardInterrupt:

@@ -1,0 +1,154 @@
+"""Estimator-priced job placement for the spgemmd device pool.
+
+The placement question at admission is "which slice class should run this
+job": cheap jobs onto the narrowest free slice (so the wide slices stay
+free for work that can use them), webbase-class jobs onto the widest
+slice, and first-contact jobs -- no estimate yet -- onto the spec's
+default slice.  The price signal is the sampled structure estimator's
+predicted tile-pair mass (ops/estimate.chain_mass -- the Ocean-style
+sampling that already steers planning budgets), recorded into a bounded
+price book the first time a job's chain is actually read:
+
+  * admission (`route`, conn-thread, jax-free, O(stat) cheap): look the
+    input folder up by its stat signature (file names + sizes + mtimes --
+    the same change-detection granularity the delta path's digests refine
+    later).  A book hit prices the job exactly; a miss classifies
+    webbase-class inputs by raw on-disk bytes (a monotone nnz proxy that
+    costs three stat calls) and sends everything else to the default
+    slice.
+  * execution (`note_mass`, executor thread): the runner has the chain's
+    coords in hand anyway -- one sampled mini-join prices the structure
+    and seeds the book, so every re-submit of the folder (the serving
+    workload) routes on a real estimate.
+
+Pricing steers placement only -- never fold order, never kernel routing
+-- so a mis-priced job is merely scheduled on a narrower/wider slice than
+ideal, with bits identical by construction.
+
+jax-free by design: imported by the daemon's admission path (conn
+threads) and by tests that never start a backend.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+# class thresholds (module constants, monkeypatchable in tests and small
+# enough to revisit with fleet data): a job whose predicted first-pass
+# pair mass reaches LARGE_MASS_PAIRS is webbase-class (route wide); a
+# first-contact folder whose matrix files reach LARGE_INPUT_BYTES is
+# assumed webbase-class without an estimate (raw bytes are a monotone
+# nnz proxy at reference text densities)
+LARGE_MASS_PAIRS = 1e6
+LARGE_INPUT_BYTES = 64 << 20
+
+# price-book capacity: one entry per distinct (folder, content-stamp);
+# LRU past this, like every other client-growable resource in the daemon
+BOOK_CAP = 4096
+
+_LOCK = threading.Lock()
+_BOOK: "OrderedDict[str, float]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
+_STATS = {"book_hits": 0, "book_misses": 0,
+          "routed": {}}  # spgemm-lint: guarded-by(_LOCK)
+
+
+def signature(folder: str) -> str | None:
+    """Stat signature of a chain input folder (size file + matrix files:
+    names, byte sizes, mtimes) -- the book key.  None when the folder is
+    unreadable (journal replay may race a deleted input; the job itself
+    will fail with the real error)."""
+    try:
+        names = sorted(n for n in os.listdir(folder)
+                       if n == "size" or n.startswith("matrix"))
+        h = hashlib.sha256(folder.encode())
+        for n in names:
+            st = os.stat(os.path.join(folder, n))
+            h.update(f"{n}:{st.st_size}:{st.st_mtime_ns}|".encode())
+        return h.hexdigest()
+    except OSError:
+        return None
+
+
+def input_bytes(folder: str) -> int:
+    """Total on-disk bytes of the folder's matrix files (the first-contact
+    webbase-class proxy); 0 when unreadable."""
+    total = 0
+    try:
+        for n in os.listdir(folder):
+            if n.startswith("matrix"):
+                total += os.path.getsize(os.path.join(folder, n))
+    except OSError:
+        return 0
+    return total
+
+
+def note_mass(folder: str, mass: float) -> None:
+    """Record a measured/estimated pair mass for the folder's current
+    content (executor side, after the chain is read)."""
+    sig = signature(folder)
+    if sig is None:
+        return
+    with _LOCK:
+        _BOOK[sig] = float(mass)
+        _BOOK.move_to_end(sig)
+        while len(_BOOK) > BOOK_CAP:
+            _BOOK.popitem(last=False)
+
+
+def lookup_mass(folder: str) -> float | None:
+    """The recorded pair mass for the folder's CURRENT content, or None
+    on first contact / content change (the stat signature is the key, so
+    a mutated input re-prices instead of riding a stale estimate)."""
+    sig = signature(folder)
+    with _LOCK:
+        if sig is None or sig not in _BOOK:
+            _STATS["book_misses"] += 1
+            return None
+        _BOOK.move_to_end(sig)
+        _STATS["book_hits"] += 1
+        return _BOOK[sig]
+
+
+def route(folder: str) -> dict:
+    """The admission-time placement record for a job: `class` is
+    small|large|default (narrowest slice / widest slice / the spec's
+    default slice), plus the price provenance for status detail and
+    stats."""
+    mass = lookup_mass(folder)
+    if mass is not None:
+        cls = "large" if mass >= LARGE_MASS_PAIRS else "small"
+        source = "estimate"
+    else:
+        nbytes = input_bytes(folder)
+        if nbytes >= LARGE_INPUT_BYTES:
+            cls, source = "large", "bytes"
+            mass = float(nbytes)
+        else:
+            cls, source = "default", "none"
+    with _LOCK:
+        _STATS["routed"][cls] = _STATS["routed"].get(cls, 0) + 1
+    return {"class": cls, "source": source,
+            **({"mass": mass} if mass is not None else {})}
+
+
+def stats() -> dict:
+    """Live placement state for spgemmd stats: book size/hit rate and the
+    admission routing histogram."""
+    with _LOCK:
+        return {"book_entries": len(_BOOK),
+                "book_hits": _STATS["book_hits"],
+                "book_misses": _STATS["book_misses"],
+                "routed": dict(_STATS["routed"]),
+                "large_mass_pairs": LARGE_MASS_PAIRS,
+                "large_input_bytes": LARGE_INPUT_BYTES}
+
+
+def clear() -> None:
+    """Drop the book and zero the stats (tests, A/B harnesses)."""
+    with _LOCK:
+        _BOOK.clear()
+        _STATS["book_hits"] = _STATS["book_misses"] = 0
+        _STATS["routed"].clear()

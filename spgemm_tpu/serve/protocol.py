@@ -11,7 +11,14 @@ a structured `bad-request` error -- the daemon must survive garbage input
 propagate past the connection handler.
 
 Ops:
-  submit   {folder, options?}       -> {id, state, queued}
+  submit   {folder, options?, tenant?} -> {id, state, queued}
+                                       (tenant: optional fair-queuing
+                                       identity -- deficit-round-robin
+                                       across tenants with an optional
+                                       per-tenant in-flight cap,
+                                       SPGEMM_TPU_SERVE_TENANT_INFLIGHT;
+                                       absent = the shared "default"
+                                       tenant, exactly the v1 behavior)
   status   {id}                     -> {job: <snapshot>}
   wait     {id, timeout?}           -> {job: <snapshot>} (blocks until the
                                        job is terminal or timeout elapses;
@@ -50,11 +57,25 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import tempfile
 
 from spgemm_tpu.utils import knobs
 
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2
+
+# versions the daemon still speaks: v2 added the optional submit `tenant`
+# field (absent = DEFAULT_TENANT), which a v1 daemon would have rejected
+# as an unknown key had it been an option -- v1 requests parse unchanged,
+# so old clients keep working against a new daemon
+ACCEPTED_VERSIONS = (1, 2)
+
+# the tenant every v1 (or tenant-less v2) submit maps to
+DEFAULT_TENANT = "default"
+
+# tenant names are operator-facing label values (Prometheus series, stats
+# keys): bound the charset and length at admission
+TENANT_MAX_LEN = 64
 
 OPS = ("submit", "status", "wait", "stats", "metrics", "trace", "profile",
        "events", "shutdown")
@@ -73,6 +94,7 @@ CHAIN_BACKENDS = ("xla", "pallas", "mxu", "hybrid")
 # request-level error codes
 E_BAD_REQUEST = "bad-request"      # unparsable line / unknown op / bad version
 E_QUEUE_FULL = "queue-full"        # admission control rejection
+E_TENANT_CAP = "tenant-cap"        # per-tenant in-flight cap rejection
 E_BUSY = "too-many-connections"    # concurrent-connection bound hit
 E_UNKNOWN_JOB = "unknown-job"
 E_SHUTTING_DOWN = "shutting-down"
@@ -82,6 +104,17 @@ E_INTERNAL = "internal-error"      # handler crash (daemon survives)
 E_JOB_TIMEOUT = "job-timeout"      # reaped past SPGEMM_TPU_SERVE_JOB_TIMEOUT
 E_EXECUTOR_DIED = "executor-died"  # executor thread died/wedged mid-job
 E_JOB_ERROR = "job-error"          # the chain runner raised
+
+
+# tenant charset: safe as a Prometheus label value and a stats dict key
+# (no quotes, no whitespace, no control characters)
+_TENANT_RE = re.compile(r"^[A-Za-z0-9._:-]+$")
+
+
+def valid_tenant(tenant) -> bool:
+    """True iff `tenant` is an acceptable wire tenant name."""
+    return (isinstance(tenant, str) and 0 < len(tenant) <= TENANT_MAX_LEN
+            and _TENANT_RE.match(tenant) is not None)
 
 
 class ProtocolError(Exception):
@@ -129,10 +162,11 @@ def parse_request(line: str) -> dict:
         raise ProtocolError(E_BAD_REQUEST,
                             "request must be a JSON object")
     v = msg.get("v")
-    if v != PROTOCOL_VERSION:
+    if v not in ACCEPTED_VERSIONS:
         raise ProtocolError(
             E_BAD_REQUEST,
-            f"protocol version mismatch: daemon speaks v{PROTOCOL_VERSION}, "
+            f"protocol version mismatch: daemon speaks v{PROTOCOL_VERSION} "
+            f"(accepts {'/'.join(f'v{a}' for a in ACCEPTED_VERSIONS)}), "
             f"request carries v={v!r}")
     op = msg.get("op")
     if op not in OPS:

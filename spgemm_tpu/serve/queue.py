@@ -1,4 +1,5 @@
-"""spgemmd job queue: bounded FIFO with admission control.
+"""spgemmd job queue: bounded multi-tenant fair queue with admission
+control.
 
 Admission control is the daemon's back-pressure contract: a submit that
 arrives with SPGEMM_TPU_SERVE_QUEUE_CAP jobs already queued is rejected
@@ -7,6 +8,22 @@ reference's analog is MPI ranks deadlocking when a peer falls behind --
 here overload is an answer, not a wedge).  Per-job deadlines are stored at
 submit time so the watchdog can reap a job that exceeds them with a
 structured job-timeout error.
+
+Fair queuing (the device-pool scheduler's admission half): every job
+carries a tenant (the optional v2 submit field; absent maps to
+protocol.DEFAULT_TENANT, exactly the v1 behavior), jobs queue per tenant,
+and dispatch serves tenants deficit-round-robin -- with unit job costs the
+deficit counters collapse to strict rotation, so a chatty tenant's burst
+never starves a quiet tenant's single job past one round.  An optional
+per-tenant in-flight cap (SPGEMM_TPU_SERVE_TENANT_INFLIGHT: queued +
+running jobs per tenant) rejects the chatty tenant's overflow with a
+structured tenant-cap error, never a hang; the global queue cap always
+applies on top.
+
+Dispatch is placement-aware: `next(accept=...)` lets the pool's per-slice
+executors decline a tenant's head job (wrong slice class for this
+executor) without popping it -- the accept predicate runs under the queue
+lock, so the executor that got True is the one that owns the job.
 
 jax-free by design (imported by the client-side CLI path).
 """
@@ -17,6 +34,9 @@ import threading
 import time
 from collections import deque
 
+from spgemm_tpu.serve import protocol
+from spgemm_tpu.utils import knobs
+
 TERMINAL = ("done", "failed")
 
 
@@ -25,6 +45,17 @@ class QueueFull(Exception):
 
     def __init__(self, cap: int):
         super().__init__(f"queue full: {cap} jobs already queued")
+        self.cap = cap
+
+
+class TenantCapExceeded(Exception):
+    """Per-tenant in-flight cap rejection (structured, never a hang);
+    carries the tenant and the live cap for the error message."""
+
+    def __init__(self, tenant: str, cap: int):
+        super().__init__(f"tenant {tenant!r} already has {cap} jobs in "
+                         "flight")
+        self.tenant = tenant
         self.cap = cap
 
 
@@ -54,11 +85,13 @@ class Job:
     """
 
     def __init__(self, job_id: str, folder: str, output: str,
-                 options: dict, timeout_s: float = 0.0):
+                 options: dict, timeout_s: float = 0.0,
+                 tenant: str = protocol.DEFAULT_TENANT):
         self.id = job_id
         self.folder = folder
         self.output = output
         self.options = options
+        self.tenant = tenant
         self.timeout_s = timeout_s  # 0 = no deadline
         self.state = "queued"                   # spgemm-lint: guarded-by(_lock)
         self.error: dict | None = None          # spgemm-lint: guarded-by(_lock)
@@ -70,6 +103,16 @@ class Job:
         # executor's per-multiply touch), float-ref store is atomic under
         # the GIL, and the watchdog's read tolerates staleness by design
         self.heartbeat_at: float | None = None
+        # placement record (serve/placement.route, set at admission before
+        # the job is queue-visible) and the pickup-time assignment (slice
+        # name / device positions / whether an off-class slice stole it --
+        # written once by the winning executor under the QUEUE lock or
+        # right after the pop, read by snapshots that tolerate staleness
+        # like heartbeat_at does)
+        self.placement: dict | None = None
+        self.slice: str | None = None
+        self.device_ids: tuple | None = None
+        self.stolen = False
         # set by the daemon's executor when it picks the job up: the live
         # PhaseScope (opaque here -- the queue stays jax-free) and the
         # path the job ran on, read by the watchdog so a reaped job's
@@ -141,6 +184,7 @@ class Job:
                 "folder": self.folder,
                 "output": self.output,
                 "options": dict(self.options),
+                "tenant": self.tenant,
                 "state": self.state,
                 "error": self.error,
                 "detail": dict(self.detail),
@@ -149,38 +193,65 @@ class Job:
                 "started_at": self.started_at,
                 "finished_at": self.finished_at,
                 "heartbeat_at": self.heartbeat_at,
+                "slice": self.slice,
+                "stolen": self.stolen,
+                "placement": dict(self.placement) if self.placement
+                else None,
             }
 
 
 class JobQueue:
-    """Bounded FIFO over Job objects + the daemon's job index.
+    """Bounded per-tenant fair queue over Job objects + the daemon's job
+    index.
 
-    The cap bounds jobs in the *queued* state (a running job no longer
-    occupies a queue slot).  Completed jobs stay in the index so
-    status/wait work after the fact, but only the RETAIN_TERMINAL most
-    recent -- a resident daemon must not grow per-job state (options,
-    detail, the stashed PhaseScope) for its lifetime; a status for an
-    evicted id answers unknown-job.
+    The cap bounds jobs in the *queued* state across every tenant (a
+    running job no longer occupies a queue slot); the optional per-tenant
+    in-flight cap additionally bounds queued + running per tenant.
+    Completed jobs stay in the index so status/wait work after the fact,
+    but only the RETAIN_TERMINAL most recent -- a resident daemon must not
+    grow per-job state (options, detail, the stashed PhaseScope) for its
+    lifetime; a status for an evicted id answers unknown-job.
+
+    Dispatch order: deficit round robin across tenants (unit job costs =
+    strict tenant rotation), FIFO within a tenant.  With one tenant this
+    degenerates to exactly the pre-pool FIFO.
     """
 
     # terminal jobs retained; past this the oldest are evicted at the
     # next admission (class attribute so tests can shrink it)
     RETAIN_TERMINAL = 512
 
-    def __init__(self, cap: int):
+    def __init__(self, cap: int, tenant_inflight: int | None = None):
         self.cap = cap
-        self._fifo: deque[Job] = deque()   # spgemm-lint: guarded-by(_lock)
+        # explicit constructor cap wins; None falls back to the knob,
+        # re-read per submit (tests flip it mid-process like every knob)
+        self._tenant_cap = tenant_inflight
+        self._queues: dict[str, deque[Job]] = {}  # spgemm-lint: guarded-by(_lock)
+        self._rr: list[str] = []           # spgemm-lint: guarded-by(_lock)
+        self._queued = 0                   # spgemm-lint: guarded-by(_lock)
+        self._inflight: dict[str, int] = {}  # spgemm-lint: guarded-by(_lock)
+        self._served: dict[str, int] = {}  # spgemm-lint: guarded-by(_lock)
         self._jobs: dict[str, Job] = {}    # spgemm-lint: guarded-by(_lock)
         self._lock = threading.Lock()
         self._avail = threading.Condition(self._lock)
 
+    def tenant_cap(self) -> int | None:
+        """The live per-tenant in-flight cap (None = uncapped)."""
+        if self._tenant_cap is not None:
+            return self._tenant_cap
+        return knobs.get("SPGEMM_TPU_SERVE_TENANT_INFLIGHT")
+
     def submit(self, job: Job) -> int:
-        """Admit job (FIFO order); QueueFull once cap jobs are queued.
+        """Admit job (FIFO within its tenant); QueueFull once cap jobs are
+        queued, TenantCapExceeded once the tenant's in-flight cap is hit.
         Returns the queue depth including the new job."""
+        cap_t = self.tenant_cap()
         with self._avail:
-            queued = len(self._fifo)
-            if queued >= self.cap:
+            if self._queued >= self.cap:
                 raise QueueFull(self.cap)
+            if cap_t is not None \
+                    and self._inflight.get(job.tenant, 0) >= cap_t:
+                raise TenantCapExceeded(job.tenant, cap_t)
             # evict the oldest terminal jobs beyond the retention bound
             # (dict order = admission order, oldest first)
             terminal = [j.id for j in self._jobs.values()
@@ -188,20 +259,85 @@ class JobQueue:
             for jid in terminal[:max(0, len(terminal)
                                      - self.RETAIN_TERMINAL)]:
                 del self._jobs[jid]
-            self._fifo.append(job)
+            if job.tenant not in self._queues:
+                self._queues[job.tenant] = deque()
+                if job.tenant not in self._rr:
+                    self._rr.append(job.tenant)
+            self._queues[job.tenant].append(job)
+            self._queued += 1
+            self._inflight[job.tenant] = \
+                self._inflight.get(job.tenant, 0) + 1
+            # release() frees an in-flight slot only for jobs that took
+            # one: a job whose submit RAISED (queue-full / tenant-cap)
+            # may still be finished + observed by the caller, and must
+            # never decrement a slot an admitted job owns
+            job._admitted = True
             self._jobs[job.id] = job
-            self._avail.notify()
-            return queued + 1
+            # notify_all: with placement-aware accept predicates, the one
+            # waiter notify() picks may decline the job while a compatible
+            # executor keeps sleeping
+            self._avail.notify_all()
+            return self._queued
 
-    def next(self, timeout: float | None = None) -> Job | None:
-        """Pop the oldest queued job; None on timeout (executor idle
-        tick)."""
+    def _pop_locked(self, accept) -> Job | None:
+        """One DRR pass over the tenant rotation (caller holds _lock):
+        serve the first tenant whose head job the accept predicate takes,
+        then rotate the served tenant (and everyone it skipped past) to
+        the back of the order."""
+        order = self._rr
+        for idx, tenant in enumerate(order):
+            q = self._queues.get(tenant)
+            if not q:
+                continue
+            job = q[0]
+            if accept is not None and not accept(job):
+                continue
+            q.popleft()
+            self._queued -= 1
+            if not q:
+                del self._queues[tenant]
+            self._served[tenant] = self._served.get(tenant, 0) + 1
+            self._rr = order[idx + 1:] + order[:idx + 1]
+            return job
+        return None
+
+    def next(self, timeout: float | None = None, accept=None) -> Job | None:
+        """Pop the next job in fair order that `accept` takes (None
+        predicate takes anything); None on timeout (executor idle tick).
+        The predicate runs under the queue lock -- it must be cheap and
+        lock-free -- and the caller that received the job is exactly the
+        one whose predicate returned True for it."""
         with self._avail:
-            if not self._fifo:
+            job = self._pop_locked(accept)
+            if job is None:
                 self._avail.wait(timeout)
-            if not self._fifo:
-                return None
-            return self._fifo.popleft()
+                job = self._pop_locked(accept)
+            return job
+
+    def release(self, job: Job) -> None:
+        """Retire a terminal job from the per-tenant in-flight accounting
+        (the daemon calls this exactly once per committed terminal
+        transition).  Idempotent, and a no-op for a job that was never
+        admitted: a double (or unearned) release must never free a slot
+        an admitted job owns."""
+        with self._lock:
+            if not getattr(job, "_admitted", False) \
+                    or getattr(job, "_released", False):
+                return
+            job._released = True
+            n = self._inflight.get(job.tenant, 0) - 1
+            if n > 0:
+                self._inflight[job.tenant] = n
+            else:
+                self._inflight.pop(job.tenant, None)
+            # retire the tenant's rotation + served records once it has
+            # nothing queued and nothing in flight: per-tenant state must
+            # not grow with the number of tenant names ever seen
+            if job.tenant not in self._queues \
+                    and job.tenant not in self._inflight:
+                if job.tenant in self._rr:
+                    self._rr.remove(job.tenant)
+                self._served.pop(job.tenant, None)
 
     def get(self, job_id: str) -> Job | None:
         with self._lock:
@@ -218,9 +354,22 @@ class JobQueue:
         """State histogram over every job ever admitted + live depth."""
         with self._lock:
             jobs = list(self._jobs.values())
-            depth = len(self._fifo)
+            depth = self._queued
         hist = {"queued": 0, "running": 0, "done": 0, "failed": 0}
         for j in jobs:
             hist[j.state] = hist.get(j.state, 0) + 1
         hist["depth"] = depth
         return hist
+
+    def tenants(self) -> dict[str, dict]:
+        """Per-tenant fair-queue state (stats + the Prometheus
+        spgemmd_tenant_queue_depth series): queued depth, in-flight count
+        and jobs served this residency, for every tenant with live
+        state."""
+        with self._lock:
+            names = set(self._queues) | set(self._inflight) \
+                | set(self._served)
+            return {t: {"queued": len(self._queues.get(t, ())),
+                        "inflight": self._inflight.get(t, 0),
+                        "served": self._served.get(t, 0)}
+                    for t in sorted(names)}

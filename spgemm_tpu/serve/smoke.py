@@ -21,7 +21,14 @@ tiles mutated in one operand, and asserts the serving contract:
     cache), zero `plan_cache` scoped hits but warm-loaded plans, and a
     DELTA recompute (`delta_rows == 0 < total_rows`, zero
     `delta_full_fallbacks`) against the rehydrated retained result --
-    bit-exact again, clean shutdown again.
+    bit-exact again, clean shutdown again;
+  * CONCURRENCY LEG (the device-pool proof, SPGEMM_TPU_SERVE_SLICES): a
+    THIRD daemon with a 2-slice pool takes two same-cost jobs submitted
+    back-to-back, which must OVERLAP -- the second job's
+    `serve_queue_wait` stays well under the first job's `serve_execute`
+    wall (a single-executor daemon would serialize them), the two jobs
+    land on two different slices, and both results stay bit-exact vs
+    the oracle -- clean shutdown once more.
 
 Any step failing exits nonzero.  This process itself stays jax-free (the
 oracle and the generator are pure numpy) -- only the daemon touches a
@@ -210,13 +217,82 @@ def main() -> int:
         if rc != 0:
             return _fail(proc, f"restarted daemon exited {rc} after "
                                "shutdown")
+
+        # ---- concurrency leg: the device-pool proof (2 slices) ----
+        # two fresh same-cost chains (cold shapes: their plan + jit are
+        # the measurable part of serve_execute) on a 2-slice daemon; the
+        # jobs must overlap, not serialize
+        sock2 = os.path.join(tmp, "pool.sock")
+        folders, wants = [], []
+        for i, seed in enumerate((21, 22)):
+            f = os.path.join(tmp, f"conc_{i}")
+            cm = random_chain(4, 12, 8, 0.4,
+                              np.random.default_rng(seed), "full")
+            io_text.write_chain_dir(f, cm, 8)
+            w = chain_oracle([m.to_dict() for m in cm], 8)
+            wants.append(io_text.format_matrix(BlockSparseMatrix.from_dict(
+                cm[0].rows, cm[-1].cols, 8, w).prune_zeros()))
+            folders.append(f)
+        env2 = dict(env)
+        env2["SPGEMM_TPU_SERVE_SLICES"] = "2"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+             "--socket", sock2, "--device", "cpu", "-v"],
+            env=env2, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        deadline = time.time() + 120
+        while not os.path.exists(sock2):
+            if proc.poll() is not None:
+                return _fail(proc, "pool daemon exited before binding "
+                                   "its socket")
+            if time.time() > deadline:
+                return _fail(proc, "pool daemon never bound its socket")
+            time.sleep(0.1)
+        ids = [client.submit(f, sock2,
+                             {"output": f + ".out"})["id"]
+               for f in folders]  # back-to-back: overlap or serialize
+        jobs = []
+        for jid in ids:
+            r = client.wait(jid, sock2, timeout=300)
+            if r["job"]["state"] != "done":
+                return _fail(proc, f"pool job {jid} ended "
+                                   f"{r['job']['state']}: "
+                                   f"{r['job']['error']}")
+            jobs.append(r["job"])
+        for i, f in enumerate(folders):
+            if open(f + ".out", "rb").read() != wants[i]:
+                return _fail(proc, f"pool job {i + 1} output does not "
+                                   "match the oracle bytes")
+        slices_used = {j["detail"].get("slice") for j in jobs}
+        if len(slices_used) != 2:
+            return _fail(proc, "the two pool jobs did not land on two "
+                               f"slices (got {slices_used})")
+        a_exec = jobs[0]["detail"]["phases_s"].get("serve_execute", 0.0)
+        b_wait = jobs[1]["detail"]["phases_s"].get("serve_queue_wait",
+                                                   1e9)
+        # overlap: job 2 was picked up while job 1 was still executing
+        # (a single-executor daemon would give b_wait >= a_exec)
+        if not (a_exec > 0.05 and b_wait < 0.5 * a_exec):
+            return _fail(proc, "pool jobs did not overlap: job2 "
+                               f"queue_wait={b_wait:.3f}s vs job1 "
+                               f"execute={a_exec:.3f}s (want "
+                               "queue_wait < 0.5 * execute)")
+        client.shutdown(sock2)
+        try:
+            rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            return _fail(proc, "pool daemon did not exit after shutdown")
+        if rc != 0:
+            return _fail(proc, f"pool daemon exited {rc} after shutdown")
     finally:
         if proc.poll() is None:
             proc.kill()
     print(f"serve-smoke: OK (3 jobs bit-exact vs oracle, warm hits={hits}, "
           f"delta rows {delta_rows}/{total_rows}; restart leg: "
-          f"warm_hits={warm_hits}, clean delta {d4_rows}/{t4_rows}, "
-          "clean shutdown x2)")
+          f"warm_hits={warm_hits}, clean delta {d4_rows}/{t4_rows}; "
+          f"pool leg: 2 jobs overlapped on {sorted(slices_used)} "
+          f"(queue_wait {b_wait:.3f}s vs execute {a_exec:.3f}s), "
+          "bit-exact both; clean shutdown x3)")
     return 0
 
 

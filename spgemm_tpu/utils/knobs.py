@@ -207,6 +207,30 @@ _KNOBS = (
          "<tmpdir>/spgemmd-<uid>.sock); the on-disk job journal lives "
          "next to it at <socket>.journal.",
          "serve/protocol.py"),
+    Knob("SPGEMM_TPU_SERVE_SLICES", "str",
+         "spgemmd device-pool slice spec (parallel/mesh.slice_pool): "
+         "terms [COUNTx]WIDTH[*] joined by '+', or 'auto' (one "
+         "single-device slice per visible device plus one full-mesh "
+         "slice).  Each slice gets its own executor thread with its own "
+         "warm per-placement delta/warm state, and the placement "
+         "scheduler routes jobs by the estimator's predicted mass (cheap "
+         "-> narrowest free slice, webbase-class -> widest, first "
+         "contact -> the '*'-marked default term, else the narrowest "
+         "class) with work-stealing when a slice idles.  Example: "
+         "'1x4+4' = one 4-device slice plus four singles.  The default "
+         "'1' is one single-device executor -- exactly the pre-pool "
+         "daemon (the whole-pool A/B).  An unparsable or overcommitted "
+         "spec fails daemon startup loudly (never a silently smaller "
+         "pool).",
+         "serve/daemon.py", default="1"),
+    Knob("SPGEMM_TPU_SERVE_TENANT_INFLIGHT", "int",
+         "spgemmd per-tenant in-flight cap (queued + running jobs per "
+         "tenant): a submit arriving with this many of its tenant's jobs "
+         "already in flight is rejected with a structured tenant-cap "
+         "error -- one chatty client cannot fill the whole admission "
+         "queue.  Unset = no per-tenant cap (the pre-pool behavior); "
+         "the global SPGEMM_TPU_SERVE_QUEUE_CAP always applies on top.",
+         "serve/queue.py", minimum=1),
     Knob("SPGEMM_TPU_SERVE_QUEUE_CAP", "int",
          "spgemmd admission cap: a submit arriving with this many jobs "
          "already queued is rejected with a structured queue-full error "

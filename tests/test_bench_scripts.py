@@ -115,6 +115,26 @@ def test_planner_bench_delta_contract():
     assert fr[1]["rows_recomputed"] < fr[1]["total_rows"]
 
 
+def test_pool_bench_contract():
+    """benchmarks/pool_bench.py (tiny config): one JSON line with both
+    legs' makespans, the speedup, jobs/minute, and bit-exact parity in
+    BOTH legs -- the device-pool acceptance bench's wire contract."""
+    rc = _run([os.path.join("benchmarks", "pool_bench.py"),
+               "--small", "1", "--chain", "3", "--small-dim", "5",
+               "--large-dim", "8", "--k", "4", "--slices", "2"],
+              timeout=540)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    row = json.loads(rc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "pool_batch_makespan"
+    d = row["detail"]
+    assert d["parity"] is True
+    assert d["makespan_1slice_s"] > 0 and d["makespan_pool_s"] > 0
+    assert d["speedup_vs_1slice"] is not None
+    assert d["jobs"] == 2 and d["jobs_per_min_pool"] > 0
+    # per-job placement detail rides along (slice names + queue waits)
+    assert {j["slice"] for j in d["per_job_pool"]} <= {"s0w1", "s1w1"}
+
+
 def test_bench_single_chain_no_crash():
     rc = _run(["bench.py", "--chain", "1", "--block-dim", "8",
                "--bandwidth", "1", "--k", "8", "--iters", "1",

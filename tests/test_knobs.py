@@ -53,6 +53,8 @@ def test_whitespace_and_empty_fall_back_to_default(monkeypatch):
     ("SPGEMM_TPU_DCN_CHUNK_MB", "-1"),
     ("SPGEMM_TPU_DCN_CHUNK_MB", "lots"),
     ("SPGEMM_TPU_HYBRID_GATE", "maybe"),
+    ("SPGEMM_TPU_SERVE_TENANT_INFLIGHT", "0"),
+    ("SPGEMM_TPU_SERVE_TENANT_INFLIGHT", "many"),
 ])
 def test_invalid_values_raise_naming_the_knob(monkeypatch, name, bad):
     """The round-5 contract ('a documented knob that crashes later' trap):

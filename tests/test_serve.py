@@ -545,7 +545,7 @@ def test_reaped_slow_job_aborts_and_executor_serves_on(tmp_path,
     resp = client.wait(j2["id"], d.socket_path, timeout=30)
     assert resp["job"]["state"] == "done"
     assert d.degraded is False
-    assert d._executor_gen == 1  # still the original executor thread
+    assert d.slices[0].gen == 1  # still the original executor thread
 
 
 def test_reaped_job_keeps_its_phase_detail(tmp_path, make_daemon):

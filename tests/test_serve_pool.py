@@ -1,0 +1,585 @@
+"""Device-pool scheduler (PR 12): slice specs (parallel/mesh.slice_pool),
+per-tenant fair queuing + in-flight caps (serve/queue), estimator-priced
+placement + work stealing (serve/placement, Daemon._accepts), per-slice
+watchdog degrade, the client wait backoff, and the protocol v2 tenant
+field -- tier-1 on the 8-vdev CPU backend (injected runners everywhere
+the engine itself is not the subject)."""
+
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.parallel import mesh
+from spgemm_tpu.serve import client, placement, protocol
+from spgemm_tpu.serve.daemon import Daemon
+from spgemm_tpu.serve.queue import (Job, JobQueue, TenantCapExceeded)
+from spgemm_tpu.utils import io_text
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_chain
+from spgemm_tpu.utils.semantics import chain_oracle
+
+
+def _chain_folder(tmp_path, n=3, k=2, seed=7, name="chain_in"):
+    """A reference-format input dir + the oracle's output bytes."""
+    mats = random_chain(n, 4, k, 0.5, np.random.default_rng(seed), "full")
+    folder = str(tmp_path / name)
+    io_text.write_chain_dir(folder, mats, k)
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, k, want).prune_zeros())
+    return folder, want_bytes
+
+
+@pytest.fixture
+def make_daemon(tmp_path):
+    """Daemon factory bound to a per-test socket; stops them on teardown."""
+    daemons = []
+
+    def _make(idx=0, **kw):
+        d = Daemon(str(tmp_path / f"d{idx}.sock"), **kw)
+        d.start()
+        daemons.append(d)
+        return d
+
+    yield _make
+    for d in daemons:
+        d.stop()
+
+
+def _wait_until(pred, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ------------------------------------------------------------ slice spec --
+def test_slice_spec_terms_and_device_assignment():
+    """The `1x4+4` idiom: one 4-device slice (devices 0-3) plus four
+    singles (4-7), in declaration order."""
+    pool = mesh.slice_pool("1x4+4", 8)
+    assert [s.width for s in pool] == [4, 1, 1, 1, 1]
+    assert pool[0].device_ids == (0, 1, 2, 3)
+    assert [s.device_ids for s in pool[1:]] == [(4,), (5,), (6,), (7,)]
+    # no '*': the narrowest width class is the default placement
+    assert [s.default for s in pool] == [False, True, True, True, True]
+    # names are stable and carry the width
+    assert pool[0].name == "s0w4" and pool[1].name == "s1w1"
+
+
+def test_slice_spec_star_marks_default():
+    pool = mesh.slice_pool("1x4*+4", 8)
+    assert [s.default for s in pool] == [True, False, False, False, False]
+
+
+def test_slice_spec_single_is_one_single_device_slice():
+    pool = mesh.slice_pool("1", None)  # no device count needed
+    assert len(pool) == 1 and pool[0].device_ids == (0,)
+
+
+def test_slice_spec_auto_builds_singles_plus_full_mesh():
+    pool = mesh.slice_pool("auto", 4)
+    assert [s.width for s in pool] == [1, 1, 1, 1, 4]
+    assert pool[-1].device_ids == (0, 1, 2, 3)
+    assert all(s.default for s in pool[:4]) and not pool[-1].default
+    assert pool[-1].overlaps(pool[0])
+
+
+@pytest.mark.parametrize("spec", ["", "bogus", "0x2", "2x0", "4x"])
+def test_slice_spec_garbage_raises_naming_the_spec(spec):
+    with pytest.raises(mesh.SliceSpecError):
+        mesh.parse_slice_spec(spec, 8)
+
+
+def test_slice_spec_overcommit_and_auto_need_devices():
+    with pytest.raises(mesh.SliceSpecError, match="12 devices"):
+        mesh.parse_slice_spec("1x4+8", 8)
+    with pytest.raises(mesh.SliceSpecError, match="device count"):
+        mesh.parse_slice_spec("auto", None)
+    # explicit specs are trusted when the count is unknown
+    assert mesh.parse_slice_spec("1x4+8", None)
+
+
+# ---------------------------------------------------------- fair queuing --
+def test_tenant_round_robin_no_starvation():
+    """The satellite contract: a chatty tenant's burst never starves a
+    quiet tenant's single job past one round -- it is served on the very
+    next pop after its submit."""
+    q = JobQueue(cap=16)
+    chatty = [Job(f"a{i}", "f", "o", {}, tenant="chatty")
+              for i in range(4)]
+    for j in chatty:
+        q.submit(j)
+    quiet = Job("b0", "f", "o", {}, tenant="quiet")
+    q.submit(quiet)
+    order = [q.next(0.01).id for _ in range(5)]
+    assert order[0] == "a0"           # chatty was first in
+    assert "b0" in order[:2]          # quiet lands within its round
+    assert order.count("b0") == 1
+    # within a tenant, strict FIFO
+    assert [i for i in order if i.startswith("a")] == \
+        ["a0", "a1", "a2", "a3"]
+
+
+def test_tenant_absent_maps_to_default_and_rides_snapshot():
+    j = Job("j1", "f", "o", {})
+    assert j.tenant == protocol.DEFAULT_TENANT
+    snap = j.snapshot()
+    assert snap["tenant"] == protocol.DEFAULT_TENANT
+    assert snap["slice"] is None and snap["placement"] is None
+
+
+def test_tenant_inflight_cap_is_structured_and_releases():
+    q = JobQueue(cap=16, tenant_inflight=2)
+    a, b = (Job(f"j{i}", "f", "o", {}, tenant="t") for i in (1, 2))
+    q.submit(a)
+    q.submit(b)
+    with pytest.raises(TenantCapExceeded) as ei:
+        q.submit(Job("j3", "f", "o", {}, tenant="t"))
+    assert ei.value.tenant == "t" and ei.value.cap == 2
+    # another tenant is not capped by t's flight
+    q.submit(Job("other", "f", "o", {}, tenant="u"))
+    # a terminal release frees the slot (queued jobs count as in flight
+    # until released)
+    a2 = q.next(0.01)
+    a2.start()
+    a2.finish("done")
+    q.release(a2)
+    q.submit(Job("j4", "f", "o", {}, tenant="t"))  # fits again
+    assert q.tenants()["t"]["inflight"] == 2
+
+
+def test_release_of_never_admitted_job_frees_no_slot():
+    """The journal-replay rejection path finishes (and releases) a job
+    whose submit RAISED: that release must not decrement an in-flight
+    slot an admitted job owns, or the tenant cap silently widens."""
+    q = JobQueue(cap=16, tenant_inflight=2)
+    for i in (1, 2):
+        q.submit(Job(f"j{i}", "f", "o", {}, tenant="t"))
+    rej = Job("j3", "f", "o", {}, tenant="t")
+    with pytest.raises(TenantCapExceeded):
+        q.submit(rej)
+    rej.finish("failed", error={"code": "tenant-cap", "message": "x"})
+    q.release(rej)  # what _observe_terminal does on the replay path
+    assert q.tenants()["t"]["inflight"] == 2  # slots intact
+    with pytest.raises(TenantCapExceeded):
+        q.submit(Job("j4", "f", "o", {}, tenant="t"))
+
+
+def test_tenant_cap_rejection_is_a_wire_error_not_a_hang(tmp_path,
+                                                         make_daemon):
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        release.wait(30)
+
+    d = make_daemon(runner=runner, tenant_inflight=1)
+    try:
+        client.submit(folder, d.socket_path, tenant="chatty")
+        with pytest.raises(client.ServeError) as ei:
+            client.submit(folder, d.socket_path, tenant="chatty")
+        assert ei.value.code == protocol.E_TENANT_CAP
+        # a different tenant is admitted; stats reports both tenants
+        client.submit(folder, d.socket_path, tenant="quiet")
+        st = client.stats(d.socket_path)
+        assert "chatty" in st["tenants"]
+        assert st["tenant_inflight_cap"] == 1
+    finally:
+        release.set()
+
+
+def test_bad_tenant_name_is_bad_request(tmp_path, make_daemon):
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    for bad in ("", "has space", "x" * 65, 7):
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(10.0)
+            s.connect(d.socket_path)
+            s.sendall(protocol.encode({"v": protocol.PROTOCOL_VERSION,
+                                       "op": "submit", "folder": folder,
+                                       "tenant": bad}))
+            resp = json.loads(next(protocol.read_lines(s)))
+        assert resp["ok"] is False
+        assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+
+
+def test_protocol_v1_requests_still_served(make_daemon):
+    """The version bump is backward compatible: a v1 client (no tenant
+    field) keeps working against the v2 daemon."""
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(10.0)
+        s.connect(d.socket_path)
+        s.sendall(protocol.encode({"v": 1, "op": "stats"}))
+        resp = json.loads(next(protocol.read_lines(s)))
+    assert resp["ok"] is True and resp["daemon"] == "spgemmd"
+
+
+def test_client_requests_stay_v1_unless_tenant_used(tmp_path, make_daemon,
+                                                    monkeypatch):
+    """Rolling-upgrade compatibility the other way: the upgraded client
+    stamps v1 on every request that carries no v2 feature (a still-v1
+    daemon's strict version check would reject a blanket v2 stamp), and
+    bumps to v2 exactly when a tenant rides along."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    sent = []
+    real_encode = protocol.encode
+    # protocol.encode is shared with the in-process daemon's responses:
+    # keep only REQUEST messages (they carry an op)
+    monkeypatch.setattr(client.protocol, "encode",
+                        lambda msg: sent.append(msg) or real_encode(msg))
+    client.stats(d.socket_path)
+    client.submit(folder, d.socket_path)
+    reqs = [m for m in sent if "op" in m]
+    assert [m["v"] for m in reqs] == [1, 1]
+    client.submit(folder, d.socket_path, tenant="alice")
+    reqs = [m for m in sent if "op" in m]
+    assert reqs[-1]["v"] == protocol.PROTOCOL_VERSION
+
+
+def test_accept_claims_slice_under_the_queue_lock(tmp_path, make_daemon):
+    """Overlapping-slice mutual exclusion is decided at the ACCEPT, not
+    at the executor's later bookkeeping: a predicate that returns True
+    claims sl.current immediately, so an overlapping slice probing
+    _devices_held in the same dispatch round can never double-book the
+    device."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None,
+                    slices="auto", n_devices=2)
+    d._stop.set()  # freeze the executors; we drive the predicate by hand
+    for sl in d.slices:
+        sl.thread.join(timeout=5.0)
+        sl.current = None
+    single, full = d.slices[0], d.slices[2]
+    from spgemm_tpu.serve.queue import Job as _Job
+    j1 = _Job("c1", folder, "o", {})
+    j2 = _Job("c2", folder, "o", {})
+    j2.placement = {"class": "large"}  # prefers the full-mesh slice
+    assert d._accepts(single, j1) is True
+    assert single.current is j1  # claimed at accept time
+    # the full-mesh slice shares device 0 with the claimed single: it
+    # must refuse j2 in the same round, not dispatch concurrently
+    assert d._accepts(full, j2) is False
+
+
+def test_lone_wide_slice_pins_all_its_devices(tmp_path, make_daemon):
+    """`--slices 1x4` (one wide slice, nothing else) must shard over its
+    devices, never silently shrink to the single-device legacy path."""
+    folder, _ = _chain_folder(tmp_path)
+    seen = {}
+
+    def runner(job, degraded=False):
+        seen["device_ids"] = job.device_ids
+
+    d = make_daemon(runner=runner, slices="1x4", n_devices=4)
+    j = client.submit(folder, d.socket_path)
+    resp = client.wait(j["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "done"
+    assert seen["device_ids"] == (0, 1, 2, 3)
+
+
+def test_one_degraded_slice_keeps_daemon_reason_null(tmp_path,
+                                                     make_daemon):
+    """The pre-pool alerting contract: daemon-level degrade_reason is set
+    if-and-only-if the daemon-level degraded flag is -- a healthy pool
+    with one bad slice reports the reason per-slice only."""
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    first = threading.Event()
+
+    def runner(job, degraded=False):
+        if not first.is_set() and not degraded:
+            first.set()
+            unwedge.wait(60)
+
+    d = make_daemon(runner=runner, slices="2", n_devices=2,
+                    job_timeout_s=0.3, wedge_grace_s=0.2,
+                    probe=lambda: "timeout")
+    try:
+        j = client.submit(folder, d.socket_path)
+        client.wait(j["id"], d.socket_path, timeout=30)
+        _wait_until(lambda: any(s.degraded for s in d.slices),
+                    msg="wedged slice degrades")
+        st = client.stats(d.socket_path)
+        assert st["degraded"] is False
+        assert st["degrade_reason"] is None          # daemon-level: null
+        bad = next(s for s in st["slices"] if s["degraded"])
+        assert bad["degrade_reason"]                 # slice-level: set
+    finally:
+        unwedge.set()
+
+
+# ------------------------------------------------------------- placement --
+def test_placement_route_classes(tmp_path, monkeypatch):
+    placement.clear()
+    folder, _ = _chain_folder(tmp_path, name="routed")
+    # first contact, small input: the spec's default slice
+    assert placement.route(folder)["class"] == "default"
+    # priced: below the webbase threshold -> small, above -> large
+    placement.note_mass(folder, 10.0)
+    assert placement.route(folder) == {
+        "class": "small", "source": "estimate", "mass": 10.0}
+    placement.note_mass(folder, placement.LARGE_MASS_PAIRS * 2)
+    assert placement.route(folder)["class"] == "large"
+    # a content change invalidates the stat-signature key: re-priced
+    time.sleep(0.01)
+    (tmp_path / "routed" / "matrix1").write_text(
+        (tmp_path / "routed" / "matrix1").read_text() + " ")
+    assert placement.route(folder)["class"] == "default"
+    # first contact, webbase-class bytes: wide without an estimate
+    monkeypatch.setattr(placement, "LARGE_INPUT_BYTES", 1)
+    got = placement.route(folder)
+    assert got["class"] == "large" and got["source"] == "bytes"
+    st = placement.stats()
+    assert st["book_entries"] >= 1 and st["routed"]["large"] >= 2
+
+
+def test_estimate_chain_mass_prices_first_pass_pairs():
+    from spgemm_tpu.ops import estimate
+
+    a = np.array([[0, 0], [0, 1], [1, 0]], np.int64)
+    b = np.array([[0, 0], [1, 1]], np.int64)
+    # exact tiny join: rows of a join b's row index -> 3 pairs
+    assert estimate.pair_mass(a, b) == 3.0
+    # helper2 first pass: (0,1) only for a 3-chain
+    assert estimate.chain_mass([a, b, a]) == 3.0
+    assert estimate.chain_mass([a]) == 0.0
+
+
+# ----------------------------------------------------- pool dispatching --
+def test_two_slices_run_jobs_concurrently(tmp_path, make_daemon):
+    folder, _ = _chain_folder(tmp_path)
+    started, release = [], threading.Event()
+
+    def runner(job, degraded=False):
+        started.append(job.id)
+        release.wait(30)
+
+    d = make_daemon(runner=runner, slices="2", n_devices=2)
+    try:
+        for _ in range(2):
+            client.submit(folder, d.socket_path)
+        # a single-executor daemon can never have two jobs in flight
+        _wait_until(lambda: len(started) == 2,
+                    msg="two jobs running concurrently")
+        st = client.stats(d.socket_path)
+        assert sum(1 for s in st["slices"] if s["busy"]) == 2
+    finally:
+        release.set()
+
+
+def test_single_slice_default_is_legacy_executor(tmp_path, make_daemon):
+    """SPGEMM_TPU_SERVE_SLICES=1 (the default) is the whole-pool A/B:
+    one slice, and jobs run with default (uncommitted) device placement
+    exactly like the pre-pool daemon."""
+    folder, _ = _chain_folder(tmp_path)
+    seen = {}
+
+    def runner(job, degraded=False):
+        seen["device_ids"] = job.device_ids
+        seen["slice"] = job.slice
+
+    d = make_daemon(runner=runner)
+    assert len(d.slices) == 1 and d.slices[0].width == 1
+    j = client.submit(folder, d.socket_path)
+    resp = client.wait(j["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "done"
+    assert seen["device_ids"] is None        # legacy default placement
+    assert seen["slice"] == d.slices[0].name
+
+
+def test_work_stealing_when_preferred_slice_busy(tmp_path, make_daemon):
+    """An idle off-class slice takes the job when every preferred slice
+    is busy: `1x2+1` has one wide + one (default) narrow slice, so the
+    second default-class job is stolen by the wide slice instead of
+    queueing behind the narrow one."""
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        release.wait(30)
+
+    d = make_daemon(runner=runner, slices="1x2+1", n_devices=3)
+    try:
+        narrow = next(s.name for s in d.slices if s.width == 1)
+        wide = next(s.name for s in d.slices if s.width == 2)
+        j1 = client.submit(folder, d.socket_path)
+        _wait_until(lambda: any(s.current for s in d.slices),
+                    msg="first job picked up")
+        j2 = client.submit(folder, d.socket_path)
+        _wait_until(lambda: sum(1 for s in d.slices if s.current) == 2,
+                    msg="second job stolen by the idle slice")
+        snap1 = client.status(j1["id"], d.socket_path)["job"]
+        snap2 = client.status(j2["id"], d.socket_path)["job"]
+        assert snap1["slice"] == narrow and not snap1["stolen"]
+        assert snap2["slice"] == wide and snap2["stolen"]
+        st = client.stats(d.socket_path)
+        assert next(s for s in st["slices"]
+                    if s["name"] == wide)["steals"] == 1
+    finally:
+        release.set()
+
+
+def test_overlapping_slices_are_mutually_exclusive(tmp_path, make_daemon):
+    """`auto`'s full-mesh slice shares devices with the singles: it must
+    not dispatch while a device-owning single is busy."""
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        release.wait(30)
+
+    d = make_daemon(runner=runner, slices="auto", n_devices=2)
+    try:
+        for _ in range(3):
+            client.submit(folder, d.socket_path)
+        _wait_until(lambda: sum(1 for s in d.slices if s.current) == 2,
+                    msg="both singles busy")
+        time.sleep(0.6)  # give the full-mesh slice every chance to err
+        full = next(s for s in d.slices if s.width == 2)
+        assert full.current is None  # its devices are held by the singles
+        assert client.stats(d.socket_path)["jobs"]["queued"] == 1
+    finally:
+        release.set()
+
+
+# -------------------------------------------------- per-slice degrade ----
+def test_one_wedged_slice_degrades_alone(tmp_path, make_daemon):
+    """The acceptance contract: one wedged slice degrades (CPU failover)
+    and is excluded from placement while the rest keep serving; stats and
+    the Prometheus per-slice series expose it."""
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    first = threading.Event()
+
+    def runner(job, degraded=False):
+        if not first.is_set() and not degraded:
+            first.set()
+            unwedge.wait(60)  # hung backend call: no beats, no return
+
+    d = make_daemon(runner=runner, slices="2", n_devices=2,
+                    job_timeout_s=0.3, wedge_grace_s=0.2,
+                    probe=lambda: "timeout")
+    try:
+        j1 = client.submit(folder, d.socket_path)
+        resp = client.wait(j1["id"], d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "failed"
+        assert resp["job"]["error"]["code"] == protocol.E_JOB_TIMEOUT
+        _wait_until(lambda: any(s.degraded for s in d.slices),
+                    msg="wedged slice degrades")
+        # the POOL is not degraded: one healthy slice remains
+        assert d.degraded is False
+        # and it keeps serving new jobs on the device path
+        j2 = client.submit(folder, d.socket_path, {"timeout_s": 0})
+        resp2 = client.wait(j2["id"], d.socket_path, timeout=30)
+        assert resp2["job"]["state"] == "done"
+        assert resp2["job"]["detail"]["degraded"] is False
+        st = client.stats(d.socket_path)
+        assert st["degraded"] is False
+        assert st["slices_degraded"] == 1
+        bad = next(s for s in st["slices"] if s["degraded"])
+        assert bad["degrade_reason"]
+        # the scrape surface carries the per-slice series
+        text = client.metrics(d.socket_path)
+        assert f'spgemm_slice_degraded{{slice="{bad["name"]}"}} 1' in text
+        assert "spgemm_slice_busy{" in text
+        assert "spgemm_slice_jobs_total{" in text
+    finally:
+        unwedge.set()
+
+
+def test_all_slices_degraded_still_serves_and_flags_daemon(tmp_path,
+                                                           make_daemon):
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    hangs = []
+
+    def runner(job, degraded=False):
+        if not degraded and len(hangs) < 2:
+            hangs.append(job.id)
+            unwedge.wait(60)
+
+    d = make_daemon(runner=runner, slices="2", n_devices=2,
+                    job_timeout_s=0.3, wedge_grace_s=0.2,
+                    probe=lambda: "timeout")
+    try:
+        for _ in range(2):
+            j = client.submit(folder, d.socket_path)
+            client.wait(j["id"], d.socket_path, timeout=30)
+        _wait_until(lambda: all(s.degraded for s in d.slices),
+                    msg="both slices degrade")
+        assert d.degraded is True  # the whole pool is down
+        # degraded slices still serve, host-only
+        j = client.submit(folder, d.socket_path, {"timeout_s": 0})
+        resp = client.wait(j["id"], d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "done"
+        assert resp["job"]["detail"]["degraded"] is True
+    finally:
+        unwedge.set()
+
+
+# ------------------------------------------------------- client backoff --
+def test_client_wait_backs_off_between_slices(tmp_path, make_daemon,
+                                              monkeypatch):
+    """The satellite regression: a slow job must not make the waiter
+    hammer the accept loop -- reconnects between expired wait slices are
+    exponentially spaced (capped), so the request count stays near
+    logarithmic in the wait, not linear."""
+    folder, _ = _chain_folder(tmp_path)
+
+    def runner(job, degraded=False):
+        time.sleep(1.2)
+
+    d = make_daemon(runner=runner)
+    monkeypatch.setattr(client, "WAIT_SLICE_S", 0.05)
+    calls = []
+    real_request = client.request
+
+    def counting_request(msg, *a, **kw):
+        if msg.get("op") == "wait":
+            calls.append(time.time())
+        return real_request(msg, *a, **kw)
+
+    monkeypatch.setattr(client, "request", counting_request)
+    j = client.submit(folder, d.socket_path)
+    resp = client.wait(j["id"], d.socket_path, timeout=30)
+    assert resp["job"]["state"] == "done"
+    # 1.2 s of waiting at 0.05 s slices would be ~24 reconnects without
+    # backoff; the doubling schedule needs well under half that
+    assert 2 <= len(calls) <= 12
+    gaps = [b - a for a, b in zip(calls, calls[1:])]
+    assert max(gaps) > 0.15  # the backoff actually grew past the slice
+
+
+# ------------------------------------------------ real-engine pool proof --
+def test_pool_serves_real_engine_bit_exact_across_slices(tmp_path,
+                                                         make_daemon):
+    """Two real chain jobs through a 2-slice pool: both bit-exact vs the
+    oracle, each on its own slice with committed device placement --
+    slice width and placement steer wall, never bits."""
+    fa, wa = _chain_folder(tmp_path, seed=31, name="pool_a")
+    fb, wb = _chain_folder(tmp_path, seed=32, name="pool_b")
+    d = make_daemon(slices="2", n_devices=2)  # default runner: real engine
+    outs = {}
+    for folder in (fa, fb):
+        out = folder + ".out"
+        j = client.submit(folder, d.socket_path, {"output": out})
+        outs[folder] = (j["id"], out)
+    slices_used = set()
+    for folder, want in ((fa, wa), (fb, wb)):
+        jid, out = outs[folder]
+        resp = client.wait(jid, d.socket_path, timeout=300)
+        assert resp["job"]["state"] == "done", resp["job"]["error"]
+        assert open(out, "rb").read() == want
+        slices_used.add(resp["job"]["slice"])
+        # pool jobs carry committed per-slice placement
+        assert resp["job"]["detail"]["slice"] in ("s0w1", "s1w1")
+    assert len(slices_used) == 2
