@@ -22,7 +22,7 @@ MEMFLAG = $(MEMFLAG_$(MEM))
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test lint lint-sarif bench bench-large warm serve-smoke obs-smoke clean
+.PHONY: all native run test lint lint-sarif bench bench-large warm serve-smoke obs-smoke chaos-smoke clean
 
 all: native
 
@@ -90,6 +90,19 @@ serve-smoke:
 obs-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m spgemm_tpu.serve.obs_smoke
+
+# chaos end-to-end proof on CPU: a seeded randomized failpoint schedule
+# (SPGEMM_TPU_FAILPOINTS; utils/failpoints.py registry) against a live
+# 2-slice daemon -- every job must end bit-exact vs the oracle or with a
+# structured error, no hang past the watchdog window, one injected
+# executor wedge must degrade the slice and the recovery loop
+# (SPGEMM_TPU_SERVE_RECOVER_S) must reinstate it (recoveries >= 1), a
+# torn journal tail (injected + a harness-appended half frame) must
+# replay clean and counted on restart, and SIGTERM must drain and exit
+# 0; exits nonzero on any step.
+chaos-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m spgemm_tpu.serve.chaos_smoke
 
 # the reference's Large scale (1M tiles) through the out-of-core pipeline
 bench-large:

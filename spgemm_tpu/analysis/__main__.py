@@ -41,7 +41,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="spgemm-lint: package-level invariant checker (FLD fold "
                     "order incl. interprocedural taint, KNB knob registry, "
                     "BKD import-time backend touch, THR lock discipline, "
-                    "EXC exception contracts, SUP stale suppressions, DOC "
+                    "EXC exception contracts, MET metric registry, FPT "
+                    "failpoint registry, SUP stale suppressions, DOC "
                     "doc drift)",
         epilog=epilog)
     p.add_argument("paths", nargs="*",

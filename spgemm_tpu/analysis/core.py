@@ -73,6 +73,10 @@ RULES = {
     "MET": "ENGINE.phase/record/incr metric name that is not a string "
            "literal declared in the metrics registry "
            "spgemm_tpu/obs/metrics.py (no ad-hoc time-series names)",
+    "FPT": "failpoints.check() name that is not a string literal "
+           "declared in the failpoint registry "
+           "spgemm_tpu/utils/failpoints.py, or a registry entry with no "
+           "check() site anywhere in the package (stale chaos surface)",
     "DOC": "generated doc drift (CLAUDE.md knob table, ARCHITECTURE.md "
            "metrics table, CLI help knob coverage, analysis --help "
            "rule-id coverage)",
@@ -199,8 +203,8 @@ def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
     filter is applied here, so the same pass yields both the surviving
     findings and the raw (file, rule, line) triples the suppression audit
     needs to tell used escapes from stale ones."""
-    from spgemm_tpu.analysis import (excrules, metrules, rules,  # noqa: PLC0415
-                                     thrrules)
+    from spgemm_tpu.analysis import (excrules, fptrules, metrules,  # noqa: PLC0415
+                                     rules, thrrules)
 
     if unit.tree is None:
         return [unit.parse_finding], set()
@@ -227,6 +231,7 @@ def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
     findings += escaping(thrrules.check_thr(unit, set()), "THR")
     findings += escaping(excrules.check_exc(unit, set()), "EXC")
     findings += metrules.check_met(unit.tree, unit.file)
+    findings += fptrules.check_fpt(unit.tree, unit.file)
     return findings, raw
 
 
@@ -280,7 +285,7 @@ def lint_report(paths: list[str], *, claude_md: str | None = None,
     are SUP findings; the full inventory is returned for --json), and
     optionally the DOC drift checks (claude_md None = skip the table
     check; the CLI/analysis help checks ride the same flag)."""
-    from spgemm_tpu.analysis import callgraph, docrules  # noqa: PLC0415
+    from spgemm_tpu.analysis import callgraph, docrules, fptrules  # noqa: PLC0415
 
     units = [LintUnit(f) for path in paths for f in _walk_py(path)]
     findings: list[Finding] = []
@@ -289,6 +294,10 @@ def lint_report(paths: list[str], *, claude_md: str | None = None,
         unit_findings, unit_raw = _lint_unit(u)
         findings += unit_findings
         raw |= unit_raw
+    # the FPT stale-entry direction needs the whole unit set (a registry
+    # entry is live if ANY module checks it); it self-gates on the
+    # registry module being in scope, so fixture runs stay quiet
+    findings += fptrules.check_fpt_registry(units)
     cg_findings, cg_raw, cg_used = callgraph.check(units)
     findings += cg_findings
     # interprocedural raw findings feed the audit exactly like per-file

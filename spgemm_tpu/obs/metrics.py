@@ -87,6 +87,10 @@ ENGINE_COUNTERS: dict[str, str] = {
     "serve_steals": "spgemmd pool work steals: jobs taken by an idle "
                     "slice outside their preferred slice class (every "
                     "preferred slice was busy or degraded)",
+    "serve_recoveries": "spgemmd self-healing slice reinstatements: a "
+                        "degraded slice whose recovery re-probe "
+                        "(SPGEMM_TPU_SERVE_RECOVER_S) came back live "
+                        "rejoined placement behind the canary gate",
     "warm_hits": "warm-start store hits: a plan or delta entry a "
                  "previous process persisted was deserialized and "
                  "served (ops/warmstore)",
@@ -202,6 +206,12 @@ _METRICS = (
            "Jobs this slice STOLE (its class was not the job's preferred "
            "placement, but every preferred slice was busy/degraded).",
            "serve/daemon.py", labels=("slice",)),
+    Metric("spgemm_slice_recoveries_total", "counter",
+           "Times this degraded slice was reinstated into placement by "
+           "the self-healing recovery loop (SPGEMM_TPU_SERVE_RECOVER_S "
+           "re-probe came back live; the first job after each "
+           "reinstatement runs under the canary gate).",
+           "serve/daemon.py", labels=("slice",)),
     Metric("spgemmd_tenant_queue_depth", "gauge",
            "Jobs queued per fair-queuing tenant (tenants with no queued "
            "or in-flight jobs are retired from the series).",
@@ -221,7 +231,9 @@ _METRICS = (
            "raised), timeout (watchdog reap -- a later wedge declaration "
            "does not re-count the job; alert on spgemmd_degraded / "
            "serve_degrades for wedges), abandoned (executor thread died "
-           "mid-job).",
+           "mid-job), drained (reaped by a graceful shutdown past "
+           "DRAIN_GRACE_S -- routine on rollouts, never an executor-"
+           "death signal).",
            "serve/daemon.py", labels=("outcome",)),
     Metric("spgemmd_journal_bytes", "gauge",
            "On-disk size of the job journal next to the socket.",
@@ -230,6 +242,19 @@ _METRICS = (
            "Journal compactions since daemon start (startup replay "
            "included).",
            "serve/daemon.py"),
+    Metric("spgemmd_journal_torn_total", "counter",
+           "Journal tears detected during replay or compaction "
+           "(CRC32/length frame mismatch -- the mid-write-kill "
+           "signature): one count per truncation at the first bad "
+           "record, never a crash.  Everything after the tear is "
+           "unattributable and dropped with it, so this counts tears, "
+           "not dropped records.",
+           "serve/daemon.py"),
+    Metric("spgemm_failpoints_triggered_total", "counter",
+           "Chaos failpoint triggers per registered injection point "
+           "(utils/failpoints.py registry, armed via "
+           "SPGEMM_TPU_FAILPOINTS; zero series when unarmed).",
+           "utils/failpoints.py", labels=("point",)),
     Metric("spgemmd_job_wall_seconds", "histogram",
            "Per-job wall time start-to-terminal (reaped jobs included).",
            "serve/daemon.py"),
@@ -450,6 +475,9 @@ def collect_engine() -> list[tuple]:
         ("spgemm_trace_spans_emitted_total", {}, ring["emitted"]),
         ("spgemm_trace_spans_dropped_total", {}, ring["dropped"]),
     ]
+    from spgemm_tpu.utils import failpoints  # noqa: PLC0415
+    samples += [("spgemm_failpoints_triggered_total", {"point": point}, n)
+                for point, n in sorted(failpoints.triggered().items())]
     samples += _collect_profile()
     return samples
 

@@ -449,6 +449,9 @@ def diff(entry: DeltaEntry, a, b, join, a_coords: np.ndarray,
          b_coords: np.ndarray) -> DeltaDiff | None:
     """Diff both operands against the entry's provenance and propagate
     through the join; None on any lineage ambiguity (full fallback)."""
+    from spgemm_tpu.utils import failpoints  # noqa: PLC0415
+    if failpoints.check("delta.diff"):
+        return None  # injected lineage ambiguity: counted full fallback
     got_a = _operand_dirty(entry.a_src, a)
     if got_a is None:
         return None

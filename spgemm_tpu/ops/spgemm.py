@@ -30,7 +30,7 @@ import numpy as np
 from spgemm_tpu.obs import events as obs_events
 from spgemm_tpu.obs import profile as obs_profile
 from spgemm_tpu.ops import estimate, plancache, u64, warmstore
-from spgemm_tpu.utils import knobs
+from spgemm_tpu.utils import failpoints, knobs
 from spgemm_tpu.ops.symbolic import (SpgemmPlan, accept_round_stack,
                                      assembly_permutation, plan_rounds,
                                      slice_join, symbolic_join)
@@ -441,6 +441,7 @@ def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
     k = a.k
     t0 = time.perf_counter()
     with timers.phase("plan"):
+        failpoints.check("plan.build")
         batch = round_batch_enabled()
         split = None
         if backend == "hybrid" and batch:
@@ -638,6 +639,7 @@ def execute(plan: SpgemmPlan, a, b):
     # are plan (symbolic_join + plan_rounds) / numeric_dispatch / assembly.
     mxu_rounds = proof_rounds = 0
     with timers.phase("numeric_dispatch"):
+        failpoints.check("kernel.dispatch")
         outs_h, outs_l, order = [], [], []
         for rnd in rounds:
             fn = numeric
@@ -897,6 +899,7 @@ def _delta_execute(plan: SpgemmPlan, a, b):
         sub_plan, kept = subplan(plan, d.key_mask)
         sub = execute(sub_plan, a, b)
         with timers.phase("delta_splice"):
+            failpoints.check("delta.splice")
             prev = entry.result
             n_sub = len(kept)
             # ladder-pad the scatter like the sub-plan's assembly: pad

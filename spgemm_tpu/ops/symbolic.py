@@ -342,6 +342,8 @@ class SpgemmPlan:
         with self._complete_lock:
             builder = self._exact_builder
             if builder is not None:
+                from spgemm_tpu.utils import failpoints  # noqa: PLC0415
+                failpoints.check("plan.ensure_exact")
                 builder(self)
                 self._exact_builder = None
                 # event-log breadcrumb: WHERE the deferred join landed

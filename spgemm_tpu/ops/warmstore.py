@@ -127,7 +127,12 @@ def configure(path: str | None = None) -> bool:
         # brief retry: `cli warm --stat/--clear` probes the lock for a
         # few microseconds, and losing THAT race must not cold-start a
         # daemon for its whole lifetime; a dir genuinely held by a live
-        # process still fails fast (~a quarter second)
+        # process still fails fast (~a quarter second).  The scan probe
+        # is the ONLY transient flock taker: the recovery re-probe path
+        # (serve/daemon._recover_probe) never touches the warm dir --
+        # the probe is a subprocess matmul and the replacement executor
+        # reuses the already-bound store -- so this window covers every
+        # race there is (tests/test_chaos.py pins both directions)
         locked = False
         for attempt in range(6):
             try:
@@ -307,6 +312,10 @@ def _check_envelope(z, path: str, kind: str, ident: str) -> bool:
     """Validate one loaded npz's envelope: schema version, entry kind,
     identity (fingerprint/key) and the jit-static knob vector.  False =
     counted cold fallback."""
+    from spgemm_tpu.utils import failpoints  # noqa: PLC0415
+    if failpoints.check("warm.load"):
+        _note_corrupt(path, "failpoint warm.load")
+        return False
     schema = int(z["schema"]) if "schema" in z.files else -1
     if schema != SCHEMA_VERSION:
         _note_corrupt(path, f"schema version {schema} != {SCHEMA_VERSION}")
@@ -522,8 +531,10 @@ def flush() -> dict:
             return counts
         from spgemm_tpu.obs import events  # noqa: PLC0415
         from spgemm_tpu.ops import delta, plancache  # noqa: PLC0415
+        from spgemm_tpu.utils import failpoints  # noqa: PLC0415
         from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
         with ENGINE.phase("warm_flush"):
+            failpoints.check("warm.flush")
             for _, plan in plancache.entries():
                 if save_plan(plan):
                     counts["plans"] += 1

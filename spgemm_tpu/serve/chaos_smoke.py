@@ -1,0 +1,368 @@
+"""`make chaos-smoke`: failpoint-driven chaos proof for spgemmd on CPU.
+
+A seeded randomized fault schedule (utils/failpoints.py registry, armed
+via SPGEMM_TPU_FAILPOINTS) runs against a LIVE 2-slice daemon, and the
+serving contract is asserted under fire:
+
+  * every job ends bit-exact vs the host oracle OR failed with a
+    structured error (a code-carrying error dict) -- never wrong bits,
+    never an unexplained loss;
+  * no job hangs past the watchdog window: every wait() returns a
+    terminal state within a bound derived from the job deadline + wedge
+    grace (+ engine margin);
+  * the pool HEALS: the schedule always arms `serve.executor:1:1` (one
+    slice wedges on its first pickup -> reap -> wedge declaration ->
+    per-slice degrade), and SPGEMM_TPU_SERVE_RECOVER_S re-probes and
+    reinstates it behind the canary gate -- per-slice stats must report
+    `recoveries >= 1` before the leg ends, and the Prometheus scrape
+    must carry a moving spgemm_failpoints_triggered_total series;
+  * the journal survives a mid-write kill: the schedule arms
+    `serve.journal:1:1` (one deliberately torn record), the harness
+    additionally appends a half-written frame after shutdown, and a
+    SECOND daemon on the same socket must replay clean -- bind, count
+    the tear (stats journal.torn >= 1), and serve a fresh submit
+    bit-exact;
+  * shutdown is rollout-grade: the second daemon is stopped with
+    SIGTERM and must drain + exit 0 with its socket unlinked.
+
+Any step failing exits nonzero.  The harness process stays jax-free
+(oracle + generator are pure numpy); only the daemons touch a backend.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+# the watchdog window the no-hang assertion is derived from
+JOB_TIMEOUT_S = 45.0
+WEDGE_GRACE_S = 2.0
+RECOVER_S = 0.5
+# engine margin on top of the watchdog window: CPU jit of a cold shape
+WAIT_MARGIN_S = 120.0
+
+# probabilistic candidates the seeded schedule draws from (the wedge and
+# the torn journal record are always armed -- the heal and replay
+# assertions need them deterministically)
+CANDIDATES = (
+    ("plan.build", (0.1, 0.3)),
+    ("plan.ensure_exact", (0.1, 0.3)),
+    ("kernel.dispatch", (0.1, 0.3)),
+    ("delta.diff", (0.3, 0.7)),
+    ("warm.load", (0.3, 0.7)),
+    ("serve.accept", (0.1, 0.3)),
+    ("serve.readline", (0.05, 0.15)),
+)
+
+
+def _fail(procs, msg: str) -> int:
+    print(f"chaos-smoke: FAIL: {msg}", file=sys.stderr)
+    for proc in procs:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        if proc is not None:
+            out, _ = proc.communicate(timeout=10)
+            sys.stderr.write(out[-6000:] if out else "")
+    return 1
+
+
+def _start_daemon(sock: str, env: dict, procs: list):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+         "--socket", sock, "--device", "cpu", "-v"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    procs.append(proc)
+    deadline = time.time() + 120
+    while not os.path.exists(sock):
+        if proc.poll() is not None:
+            return None, "daemon exited before binding its socket"
+        if time.time() > deadline:
+            return None, "daemon never bound its socket"
+        time.sleep(0.1)
+    return proc, None
+
+
+def _transient(client, e) -> bool:
+    """Retryable chaos weather, not an outcome: the daemon answered busy
+    (MAX_CONNS under an injected accept stall) or the client's bounded
+    connect retry gave up mid-restart -- both clear on their own.  Any
+    other ServeError is a real structured result the caller must
+    surface, never swallow in a retry loop."""
+    from spgemm_tpu.serve import protocol  # noqa: PLC0415
+    return isinstance(e, client.ServeError) and \
+        e.code in (protocol.E_BUSY, protocol.E_UNAVAILABLE)
+
+
+def _submit_retrying(client, folder, sock, options):
+    """Submit, riding out an injected conn-handler death (the daemon
+    drops the connection without answering -> ConnectionError; the
+    request never reached admission, so a resend cannot double-submit)
+    and transient busy/unavailable answers."""
+    last = None
+    for _ in range(6):
+        try:
+            return client.submit(folder, sock, options)
+        except ConnectionError as e:
+            last = e
+            time.sleep(0.1)
+        except client.ServeError as e:
+            if not _transient(client, e):
+                raise
+            last = e
+            time.sleep(0.1)
+    raise last
+
+
+def _wait_retrying(client, job_id, sock, timeout):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            resp = client.wait(job_id, sock,
+                               timeout=max(1.0, deadline - time.time()))
+        except ConnectionError as e:  # injected conn death: reconnect
+            last = e
+            time.sleep(0.1)
+            continue
+        except client.ServeError as e:
+            if not _transient(client, e):
+                raise
+            last = e
+            time.sleep(0.1)
+            continue
+        if resp["job"]["state"] in ("done", "failed"):
+            return resp
+        break  # wait() returned a non-terminal snapshot: deadline hit
+    if last is not None and time.time() >= deadline:
+        raise last
+    return None
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse  # noqa: PLC0415
+
+    import numpy as np  # noqa: PLC0415
+
+    from spgemm_tpu.serve import client  # noqa: PLC0415
+    from spgemm_tpu.utils import io_text  # noqa: PLC0415
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix  # noqa: PLC0415
+    from spgemm_tpu.utils.gen import random_chain  # noqa: PLC0415
+    from spgemm_tpu.utils.semantics import chain_oracle  # noqa: PLC0415
+
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu.serve.chaos_smoke",
+        description="seeded failpoint chaos proof against a live "
+                    "2-slice spgemmd")
+    p.add_argument("--seed", type=int, default=20260804,
+                   help="fault-schedule seed (default 20260804; the "
+                        "schedule prints so a failure replays)")
+    p.add_argument("--jobs", type=int, default=10,
+                   help="submits in the chaos leg (default 10)")
+    args = p.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    tmp = tempfile.mkdtemp(prefix="spgemmd-chaos-")
+    sock = os.path.join(tmp, "d.sock")
+    procs: list = []
+
+    # two small chains + oracles; repeat submits exercise plan-cache,
+    # delta and warm paths under fire
+    folders, wants = [], []
+    for i, seed in enumerate((31, 32)):
+        f = os.path.join(tmp, f"chain_{i}")
+        mats = random_chain(4, 6, 4, 0.5, np.random.default_rng(seed),
+                            "full")
+        io_text.write_chain_dir(f, mats, 4)
+        w = chain_oracle([m.to_dict() for m in mats], 4)
+        wants.append(io_text.format_matrix(BlockSparseMatrix.from_dict(
+            mats[0].rows, mats[-1].cols, 4, w).prune_zeros()))
+        folders.append(f)
+
+    # the seeded schedule: 3 probabilistic draws + the two deterministic
+    # anchors the heal/replay assertions need
+    drawn = rng.sample(CANDIDATES, 3)
+    terms = [f"{name}:{rng.uniform(lo, hi):.2f}"
+             for name, (lo, hi) in drawn]
+    terms += ["serve.executor:1:1", "serve.journal:1:1"]
+    schedule = ",".join(terms)
+    print(f"chaos-smoke: seed={args.seed} schedule={schedule}")
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("SPGEMM_TPU_WARM")
+           and k != "SPGEMM_TPU_FAILPOINTS"}
+    env.update({
+        "SPGEMM_TPU_FAILPOINTS": schedule,
+        "SPGEMM_TPU_SERVE_SLICES": "2",
+        "SPGEMM_TPU_SERVE_JOB_TIMEOUT": f"{JOB_TIMEOUT_S:g}",
+        "SPGEMM_TPU_SERVE_WEDGE_GRACE_S": f"{WEDGE_GRACE_S:g}",
+        "SPGEMM_TPU_SERVE_RECOVER_S": f"{RECOVER_S:g}",
+    })
+    proc, err = _start_daemon(sock, env, procs)
+    if err:
+        return _fail(procs, err)
+
+    # ---- chaos leg: every job bit-exact or structured, no hangs ----
+    wait_bound = JOB_TIMEOUT_S + WEDGE_GRACE_S + WAIT_MARGIN_S
+    done = failed = 0
+    error_codes = set()
+    for i in range(args.jobs):
+        pick = rng.randrange(len(folders))
+        out = os.path.join(tmp, f"out.{i}")
+        try:
+            resp = _submit_retrying(client, folders[pick], sock,
+                                    {"output": out})
+        except client.ServeError as e:
+            return _fail(procs, f"submit {i} rejected unexpectedly: {e}")
+        try:
+            resp = _wait_retrying(client, resp["id"], sock, wait_bound)
+        except client.ServeError as e:
+            return _fail(procs, f"wait for job {i} answered a "
+                                f"structured error: {e}")
+        if resp is None:
+            return _fail(procs, f"job {i} not terminal within "
+                                f"{wait_bound:g}s: HANG past the "
+                                "watchdog window")
+        job = resp["job"]
+        if job["state"] == "done":
+            done += 1
+            if open(out, "rb").read() != wants[pick]:
+                return _fail(procs, f"job {i} completed with WRONG BITS "
+                                    "vs the oracle")
+        else:
+            err_dict = job.get("error") or {}
+            code = err_dict.get("code")
+            if not code or not isinstance(code, str):
+                return _fail(procs, f"job {i} failed WITHOUT a "
+                                    f"structured error: {err_dict!r}")
+            failed += 1
+            error_codes.add(code)
+    if done == 0:
+        return _fail(procs, "no job completed at all; the schedule "
+                            "starved the assertion (lower the probs)")
+
+    # ---- heal leg: the wedged slice must recover and serve again ----
+    deadline = time.time() + 60
+    recoveries = 0
+    while time.time() < deadline:
+        try:
+            st = client.stats(sock)
+        except ConnectionError:  # injected conn death: reconnect
+            time.sleep(0.1)
+            continue
+        except client.ServeError as e:
+            if not _transient(client, e):
+                return _fail(procs, f"stats answered a structured "
+                                    f"error mid-heal: {e}")
+            time.sleep(0.1)
+            continue
+        recoveries = sum(s.get("recoveries", 0) for s in st["slices"])
+        if recoveries >= 1 and not any(s["degraded"] for s in st["slices"]):
+            break
+        time.sleep(0.25)
+    if recoveries < 1:
+        return _fail(procs, "pool never healed: serve_recoveries == 0 "
+                            "after the wedge (recovery loop dead?)")
+    scrape = None
+    for _ in range(6):
+        try:
+            scrape = client.metrics(sock)
+            break
+        except ConnectionError:
+            time.sleep(0.1)
+        except client.ServeError as e:
+            if not _transient(client, e):
+                return _fail(procs, f"metrics answered a structured "
+                                    f"error: {e}")
+            time.sleep(0.1)
+    if scrape is None:
+        return _fail(procs, "metrics scrape never answered")
+    if "spgemm_failpoints_triggered_total{" not in scrape:
+        return _fail(procs, "failpoint triggers missing from the "
+                            "Prometheus scrape")
+    # post-heal submit: the reinstated pool serves bit-exact
+    out = os.path.join(tmp, "out.heal")
+    try:
+        resp = _submit_retrying(client, folders[0], sock, {"output": out})
+        resp = _wait_retrying(client, resp["id"], sock, wait_bound)
+    except client.ServeError as e:
+        return _fail(procs, f"post-heal submit answered a structured "
+                            f"error: {e}")
+    if resp is None or resp["job"]["state"] != "done" \
+            or open(out, "rb").read() != wants[0]:
+        return _fail(procs, "post-heal submit did not complete bit-exact")
+
+    for _ in range(6):
+        try:
+            client.shutdown(sock)
+            break
+        except ConnectionError:  # injected conn death: reconnect
+            time.sleep(0.1)
+        except client.ServeError as e:
+            if not _transient(client, e):
+                return _fail(procs, f"shutdown answered a structured "
+                                    f"error: {e}")
+            time.sleep(0.1)
+    try:
+        rc = proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        return _fail(procs, "chaos daemon did not exit after shutdown")
+    if rc != 0:
+        return _fail(procs, f"chaos daemon exited {rc} after shutdown")
+
+    # ---- torn-journal leg: replay clean after a mid-write kill ----
+    journal = sock + ".journal"
+    with open(journal, "a", encoding="utf-8") as f:
+        # half a frame, no newline: byte-for-byte what SIGKILL mid-append
+        # leaves (on top of the serve.journal-injected tear earlier)
+        f.write('89abcdef 57 {"event":"submit","id":"job-torn","fold')
+    env2 = dict(env)
+    del env2["SPGEMM_TPU_FAILPOINTS"]  # replay leg runs un-injected
+    proc2, err = _start_daemon(sock, env2, procs)
+    if err:
+        return _fail(procs, f"restart over torn journal: {err}")
+    st = client.stats(sock)
+    torn = st["journal"].get("torn", 0)
+    if torn < 1:
+        return _fail(procs, "restarted daemon did not count the torn "
+                            f"journal tail (torn={torn})")
+    out2 = os.path.join(tmp, "out.replay")
+    try:
+        resp = _submit_retrying(client, folders[1], sock,
+                                {"output": out2})
+        resp = _wait_retrying(client, resp["id"], sock, wait_bound)
+    except client.ServeError as e:
+        return _fail(procs, f"post-replay submit answered a structured "
+                            f"error: {e}")
+    if resp is None or resp["job"]["state"] != "done" \
+            or open(out2, "rb").read() != wants[1]:
+        return _fail(procs, "post-replay submit did not complete "
+                            "bit-exact")
+
+    # ---- rollout leg: SIGTERM drains and exits 0 ----
+    proc2.send_signal(signal.SIGTERM)
+    try:
+        rc = proc2.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        return _fail(procs, "daemon did not exit on SIGTERM (graceful "
+                            "drain hung)")
+    if rc != 0:
+        return _fail(procs, f"daemon exited {rc} on SIGTERM (want 0)")
+    if os.path.exists(sock):
+        return _fail([], "socket not unlinked after SIGTERM drain")
+
+    print(f"chaos-smoke: OK (seed={args.seed}; {done} done bit-exact + "
+          f"{failed} structured-failed of {args.jobs} chaos jobs, "
+          f"codes={sorted(error_codes)}; recoveries={recoveries}; "
+          f"journal torn counted={torn} and replayed clean; SIGTERM "
+          "drain exited 0)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,6 +31,16 @@ WAIT_SLICE_S = 15.0
 WAIT_BACKOFF_S = 0.05
 WAIT_BACKOFF_MAX_S = 2.0
 
+# connect retry during a daemon-restart window: ECONNREFUSED (socket file
+# exists, no listener yet -- the successor daemon is binding) and ENOENT
+# (socket unlinked -- the predecessor just exited) both retry with capped
+# exponential backoff, bounded by a TOTAL wait; past it the caller gets a
+# structured daemon-unavailable ServeError instead of a raw OSError
+# mid-rollout.  retry_total_s=0 disables retrying (one attempt).
+CONNECT_BACKOFF_S = 0.05
+CONNECT_BACKOFF_MAX_S = 1.0
+CONNECT_RETRY_TOTAL_S = 5.0
+
 
 class ServeError(Exception):
     """A structured daemon-side error response; carries the wire code."""
@@ -41,10 +51,45 @@ class ServeError(Exception):
         self.message = message
 
 
+def _connect(path: str, timeout: float | None,
+             retry_total_s: float) -> socket.socket:
+    """Connect to the daemon socket, riding out a restart window:
+    connection-refused / socket-missing retries with capped exponential
+    backoff for at most retry_total_s seconds, then raises a structured
+    daemon-unavailable ServeError (chained on the last OS error)."""
+    deadline = time.time() + retry_total_s
+    backoff = 0.0
+    while True:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        try:
+            sock.connect(path)
+        except (ConnectionRefusedError, FileNotFoundError) as e:
+            sock.close()
+            now = time.time()
+            if now >= deadline:
+                raise ServeError(
+                    protocol.E_UNAVAILABLE,
+                    f"no daemon reachable at {path} after "
+                    f"{retry_total_s:g}s of connect retries ({e})") from e
+            backoff = min(CONNECT_BACKOFF_MAX_S,
+                          backoff * 2 if backoff else CONNECT_BACKOFF_S)
+            time.sleep(min(backoff, max(0.0, deadline - now)))
+        except BaseException:
+            sock.close()
+            raise
+        else:
+            return sock
+
+
 def request(msg: dict, socket_path: str | None = None,
-            timeout: float | None = None) -> dict:
-    """One request, one response.  Raises ConnectionError flavors when no
-    daemon is listening; raises ServeError on an error response.
+            timeout: float | None = None,
+            retry_total_s: float | None = None) -> dict:
+    """One request, one response.  A missing/refusing socket retries for
+    up to retry_total_s (default CONNECT_RETRY_TOTAL_S -- the daemon-
+    restart rollout window) before raising a structured
+    daemon-unavailable ServeError; other OSError flavors raise as
+    before.  Raises ServeError on an error response.
 
     Requests advertise the LOWEST protocol version that carries their
     features (v1 unless the caller stamped a higher `v` -- submit does,
@@ -52,9 +97,9 @@ def request(msg: dict, socket_path: str | None = None,
     upgraded client keeps working against a still-v1 daemon during a
     rolling upgrade instead of tripping its strict version check."""
     path = socket_path or protocol.default_socket_path()
-    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as sock:
-        sock.settimeout(timeout)
-        sock.connect(path)
+    if retry_total_s is None:
+        retry_total_s = CONNECT_RETRY_TOTAL_S
+    with _connect(path, timeout, retry_total_s) as sock:
         sock.sendall(protocol.encode({"v": 1, **msg}))
         for line in protocol.read_lines(sock):
             resp = json.loads(line)

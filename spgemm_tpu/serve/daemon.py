@@ -80,10 +80,12 @@ import argparse
 import json
 import logging
 import os
+import signal
 import socket
 import sys
 import threading
 import time
+import zlib
 
 from spgemm_tpu.obs import events as obs_events
 from spgemm_tpu.obs import metrics as obs_metrics
@@ -94,7 +96,7 @@ from spgemm_tpu.parallel import mesh as mesh_mod
 from spgemm_tpu.serve import placement, protocol
 from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
                                     QueueFull, TenantCapExceeded)
-from spgemm_tpu.utils import knobs
+from spgemm_tpu.utils import failpoints, knobs
 
 log = logging.getLogger("spgemm_tpu.serve")
 
@@ -102,6 +104,48 @@ log = logging.getLogger("spgemm_tpu.serve")
 # misspelled knob early beats silently ignoring it on a fleet)
 SUBMIT_OPTIONS = ("backend", "round_size", "checkpoint_dir", "output",
                   "timeout_s", "failover")
+
+
+# -------------------------------------------------------- journal framing --
+def journal_frame(event: dict) -> str:
+    """One crash-safe journal line for `event`: `CRC32 LENGTH PAYLOAD\\n`
+    (crc as 8 hex digits over the utf-8 payload bytes, length in bytes).
+    A record interrupted mid-write -- daemon killed, disk full -- fails
+    either check on replay and is truncated at, never parsed as garbage
+    and never a crash."""
+    payload = json.dumps(event, separators=(",", ":"))
+    data = payload.encode("utf-8")
+    return f"{zlib.crc32(data):08x} {len(data)} {payload}\n"
+
+
+def journal_parse_line(line: str) -> dict | None:
+    """Decode one journal line; None = torn/corrupt record (the caller
+    truncates there).  Accepts the CRC32+length frame and, for journals
+    written before framing existed, a legacy bare-JSON line -- a restart
+    across the upgrade must not re-run (or lose) the old journal."""
+    if line.startswith("{"):
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            return None
+        return ev if isinstance(ev, dict) else None
+    parts = line.split(" ", 2)
+    if len(parts) != 3:
+        return None
+    crc_hex, length_s, payload = parts
+    try:
+        want_crc = int(crc_hex, 16)
+        want_len = int(length_s)
+    except ValueError:
+        return None
+    data = payload.encode("utf-8")
+    if len(data) != want_len or zlib.crc32(data) != want_crc:
+        return None
+    try:
+        ev = json.loads(payload)
+    except ValueError:
+        return None
+    return ev if isinstance(ev, dict) else None
 
 
 def run_chain_job(job: Job, degraded: bool = False) -> None:
@@ -150,6 +194,7 @@ def run_chain_job(job: Job, degraded: bool = False) -> None:
         # running a failed job's chain to completion (and, for a wedged
         # executor that unwedges hours later, instead of recording the
         # rest of its phases into the replacement executor's ENGINE)
+        failpoints.check("serve.heartbeat")
         job.touch()
         if job.state in TERMINAL:
             raise JobAbandoned(job.id)
@@ -226,6 +271,17 @@ class _Slice:
         self.degrade_reason: str | None = None
         self.jobs_total = 0
         self.steals = 0
+        # self-healing recovery state (daemon-lock-guarded like the
+        # degrade flags): the next re-probe time, the live backoff, how
+        # often this slice was reinstated, when, whether its next job is
+        # the canary, and whether a probe subprocess is in flight
+        self.recoveries = 0
+        self.recovered_at: float | None = None
+        self.recover_next = 0.0
+        self.recover_backoff = 0.0
+        self.canary = False
+        self.canary_job: "Job | None" = None  # the in-flight audition
+        self.probing = False
         self.thread: threading.Thread | None = None
         self.gen = 0
         self.current: Job | None = None   # job the slice's live executor holds
@@ -284,13 +340,25 @@ class Daemon:
     # executor, is never
     MAX_WAIT_SLICE_S = 30.0
 
+    # graceful drain (SIGTERM/SIGINT/shutdown): stop() waits this long
+    # for in-flight jobs to finish before reaping the stragglers with a
+    # structured shutting-down error -- a rollout must neither hang on a
+    # wedged job nor cut a nearly-done one off at the knees (class
+    # attribute so tests can shrink it)
+    DRAIN_GRACE_S = 10.0
+
+    # recovery backoff ceiling: a slice whose device stays dead re-probes
+    # no more often than this, however many canaries failed
+    RECOVER_BACKOFF_MAX_S = 900.0
+
     def __init__(self, socket_path: str | None = None, *, runner=None,
                  probe=None, queue_cap: int | None = None,
                  job_timeout_s: float | None = None,
                  wedge_grace_s: float | None = None, journal: bool = True,
                  persist_compile_cache: bool = False,
                  slices: str | None = None, n_devices: int | None = None,
-                 tenant_inflight: int | None = None):
+                 tenant_inflight: int | None = None,
+                 recover_s: float | None = None):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.journal_path = self.socket_path + ".journal"
         # postmortem flight dumps (watchdog reap / wedge / degrade) land
@@ -316,6 +384,11 @@ class Daemon:
         # degrade the slice to the CPU oracle path
         self._wedge_grace_s = wedge_grace_s if wedge_grace_s is not None \
             else knobs.get("SPGEMM_TPU_SERVE_WEDGE_GRACE_S")
+        # self-healing cadence: 0 = never re-probe (a degraded slice
+        # stays on the CPU failover path until restart, the pre-recovery
+        # behavior and the whole-feature A/B)
+        self._recover_s = recover_s if recover_s is not None \
+            else knobs.get("SPGEMM_TPU_SERVE_RECOVER_S")
         self._journal_enabled = journal
         # main() sets this for the real CLI daemon: jax.config's
         # compilation-cache dir is PROCESS-GLOBAL state, so an in-process
@@ -324,12 +397,14 @@ class Daemon:
         self._persist_compile_cache = persist_compile_cache
         self._journal_terminal_events = 0  # spgemm-lint: guarded-by(_lock)
         self._journal_compactions = 0      # spgemm-lint: guarded-by(_lock)
+        self._journal_torn = 0             # spgemm-lint: guarded-by(_lock)
         # daemon-lifetime terminal outcomes (stats + the Prometheus
         # spgemmd_jobs_terminal_total series): the queue index evicts old
         # terminal jobs, so a scraper needs these to tell a healthy idle
         # daemon from one that just degraded and recovered
         self._terminal_totals = {"done": 0, "error": 0, "timeout": 0,
-                                 "abandoned": 0}  # spgemm-lint: guarded-by(_lock)
+                                 "abandoned": 0,
+                                 "drained": 0}  # spgemm-lint: guarded-by(_lock)
         self._job_wall = {
             "buckets": {le: 0 for le in obs_metrics.JOB_WALL_BUCKETS},
             "sum": 0.0, "count": 0}        # spgemm-lint: guarded-by(_lock)
@@ -369,8 +444,14 @@ class Daemon:
         if not self._journal_enabled:
             return
         with self._lock:
+            line = journal_frame(event)
+            if failpoints.check("serve.journal"):
+                # injected mid-write kill: half the frame, no newline --
+                # exactly what a crashed daemon leaves, and exactly what
+                # replay must truncate at (counted) instead of crashing
+                line = line[:max(1, len(line) // 2)]
             with open(self.journal_path, "a", encoding="utf-8") as f:
-                f.write(json.dumps(event, separators=(",", ":")) + "\n")
+                f.write(line)
             if event.get("event") in ("done", "failed"):
                 # runtime compaction: a resident daemon serving a fleet
                 # for weeks must not grow the journal (or the next
@@ -380,34 +461,50 @@ class Daemon:
                         self.JOURNAL_COMPACT_EVERY:
                     self._journal_compact_locked()
 
-    def _journal_live_records(self) -> list[dict]:
-        """Submit records with no matching terminal event, in file order
-        (the jobs a crash/restart left unfinished)."""
+    def _journal_live_records(self) -> tuple[list[dict], int]:
+        """(submit records with no matching terminal event in file order,
+        torn-record count).  Every record is CRC32+length framed
+        (journal_frame; legacy bare-JSON lines still parse): the first
+        record that fails its frame check is a torn tail -- a mid-write
+        kill, a partial disk -- and reading TRUNCATES there (everything
+        after a torn record is unattributable; at-least-once replay of a
+        job whose terminal event fell past the tear is the restart
+        contract the journal already has), counted, never a crash."""
         submitted: dict[str, dict] = {}
+        torn = 0
         with open(self.journal_path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
                 if not line:
                     continue
-                try:
-                    ev = json.loads(line)
-                except ValueError:
-                    continue  # a torn tail write must not kill startup
+                ev = journal_parse_line(line)
+                if ev is None:
+                    torn += 1
+                    break  # truncate at the first bad record
                 if ev.get("event") == "submit":
                     submitted[ev["id"]] = ev
                 elif ev.get("event") in ("done", "failed"):
                     submitted.pop(ev.get("id"), None)
-        return list(submitted.values())
+        return list(submitted.values()), torn
 
     def _journal_compact_locked(self) -> None:
         """Rewrite the journal to only its live submit records (caller
-        holds self._lock)."""
-        live = self._journal_live_records()
+        holds self._lock).  A torn tail is dropped by the rewrite -- the
+        on-disk truncation that makes the in-memory truncation of
+        _journal_live_records durable -- and counted."""
+        live, torn = self._journal_live_records()
         with open(self.journal_path, "w", encoding="utf-8") as f:
             for ev in live:
-                f.write(json.dumps(ev, separators=(",", ":")) + "\n")
+                f.write(journal_frame(ev))
         self._journal_terminal_events = 0
         self._journal_compactions += 1
+        if torn:
+            self._journal_torn += torn
+            obs_events.emit("journal_torn", records=torn,
+                            path=self.journal_path)
+            log.warning("journal: dropped %d torn record(s) at the tail "
+                        "of %s (mid-write kill; replay truncated there)",
+                        torn, self.journal_path)
 
     def _journal_replay(self) -> None:
         """Re-queue journaled jobs that never reached a terminal state,
@@ -415,7 +512,7 @@ class Daemon:
         not re-run completed work, and the file must not grow forever)."""
         if not self._journal_enabled or not os.path.exists(self.journal_path):
             return
-        live = self._journal_live_records()
+        live, _ = self._journal_live_records()  # compaction counts the tear
         with self._lock:
             self._journal_compact_locked()
         for ev in live:
@@ -514,12 +611,43 @@ class Daemon:
             self.stop()
 
     def stop(self) -> None:
+        """Graceful drain + teardown (the protocol `shutdown` op, the
+        SIGTERM/SIGINT handlers, and serve_forever's finally all land
+        here): admission stops the instant the flag is set (_op_submit
+        answers shutting-down), in-flight jobs get DRAIN_GRACE_S to
+        finish, stragglers are reaped with a structured shutting-down
+        error (first-write-wins: a job that finishes during the reap
+        stays done), then warm store + event log flush, the flock
+        releases, and the socket unlinks -- a rollout's SIGTERM exits 0
+        with nothing half-written.  Queued-but-unstarted jobs keep their
+        live journal records: the successor daemon re-runs them (the
+        at-least-once restart contract)."""
         self._stop.set()
         if self._listener is not None:
             try:
                 self._listener.close()
             except OSError:
                 pass
+        deadline = time.time() + self.DRAIN_GRACE_S
+        while time.time() < deadline and self.queue.running():
+            time.sleep(0.05)
+        leftovers = self.queue.running()
+        if leftovers:
+            obs_events.emit("daemon_drain_reap",
+                            jobs=[j.id for j in leftovers])
+        for job in leftovers:
+            if job.finish("failed", error={
+                    "code": protocol.E_SHUTTING_DOWN,
+                    "message": f"daemon shut down before the job finished "
+                               f"(drained {self.DRAIN_GRACE_S:g}s); "
+                               "resubmit to the successor daemon"},
+                    detail=self._reap_detail(job),
+                    on_commit=lambda j=job: self._journal_append(
+                        {"event": "failed", "id": j.id})):
+                # a drain reap is a ROUTINE rollout outcome, not the
+                # executor-death signal: its own outcome label, so
+                # alerts keyed on "abandoned" stay meaningful
+                self._observe_terminal(job, "drained")
         for t in self._threads:
             t.join(timeout=5.0)
         for sl in self.slices:
@@ -603,6 +731,20 @@ class Daemon:
         to own -- the claim, not the executor's later bookkeeping, is the
         mutual-exclusion point.  The executor clears a claim it ends up
         not running (terminal-in-FIFO skip) and re-asserts it at pickup."""
+        cur = sl.current
+        if cur is not None and cur.state not in TERMINAL:
+            # another executor generation holds a LIVE claim on this
+            # slice: the recovery reinstatement retires an actively
+            # polling degraded executor, and for one poll cycle both
+            # generations dispatch for the slice -- serialized here
+            # (claims all run under the queue lock) so the straggler's
+            # job stays sl.current until terminal (deadline reaping and
+            # wedge attribution keep working) and two jobs can never run
+            # on one slice's devices at once.  A TERMINAL leftover claim
+            # is a wedged executor's abandoned slot: the degraded
+            # replacement must overwrite it or the slice never serves
+            # again (the hung thread can't clear it).
+            return False
         if sl.degraded:
             if any(not s.degraded for s in self.slices):
                 return False
@@ -630,8 +772,11 @@ class Daemon:
         if degraded is not None:
             with self._lock:
                 sl.degraded = degraded
-                if degraded:
-                    self.degraded = all(s.degraded for s in self.slices)
+                self.degraded = all(s.degraded for s in self.slices)
+                if not self.degraded:
+                    # reason set iff flag set (the alerting contract):
+                    # a recovery that un-degrades the pool clears it
+                    self.degrade_reason = None
         sl.gen += 1
         gen = sl.gen
         sl.thread = threading.Thread(
@@ -644,8 +789,15 @@ class Daemon:
         from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
 
         while not self._stop.is_set() and gen == sl.gen:
-            job = self.queue.next(timeout=0.2,
-                                  accept=lambda j: self._accepts(sl, j))
+            # the gen re-check inside accept retires this executor even
+            # while it is blocked in next(): a recovery reinstatement
+            # bumps sl.gen mid-poll, and without the re-check the retired
+            # generation could still claim one more job before the loop
+            # top notices (the live-claim refusal in _accepts closes the
+            # residual read-then-bump window)
+            job = self.queue.next(
+                timeout=0.2,
+                accept=lambda j: gen == sl.gen and self._accepts(sl, j))
             if job is None:
                 continue
             if job.state != "queued":  # reaped while still in the FIFO
@@ -663,12 +815,41 @@ class Daemon:
             job.slice = sl.name
             job.device_ids = sl.device_ids \
                 if len(self.slices) > 1 or sl.width > 1 else None
-            job.start()
             with self._lock:
                 degraded = sl.degraded
+                canary = sl.canary and not degraded
+                if canary:
+                    # the gate is CONSUMED by this one pickup ("first
+                    # job" means first job): the next pickup can land
+                    # before the watchdog's settle tick observes this
+                    # one's outcome, and must not be tightened too.
+                    # canary_job keeps failure attribution -- a wedge
+                    # during the audition still doubles the backoff in
+                    # _degrade_slice
+                    sl.canary = False
+                    sl.canary_job = job
                 sl.jobs_total += 1
                 if job.stolen:
                     sl.steals += 1
+            if canary:
+                # the canary gate: the first job after a recovery
+                # reinstatement runs under a TIGHTENED deadline -- if the
+                # device is still wedged, the watchdog reaps fast and the
+                # re-degrade (which doubles the recovery backoff) costs
+                # one cheap job, not a full deadline.  Half the job's own
+                # deadline when it has one; else the wedge grace window
+                # (sized to one whole multiply) bounds the probe work.
+                tight = job.timeout_s / 2 if job.timeout_s > 0 \
+                    else self._wedge_grace_s
+                if tight > 0:
+                    job.timeout_s = tight
+                obs_events.emit("slice_canary", slice=sl.name,
+                                job_id=job.id, timeout_s=job.timeout_s)
+            job.start()
+            # the backend-wedge signature, injected: the executor hangs
+            # right where a dead device would hang it -- after pickup,
+            # before any result exists
+            failpoints.check("serve.executor")
             if job.stolen:
                 ENGINE.incr("serve_steals")
             scope = ENGINE.scope()
@@ -717,6 +898,14 @@ class Daemon:
                     self._observe_terminal(job, "error")
                     obs_events.emit("job_failed", job_id=job.id,
                                     error=repr(e))
+                # a structured job error still PROVES the executor alive
+                # and responsive: the canary gate discriminates wedges,
+                # not job-level failures.  Only a HEALTHY pickup settles
+                # -- a straggler the degraded executor picked before the
+                # reinstatement ran the CPU oracle and proves nothing
+                # about the device
+                if not degraded:
+                    self._canary_settle(sl)
                 warmstore.flush()  # terminal event: persist what the job warmed
             else:
                 if job.finish("done",
@@ -725,6 +914,8 @@ class Daemon:
                                   {"event": "done", "id": job.id})):
                     self._observe_terminal(job, "done")
                     obs_events.emit("job_done", job_id=job.id)
+                if not degraded:  # healthy pickups only, as above
+                    self._canary_settle(sl)
                 warmstore.flush()  # terminal event: persist what the job warmed
             finally:
                 # detach the per-job collector: a wedged executor that
@@ -864,6 +1055,7 @@ class Daemon:
         while not self._stop.wait(0.05):
             for sl in self.slices:
                 self._watch_slice(sl)
+                self._maybe_recover(sl)
 
     def _watch_slice(self, sl: _Slice) -> None:
         job = sl.current
@@ -930,6 +1122,13 @@ class Daemon:
                                         f"{reaped.id}")
         elif reaped is not None and sl.current is not reaped:
             sl.reaped = None  # executor moved on: slow, not wedged
+            # a CANARY job reaped but outlived by its executor settles
+            # the gate: moving on proves the device executes (the wedge
+            # signature is the opposite), so the tightened deadline must
+            # not outlive the audition -- without this, a deadline-less
+            # deployment would reap every long job on a healthy
+            # recovered slice forever ("first job" means first job)
+            self._canary_settle(sl)
 
     def _degrade_slice(self, sl: _Slice, reason: str) -> None:
         """Abandon the slice's executor, record why, probe the backend (a
@@ -945,6 +1144,24 @@ class Daemon:
             already = sl.degraded
             sl.degraded = True
             sl.degrade_reason = reason
+            # recovery bookkeeping: a FAILED CANARY (re-degrade while the
+            # reinstatement's first job was still gating) doubles the
+            # backoff -- the device lied to the probe once, make it wait
+            # longer before the next audition; a fresh degrade starts the
+            # cadence at the knob's base
+            if sl.canary or sl.canary_job is not None:
+                # armed-but-unconsumed gate and in-flight audition alike:
+                # the device lied to the probe, whatever failed here
+                sl.canary = False
+                sl.canary_job = None
+                self._bump_backoff_locked(sl)
+            elif not already:
+                sl.recover_backoff = self._recover_s
+                sl.recover_next = time.time() + sl.recover_backoff
+            # already-degraded re-degrade (e.g. the CPU-failover executor
+            # itself died): keep the accumulated exponential backoff --
+            # resetting it would resume probing a known-dead device at
+            # the base cadence
             self.degraded = all(s.degraded for s in self.slices)
             if self.degraded:
                 # the daemon-level reason is set if-and-only-if the
@@ -992,6 +1209,111 @@ class Daemon:
         threading.Thread(target=_run_probe, name="spgemmd-probe",
                          daemon=True).start()
 
+    # ----------------------------------------------------------- recovery --
+    def _bump_backoff_locked(self, sl: _Slice) -> None:
+        """Double a degraded slice's recovery backoff and re-arm its
+        timer (caller holds _lock) -- the ONE backoff policy, shared by
+        the failed-canary re-degrade and the dead-probe outcome so the
+        two paths can never drift onto divergent curves."""
+        sl.recover_backoff = min(
+            max(sl.recover_backoff, self._recover_s) * 2,
+            self.RECOVER_BACKOFF_MAX_S)
+        sl.recover_next = time.time() + sl.recover_backoff
+
+    def _maybe_recover(self, sl: _Slice) -> None:
+        """Watchdog tick half of self-healing: when the recovery knob is
+        on and a degraded slice's backoff window has elapsed, launch one
+        re-probe off-thread (the probe subprocess can block for the full
+        SPGEMM_TPU_PROBE_TIMEOUT against a dead device -- the watchdog
+        still has reaping to do)."""
+        if self._recover_s <= 0 or self._stop.is_set():
+            return
+        with self._lock:
+            if not sl.degraded or sl.probing \
+                    or time.time() < sl.recover_next:
+                return
+            sl.probing = True
+        threading.Thread(target=self._recover_probe, args=(sl,),
+                         name=f"spgemmd-recover-{sl.name}",
+                         daemon=True).start()
+
+    def _recover_probe(self, sl: _Slice) -> None:
+        """One recovery attempt for a degraded slice: probe the backend
+        from a subprocess; a live outcome ('ok'/'cpu') reinstates the
+        slice into placement behind the canary gate (its next job runs a
+        tightened deadline; a canary failure re-degrades and doubles the
+        backoff in _degrade_slice), a dead outcome doubles the backoff
+        and re-arms the timer."""
+        probe = self._probe
+        if probe is None:
+            from spgemm_tpu.utils.backend_probe import (  # noqa: PLC0415
+                probe_default_backend)
+            probe = probe_default_backend
+        try:
+            outcome = probe()
+        except Exception as e:  # noqa: BLE001 -- a crashing probe is a dead device, never a dead watchdog
+            outcome = f"probe-error: {e!r}"
+        live = outcome in ("ok", "cpu")
+        with self._lock:
+            sl.probing = False
+            self._probe_outcome = outcome
+            if self._stop.is_set() or not sl.degraded:
+                return  # raced a shutdown or a concurrent reinstatement
+            if not live:
+                self._bump_backoff_locked(sl)
+            else:
+                sl.canary = True
+                sl.canary_job = None
+                sl.recoveries += 1
+                sl.recovered_at = time.time()
+                sl.degrade_reason = None
+                # keep the doubled backoff until the canary PASSES: a
+                # device that probes live but wedges the canary must not
+                # re-audition at the base cadence forever
+                sl.recover_next = time.time() + max(sl.recover_backoff,
+                                                    self._recover_s)
+                # the reinstatement is ATOMIC with the bookkeeping above:
+                # flipping degraded / spawning after releasing the lock
+                # would let a concurrent _degrade_slice (the degraded
+                # executor dying in the window) clear the canary and then
+                # be stomped by our healthy spawn -- the slice would
+                # rejoin placement unaudited with a stale degrade_reason.
+                # _spawn_executor takes no lock when degraded is None (the
+                # flag recompute is done right here).
+                sl.degraded = False
+                self.degraded = all(s.degraded for s in self.slices)
+                if not self.degraded:
+                    self.degrade_reason = None
+                self._spawn_executor(sl)
+        if not live:
+            obs_events.emit("slice_recover_probe", slice=sl.name,
+                            outcome=outcome, live=False)
+            log.info("slice %s recovery probe: %s (still degraded; next "
+                     "attempt in %.1fs)", sl.name, outcome,
+                     sl.recover_backoff)
+            return
+        from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+        ENGINE.incr("serve_recoveries")
+        obs_trace.RECORDER.instant("serve_recover", job_id=None,
+                                   slice=sl.name)
+        obs_events.emit("slice_recovered", slice=sl.name, outcome=outcome)
+        log.warning("slice %s reinstated after live probe (%s); first "
+                    "job runs the canary gate", sl.name, outcome)
+
+    def _canary_settle(self, sl: _Slice) -> None:
+        """An executor-committed terminal outcome on a canary slice
+        settles the canary: the executor is alive and responsive, so the
+        slice graduates to full trust and the backoff resets (wedge-path
+        failures never reach here -- they re-degrade via _degrade_slice,
+        which doubles the backoff instead)."""
+        with self._lock:
+            if sl.degraded or (not sl.canary and sl.canary_job is None):
+                return
+            sl.canary = False
+            sl.canary_job = None
+            sl.recover_backoff = 0.0
+        obs_events.emit("slice_canary_passed", slice=sl.name)
+
     # ----------------------------------------------------------- protocol --
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -1001,6 +1323,9 @@ class Daemon:
                 continue
             except OSError:
                 return  # listener closed during shutdown
+            # injected admission latency: clients' connect/wait backoff
+            # must ride out a stalling accept loop
+            failpoints.check("serve.accept")
             with self._lock:
                 admit = self._conn_count < self.MAX_CONNS
                 if admit:
@@ -1024,6 +1349,9 @@ class Daemon:
         try:
             for line in protocol.read_lines(
                     conn, max_line=protocol.MAX_LINE_BYTES):
+                # injected handler death mid-request: the finally below
+                # must still close the socket and free the conn slot
+                failpoints.check("serve.readline")
                 if not line.strip():
                     continue
                 try:
@@ -1207,8 +1535,9 @@ class Daemon:
             size = 0
         with self._lock:
             compactions = self._journal_compactions
+            torn = self._journal_torn
         return {"path": self.journal_path, "enabled": self._journal_enabled,
-                "bytes": size, "compactions": compactions}
+                "bytes": size, "compactions": compactions, "torn": torn}
 
     def _slice_rows(self) -> list[dict]:
         """Per-slice serving state for stats (and, flattened, the
@@ -1228,6 +1557,14 @@ class Daemon:
                     "current": cur.id if cur is not None else None,
                     "jobs_total": sl.jobs_total,
                     "steals": sl.steals,
+                    # self-healing state: reinstatements so far, when the
+                    # newest one landed, whether the canary audition is
+                    # still pending (gate armed or its job in flight),
+                    # and the live re-probe backoff
+                    "recoveries": sl.recoveries,
+                    "recovered_at": sl.recovered_at,
+                    "canary": sl.canary or sl.canary_job is not None,
+                    "recover_backoff_s": sl.recover_backoff,
                 })
         return rows
 
@@ -1246,6 +1583,13 @@ class Daemon:
             warm_stats = warmstore.stats()
         except ValueError as e:
             warm_stats = {"error": str(e)}
+        # the chaos surface: which failpoints are live under the current
+        # spec (armed() re-parses, so a malformed spec surfaces as a
+        # structured error row here instead of crashing the stats op)
+        try:
+            armed = failpoints.armed()
+        except ValueError as e:
+            armed = {"error": str(e)}
         slices = self._slice_rows()
         with self._lock:
             degraded = self.degraded
@@ -1276,6 +1620,8 @@ class Daemon:
             tenant_inflight_cap=self.queue.tenant_cap(),
             placement=placement.stats(),
             journal=self._journal_stats(),
+            failpoints={"armed": armed,
+                        "triggered": failpoints.triggered()},
             trace=obs_trace.RECORDER.stats(),
             events=obs_events.LOG.stats(),
             profile=obs_profile.summary(),
@@ -1312,6 +1658,7 @@ class Daemon:
             ("spgemmd_journal_bytes", {}, journal["bytes"]),
             ("spgemmd_journal_compactions_total", {},
              journal["compactions"]),
+            ("spgemmd_journal_torn_total", {}, journal["torn"]),
             ("spgemmd_job_wall_seconds", {}, wall),
         ]
         samples += [("spgemmd_jobs", {"state": state}, n)
@@ -1325,6 +1672,8 @@ class Daemon:
                 ("spgemm_slice_degraded", labels, int(row["degraded"])),
                 ("spgemm_slice_jobs_total", labels, row["jobs_total"]),
                 ("spgemm_slice_steals_total", labels, row["steals"]),
+                ("spgemm_slice_recoveries_total", labels,
+                 row["recoveries"]),
             ]
         for tenant, row in self.queue.tenants().items():
             samples.append(("spgemmd_tenant_queue_depth",
@@ -1426,6 +1775,26 @@ def main(argv: list[str] | None = None) -> int:
         # from the first job on every slice, reported in stats like a
         # mid-flight degrade
         daemon.degrade_at_start("startup probe: accelerator unreachable")
+
+    # rollout-grade shutdown: SIGTERM (and a direct SIGINT) set the stop
+    # flag, serve_forever's finally runs the full graceful drain -- stop
+    # admission, finish or reap in-flight jobs within DRAIN_GRACE_S,
+    # flush warm store + journal, release the flock -- and main returns
+    # 0, so `kill <pid>` during a fleet rollout is exactly as clean as
+    # the protocol `shutdown` op
+    # the handler ONLY sets the flag: emitting an event here would take
+    # the (non-reentrant) event-log lock on the main thread, and a
+    # second signal landing while stop() itself holds it inside an emit
+    # or flush would deadlock the drain -- the one thing a SIGTERM
+    # handler must never do
+    def _on_signal(signum, frame):  # noqa: ARG001 -- signal handler shape
+        daemon._stop.set()
+
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(signum, _on_signal)
+        except (ValueError, OSError):
+            pass  # not the main thread / exotic platform: Ctrl-C still works
     try:
         daemon.serve_forever()
     except KeyboardInterrupt:

@@ -99,6 +99,11 @@ E_BUSY = "too-many-connections"    # concurrent-connection bound hit
 E_UNKNOWN_JOB = "unknown-job"
 E_SHUTTING_DOWN = "shutting-down"
 E_INTERNAL = "internal-error"      # handler crash (daemon survives)
+# client-side code: no daemon reachable after the bounded connect-retry
+# window (serve/client.py -- ECONNREFUSED/ENOENT during a restart
+# rollout retry with capped exponential backoff, then THIS, structured,
+# instead of a raw OSError mid-rollout)
+E_UNAVAILABLE = "daemon-unavailable"
 
 # job-failure codes (in a failed job's error dict)
 E_JOB_TIMEOUT = "job-timeout"      # reaped past SPGEMM_TPU_SERVE_JOB_TIMEOUT

@@ -1,0 +1,287 @@
+"""Central failpoint registry: named chaos-injection sites, armed by env.
+
+The engine grew a deep failure-handling surface -- watchdog reap/wedge/
+degrade, per-slice degrade, warm-store corrupt fallbacks, delta full
+fallbacks, journal replay -- but a fault path that is only ever exercised
+by the one hand-crafted test that motivated it rots.  This module makes
+fault injection a first-class, registry-disciplined facility the same way
+knobs.py does for env knobs and obs/metrics.py does for series names:
+
+  * every injection site is DECLARED here once (name, kind, site module,
+    doc), and `check("name")` at the site is the entire wiring -- the FPT
+    lint rule holds call sites to string literals declared below and
+    flags registry entries with no site, so the registry can never drift
+    from the code;
+  * arming is one env knob, `SPGEMM_TPU_FAILPOINTS` (central registry,
+    utils/knobs.py): comma-joined `name[:prob][:count]` terms.  `prob`
+    (default 1) fires the point on that fraction of checks -- the RNG is
+    seeded from the (spec, name) pair, so a given spec replays the same
+    trigger sequence; `count` (default unlimited) bounds total triggers.
+    Unset, every check is one registry lookup + one env read and nothing
+    else: unarmed failpoints are free, so the sites ship enabled in
+    production builds.
+  * every trigger is observable: a `failpoint_trigger` structured event
+    (obs/events) and the `spgemm_failpoints_triggered_total{point=}`
+    Prometheus family (collected by obs/metrics.collect_engine).
+
+Kinds -- what a trigger does at the site:
+
+  raise   raise FailpointTriggered (exercises the site's error path)
+  hang    block until the point is DISARMED (env cleared/changed) or
+          HANG_MAX_S elapses -- the accelerator-wedge signature the
+          watchdog exists for, releasable so tests can un-wedge
+  corrupt check() returns True and the SITE takes its own corruption
+          path (a torn journal record, a warm entry treated as corrupt)
+  delay   sleep DELAY_S, then continue -- latency injection
+
+jax-free by design: imported by ops (numeric path), serve (daemon), and
+the linter -- none may touch a backend, and the numeric-path sites must
+not perturb fold order (raise/hang/delay/corrupt never change bits; a
+triggered site fails loudly or slowly, never wrongly).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+
+from spgemm_tpu.utils import knobs
+
+# a hang releases when disarmed; this is the backstop so a forgotten armed
+# spec cannot pin a thread literally forever
+HANG_MAX_S = 3600.0
+# one delay-kind trigger sleeps this long (deterministic, no jitter)
+DELAY_S = 0.25
+# a releasable hang polls the arming spec at this cadence
+HANG_POLL_S = 0.05
+
+
+class FailpointTriggered(RuntimeError):
+    """A raise-kind failpoint fired; carries the point name so the
+    surviving error path (structured job error, event log) names it."""
+
+    def __init__(self, name: str):
+        super().__init__(f"failpoint {name} triggered")
+        self.point = name
+
+
+@dataclass(frozen=True)
+class Failpoint:
+    """One registered injection site.
+
+    kind: 'raise' | 'hang' | 'corrupt' | 'delay' (what a trigger does).
+    module: the site's module (repo-relative), for docs and the FPT
+    stale-entry check's error message.
+    """
+
+    name: str
+    kind: str
+    module: str
+    doc: str
+
+
+_FAILPOINTS = (
+    Failpoint("plan.build", "raise", "ops/spgemm.py",
+              "Symbolic plan build fails (the chain runner's error path: "
+              "structured job-error, never a wedge)."),
+    Failpoint("plan.ensure_exact", "raise", "ops/symbolic.py",
+              "The deferred exact join fails when forced (plan-ahead "
+              "worker or dispatch thread -- whoever forces it owns the "
+              "error)."),
+    Failpoint("kernel.dispatch", "raise", "ops/spgemm.py",
+              "Numeric kernel dispatch fails mid-multiply (the "
+              "chain_product failover / job-error path)."),
+    Failpoint("delta.diff", "corrupt", "ops/delta.py",
+              "The delta content diff reports lineage ambiguity: the "
+              "site returns None and the multiply takes its counted "
+              "full-fallback path, never a crash."),
+    Failpoint("delta.splice", "raise", "ops/spgemm.py",
+              "Delta row splice fails after the sub-plan executed (the "
+              "most state was in flight; the job error path owns it)."),
+    Failpoint("warm.load", "corrupt", "ops/warmstore.py",
+              "A warm-store entry loads as corrupt: the site takes its "
+              "counted warm_corrupt cold fallback (entry unlinked, "
+              "re-derived, re-persisted)."),
+    Failpoint("warm.flush", "raise", "ops/warmstore.py",
+              "The warm flush raises midway; flush()'s never-raises "
+              "contract must hold (logged, store left self-validating)."),
+    Failpoint("serve.journal", "corrupt", "serve/daemon.py",
+              "One journal append writes a TORN record (truncated frame) "
+              "-- the mid-write-kill signature replay must truncate at, "
+              "count, and never crash on."),
+    Failpoint("serve.accept", "delay", "serve/daemon.py",
+              "The accept loop stalls briefly after one accept (slow "
+              "admission under load; clients' connect retry covers it)."),
+    Failpoint("serve.readline", "raise", "serve/daemon.py",
+              "A connection handler dies mid-request (the conn thread's "
+              "finally must still close the socket and free the slot)."),
+    Failpoint("serve.executor", "hang", "serve/daemon.py",
+              "A slice executor hangs after job pickup, before the "
+              "runner -- the backend-wedge signature: reap, wedge "
+              "declaration, per-slice degrade, recovery re-probe."),
+    Failpoint("serve.heartbeat", "hang", "serve/daemon.py",
+              "The chain heartbeat hangs mid-chain (a backend call that "
+              "never returns between multiplies): no beats reach the "
+              "watchdog, the wedge grace window runs out."),
+)
+
+REGISTRY: dict[str, Failpoint] = {f.name: f for f in _FAILPOINTS}
+
+
+class _Arm:
+    """Live arming state for one point under the current spec: fire
+    probability, remaining trigger budget (None = unlimited), and the
+    (spec, name)-seeded RNG that makes a spec's trigger sequence
+    replayable."""
+
+    def __init__(self, name: str, prob: float, count: int | None,
+                 spec: str):
+        self.prob = prob
+        self.remaining = count
+        self.rng = random.Random(zlib.crc32(f"{spec}|{name}".encode()))
+
+
+_LOCK = threading.Lock()
+_RAW: str | None = None          # spgemm-lint: guarded-by(_LOCK)
+_ARMS: dict[str, _Arm] = {}      # spgemm-lint: guarded-by(_LOCK)
+_TRIGGERED: dict[str, int] = {}  # spgemm-lint: guarded-by(_LOCK)
+
+
+def _parse_spec(spec: str) -> dict[str, tuple[float, int | None]]:
+    """`name[:prob][:count]` terms, comma-joined -> {name: (prob, count)}.
+    Every failure raises naming the knob: a chaos run whose spec silently
+    armed nothing would 'pass' by never injecting anything."""
+    out: dict[str, tuple[float, int | None]] = {}
+    for term in spec.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        parts = term.split(":")
+        name = parts[0].strip()
+        if name not in REGISTRY:
+            raise ValueError(
+                f"SPGEMM_TPU_FAILPOINTS names unknown failpoint {name!r} "
+                f"(registered: {', '.join(sorted(REGISTRY))})")
+        if len(parts) > 3:
+            raise ValueError(
+                f"SPGEMM_TPU_FAILPOINTS term {term!r} has more than "
+                "name:prob:count fields")
+        try:
+            prob = float(parts[1]) if len(parts) > 1 and parts[1] else 1.0
+            count = int(parts[2]) if len(parts) > 2 and parts[2] else None
+        except ValueError:
+            raise ValueError(
+                f"SPGEMM_TPU_FAILPOINTS term {term!r}: prob must be a "
+                "number, count an integer") from None
+        if not 0.0 <= prob <= 1.0 or (count is not None and count < 1):
+            raise ValueError(
+                f"SPGEMM_TPU_FAILPOINTS term {term!r}: need "
+                "0 <= prob <= 1 and count >= 1")
+        out[name] = (prob, count)
+    return out
+
+
+def _arm_for(name: str) -> _Arm | None:
+    """The live arm for `name` under the CURRENT knob value, re-parsing
+    when the spec changed (tests and the chaos harness flip it
+    mid-process like every knob).  None = not armed."""
+    global _RAW
+    spec = knobs.get("SPGEMM_TPU_FAILPOINTS")
+    with _LOCK:
+        if spec != _RAW:
+            # parse BEFORE committing _RAW: a malformed spec must raise on
+            # EVERY check, not just the first -- otherwise one swallowed
+            # ValueError leaves the bad spec cached as "armed nothing" and
+            # the chaos run passes without injecting anything
+            arms: dict[str, _Arm] = {}
+            if spec:
+                for pname, (prob, count) in _parse_spec(spec).items():
+                    arms[pname] = _Arm(pname, prob, count, spec)
+            _RAW = spec
+            _ARMS.clear()
+            _ARMS.update(arms)
+        return _ARMS.get(name)
+
+
+def check(name: str) -> bool:
+    """The one call an injection site makes.  Returns False on the
+    overwhelmingly common unarmed path; on an armed trigger performs the
+    registered kind -- raises for 'raise', blocks-until-disarmed for
+    'hang', sleeps for 'delay' -- and returns True only for 'corrupt'
+    (the site then takes its own corruption path).  The FPT lint rule
+    holds `name` to a string literal declared in REGISTRY."""
+    fp = REGISTRY[name]  # registering is the price of checking
+    if not knobs.get("SPGEMM_TPU_FAILPOINTS"):
+        return False  # inert: one env read, no lock, no parse
+    arm = _arm_for(name)
+    if arm is None:
+        return False
+    with _LOCK:
+        if arm.remaining is not None and arm.remaining <= 0:
+            return False
+        if arm.prob < 1.0 and arm.rng.random() >= arm.prob:
+            return False
+        if arm.remaining is not None:
+            arm.remaining -= 1
+        _TRIGGERED[name] = _TRIGGERED.get(name, 0) + 1
+    _note_trigger(fp)
+    if fp.kind == "raise":
+        raise FailpointTriggered(name)
+    if fp.kind == "hang":
+        _hang(name)
+        return False
+    if fp.kind == "delay":
+        time.sleep(DELAY_S)
+        return False
+    return True  # corrupt
+
+
+def _hang(name: str) -> None:
+    """Block until the point is disarmed (spec cleared or no longer
+    naming it) or HANG_MAX_S passes -- the watchdog sees a genuine wedge,
+    and a test un-wedges by clearing the env."""
+    deadline = time.monotonic() + HANG_MAX_S
+    while time.monotonic() < deadline:
+        spec = knobs.get("SPGEMM_TPU_FAILPOINTS")
+        if not spec or _arm_for(name) is None:
+            return
+        time.sleep(HANG_POLL_S)
+
+
+def _note_trigger(fp: Failpoint) -> None:
+    """Observability for one trigger: structured event (auto-correlated
+    with the emitting thread's job/trace tags) -- the metric family is
+    collected from triggered() by obs/metrics.collect_engine."""
+    from spgemm_tpu.obs import events  # noqa: PLC0415
+    events.emit("failpoint_trigger", point=fp.name, action=fp.kind)
+
+
+def triggered() -> dict[str, int]:
+    """Trigger counts per point since process start (the
+    spgemm_failpoints_triggered_total sample source)."""
+    with _LOCK:
+        return dict(_TRIGGERED)
+
+
+def armed() -> dict[str, dict]:
+    """Live arming state (stats/debugging): per armed point, prob and
+    the remaining trigger budget under the current spec."""
+    # touch the cache so the view reflects the CURRENT env value
+    _arm_for(next(iter(REGISTRY)))
+    with _LOCK:
+        return {name: {"kind": REGISTRY[name].kind, "prob": arm.prob,
+                       "remaining": arm.remaining}
+                for name, arm in _ARMS.items()}
+
+
+def clear() -> None:
+    """Zero the trigger counters and drop the parsed-arm cache (tests;
+    the env knob itself is the caller's to clear)."""
+    global _RAW
+    with _LOCK:
+        _RAW = None
+        _ARMS.clear()
+        _TRIGGERED.clear()

@@ -242,6 +242,30 @@ _KNOBS = (
          "still stuck on it afterwards counts as wedged (watchdog "
          "degrade-to-CPU path); 0 = no deadline.",
          "serve/daemon.py", default="0", minimum=0),
+    Knob("SPGEMM_TPU_SERVE_RECOVER_S", "float",
+         "spgemmd self-healing re-probe cadence, seconds: a degraded "
+         "slice is re-probed (subprocess backend_probe, off-thread) this "
+         "long after degrading, with exponential backoff between failed "
+         "attempts; a live probe reinstates the slice into placement "
+         "behind a canary gate -- the first job after reinstatement runs "
+         "with a tightened deadline, and a canary failure re-degrades "
+         "and doubles the backoff (serve_recoveries counter, "
+         "recovered_at in per-slice stats).  0 = never re-probe (the "
+         "pre-recovery behavior: a degraded slice stays on the CPU "
+         "failover path until daemon restart).",
+         "serve/daemon.py", default="0", minimum=0),
+    Knob("SPGEMM_TPU_FAILPOINTS", "str",
+         "Chaos failpoint arming spec (utils/failpoints.py registry): "
+         "comma-joined `name[:prob][:count]` terms naming registered "
+         "injection points (e.g. `serve.executor:1:1,warm.load:0.5`); "
+         "prob defaults to 1, count to unlimited.  Each armed trigger "
+         "performs the point's registered kind (raise | hang | corrupt "
+         "| delay), emits a failpoint_trigger event and counts on the "
+         "spgemm_failpoints_triggered_total{point=} series.  Unset = "
+         "every failpoint inert (zero overhead beyond one env read per "
+         "check).  An unknown name or malformed term raises naming the "
+         "knob -- a chaos run must never silently arm nothing.",
+         "utils/failpoints.py"),
     Knob("SPGEMM_TPU_SERVE_WEDGE_GRACE_S", "float",
          "spgemmd slow-vs-wedged discrimination window, seconds: after "
          "reaping a job the watchdog waits this long for an executor "

@@ -1,0 +1,672 @@
+"""Chaos layer (PR 13): the failpoint registry (utils/failpoints), the
+crash-safe CRC-framed journal + torn-tail replay, self-healing slice
+recovery (re-probe + canary gate + backoff), the client connect retry,
+the graceful drain, and the warm-dir flock probe race -- tier-1, injected
+runners/probes everywhere the engine itself is not the subject."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.serve import client, protocol
+from spgemm_tpu.serve.daemon import (Daemon, journal_frame,
+                                     journal_parse_line)
+from spgemm_tpu.utils import failpoints, io_text
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_chain
+from spgemm_tpu.utils.semantics import chain_oracle
+
+
+def _chain_folder(tmp_path, n=3, k=2, seed=7, name="chain_in"):
+    mats = random_chain(n, 4, k, 0.5, np.random.default_rng(seed), "full")
+    folder = str(tmp_path / name)
+    io_text.write_chain_dir(folder, mats, k)
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, k, want).prune_zeros())
+    return folder, want_bytes
+
+
+@pytest.fixture
+def make_daemon(tmp_path):
+    daemons = []
+
+    def _make(idx=0, **kw):
+        d = Daemon(str(tmp_path / f"d{idx}.sock"), **kw)
+        d.start()
+        daemons.append(d)
+        return d
+
+    yield _make
+    for d in daemons:
+        d.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints(monkeypatch):
+    """Every test starts unarmed with zeroed trigger counters."""
+    monkeypatch.delenv("SPGEMM_TPU_FAILPOINTS", raising=False)
+    failpoints.clear()
+    yield
+    failpoints.clear()
+
+
+def _wait_until(pred, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -------------------------------------------------- failpoint registry --
+def test_failpoints_unarmed_are_inert():
+    for name in failpoints.REGISTRY:
+        assert failpoints.check(name) is False
+    assert failpoints.triggered() == {}
+
+
+def test_failpoints_unregistered_name_raises():
+    with pytest.raises(KeyError):
+        failpoints.check("not.a.point")
+
+
+def test_failpoints_spec_parsing_is_strict(monkeypatch):
+    for bad in ("bogus.name", "plan.build:nope", "plan.build:0.5:0",
+                "plan.build:2", "plan.build:1:1:1"):
+        monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", bad)
+        failpoints.clear()
+        with pytest.raises(ValueError, match="SPGEMM_TPU_FAILPOINTS"):
+            failpoints.check("plan.build")
+
+
+def test_failpoints_malformed_spec_raises_on_every_check(monkeypatch):
+    """A malformed spec must raise on EVERY check, not just the first:
+    one swallowed ValueError (an executor's broad job-error except) must
+    never leave the bad spec cached as 'armed nothing' -- the chaos run
+    would pass without injecting anything."""
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "plan.build:bogus")
+    failpoints.clear()
+    for _ in range(3):
+        with pytest.raises(ValueError, match="SPGEMM_TPU_FAILPOINTS"):
+            failpoints.check("plan.build")
+    # and fixing the env (not just clearing it) re-arms without clear()
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "warm.load:1:1")
+    assert failpoints.check("warm.load") is True
+
+
+def test_failpoints_kinds_and_count_budget(monkeypatch):
+    # corrupt: check() returns True, site takes its own path; count caps
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "warm.load:1:2")
+    assert [failpoints.check("warm.load") for _ in range(4)] == \
+        [True, True, False, False]
+    assert failpoints.triggered() == {"warm.load": 2}
+    # raise: the registered exception, carrying the point name
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "plan.build")
+    with pytest.raises(failpoints.FailpointTriggered) as ei:
+        failpoints.check("plan.build")
+    assert ei.value.point == "plan.build"
+    # other points stay inert under a spec that does not name them
+    assert failpoints.check("delta.diff") is False
+
+
+def test_failpoints_prob_sequence_is_seeded(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "delta.diff:0.5")
+    seq1 = [failpoints.check("delta.diff") for _ in range(16)]
+    failpoints.clear()
+    seq2 = [failpoints.check("delta.diff") for _ in range(16)]
+    assert seq1 == seq2  # same spec => same trigger sequence
+    assert True in seq1 and False in seq1
+
+
+def test_failpoints_hang_releases_on_disarm(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "serve.executor")
+    t = threading.Thread(
+        target=lambda: failpoints.check("serve.executor"), daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert t.is_alive()  # hanging, the wedge signature
+    monkeypatch.delenv("SPGEMM_TPU_FAILPOINTS")
+    t.join(5.0)
+    assert not t.is_alive()  # released by disarming
+
+
+def test_failpoints_triggers_reach_metrics_and_events(monkeypatch):
+    from spgemm_tpu.obs import events as obs_events
+    from spgemm_tpu.obs import metrics as obs_metrics
+
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "warm.load:1:1")
+    assert failpoints.check("warm.load") is True
+    samples = obs_metrics.collect_engine()
+    assert ("spgemm_failpoints_triggered_total", {"point": "warm.load"},
+            1) in samples
+    kinds = [r for r in obs_events.LOG.tail(50)
+             if r.get("kind") == "failpoint_trigger"]
+    assert kinds and kinds[-1]["point"] == "warm.load"
+    # and the renderer accepts the family (declared, labeled)
+    text = obs_metrics.render(
+        [("spgemm_failpoints_triggered_total", {"point": "warm.load"}, 1)])
+    assert 'spgemm_failpoints_triggered_total{point="warm.load"} 1' in text
+
+
+# ------------------------------------------------- journal crash safety --
+def test_journal_frame_roundtrip_and_torn_lines():
+    ev = {"event": "submit", "id": "job-1", "folder": "/x"}
+    line = journal_frame(ev)
+    assert line.endswith("\n")
+    assert journal_parse_line(line.strip()) == ev
+    # a torn prefix of the frame fails the length/CRC check
+    for cut in (5, len(line) // 2, len(line) - 3):
+        assert journal_parse_line(line[:cut].strip()) is None
+    # a bit-flipped payload fails the CRC
+    bad = line.strip().replace("job-1", "job-2")
+    assert journal_parse_line(bad) is None
+    # legacy bare-JSON records (pre-framing journals) still parse
+    assert journal_parse_line('{"event":"done","id":"j"}') == \
+        {"event": "done", "id": "j"}
+    assert journal_parse_line('{"event":"done"') is None
+
+
+def test_journal_replay_truncates_at_torn_record_and_counts(tmp_path,
+                                                            make_daemon):
+    """Replay tolerates a mid-write kill: everything before the first
+    bad record replays, the tear is counted (stats + metrics), never a
+    crash -- and records past the tear are dropped (unattributable)."""
+    folder, _ = _chain_folder(tmp_path)
+    sock = str(tmp_path / "torn.sock")
+    ran = []
+    with open(sock + ".journal", "w", encoding="utf-8") as f:
+        f.write(journal_frame({"event": "submit", "id": "job-1",
+                               "folder": folder, "output": folder + "/o1",
+                               "options": {}}))
+        good = journal_frame({"event": "submit", "id": "job-2",
+                              "folder": folder, "output": folder + "/o2",
+                              "options": {}})
+        f.write(good[:len(good) // 2])  # the SIGKILL-mid-append tail
+    d = Daemon(sock, runner=lambda job, degraded=False: ran.append(job.id))
+    d.start()
+    try:
+        _wait_until(lambda: "job-1" in ran, msg="replayed job runs")
+        st = d._journal_stats()
+        assert st["torn"] == 1
+        assert "job-2" not in ran  # past the tear: dropped, not garbled
+        resp = d._op_metrics()
+        assert "spgemmd_journal_torn_total 1" in resp["text"]
+    finally:
+        d.stop()
+
+
+def test_journal_failpoint_writes_torn_record(tmp_path, monkeypatch):
+    """The serve.journal corrupt failpoint writes exactly the torn frame
+    the replay path must truncate at."""
+    folder, _ = _chain_folder(tmp_path)
+    sock = str(tmp_path / "fp.sock")
+    d = Daemon(sock, runner=lambda job, degraded=False: None)
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "serve.journal:1:1")
+    d._journal_append({"event": "submit", "id": "job-x", "folder": folder,
+                       "output": "o", "options": {}})
+    monkeypatch.delenv("SPGEMM_TPU_FAILPOINTS")
+    live, torn = d._journal_live_records()
+    assert live == [] and torn == 1
+
+
+# --------------------------------------------- self-healing recovery --
+def test_wedge_heal_lifecycle_one_slice_keeps_serving(tmp_path,
+                                                      make_daemon):
+    """The satellite acceptance test: wedge -> reap -> degrade on one
+    slice (the other keeps serving) -> heartbeat resumes -> un-wedge
+    (the abandoned executor aborts via JobAbandoned, never corrupting
+    the successor) -> recovery re-probe reinstates the slice behind the
+    canary gate -> the canary job completes and the slice graduates."""
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    first = threading.Event()
+    ran = []
+
+    def runner(job, degraded=False):
+        if not first.is_set() and not degraded:
+            first.set()
+            unwedge.wait(60)  # hung backend call: no beats, no return
+            job.touch()       # heartbeat resumes after the un-wedge
+            return
+        ran.append((job.id, job.slice, degraded))
+
+    d = make_daemon(runner=runner, slices="2", n_devices=2,
+                    job_timeout_s=0.3, wedge_grace_s=0.2,
+                    probe=lambda: "cpu", recover_s=0.1)
+    j1 = client.submit(folder, d.socket_path)
+    r1 = client.wait(j1["id"], d.socket_path, timeout=30)
+    assert r1["job"]["state"] == "failed"
+    assert r1["job"]["error"]["code"] == protocol.E_JOB_TIMEOUT
+    _wait_until(lambda: any(s.degraded for s in d.slices),
+                msg="wedged slice degrades")
+    # the pool keeps serving while one slice is down
+    j2 = client.submit(folder, d.socket_path)
+    assert client.wait(j2["id"], d.socket_path,
+                       timeout=30)["job"]["state"] == "done"
+    # recovery: the live probe reinstates the slice (canary armed)
+    _wait_until(lambda: not any(s.degraded for s in d.slices),
+                msg="degraded slice reinstated")
+    st = client.stats(d.socket_path)
+    healed = [s for s in st["slices"] if s["recoveries"] >= 1]
+    assert len(healed) == 1
+    assert healed[0]["recovered_at"] is not None
+    assert healed[0]["canary"] is True
+    # un-wedge: the abandoned executor resumes, beats, and aborts
+    unwedge.set()
+    # drive jobs until the healed slice serves its canary and graduates
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        j = client.submit(folder, d.socket_path)
+        client.wait(j["id"], d.socket_path, timeout=30)
+        st = client.stats(d.socket_path)
+        row = next(s for s in st["slices"] if s["recoveries"] >= 1)
+        if not row["canary"]:
+            break
+        time.sleep(0.05)
+    assert not row["canary"], "canary never settled"
+    assert not row["degraded"]
+    # healthy-pool bookkeeping: daemon-level flag/reason stayed null
+    assert st["degraded"] is False and st["degrade_reason"] is None
+    resp = d._op_metrics()
+    assert 'spgemm_slice_recoveries_total{slice="%s"} 1' % row["name"] \
+        in resp["text"]
+
+
+def test_recovery_disabled_by_default(tmp_path, make_daemon):
+    """recover_s=0 (the knob default) is the pre-recovery behavior: a
+    degraded slice stays degraded."""
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    first = threading.Event()
+
+    def runner(job, degraded=False):
+        if not first.is_set() and not degraded:
+            first.set()
+            unwedge.wait(60)
+
+    d = make_daemon(runner=runner, slices="2", n_devices=2,
+                    job_timeout_s=0.3, wedge_grace_s=0.2,
+                    probe=lambda: "cpu")
+    try:
+        j = client.submit(folder, d.socket_path)
+        client.wait(j["id"], d.socket_path, timeout=30)
+        _wait_until(lambda: any(s.degraded for s in d.slices),
+                    msg="wedged slice degrades")
+        time.sleep(0.5)  # several would-be recovery cadences
+        assert any(s.degraded for s in d.slices)
+        assert all(s.recoveries == 0 for s in d.slices)
+    finally:
+        unwedge.set()
+
+
+def test_canary_failure_redegrades_and_doubles_backoff(tmp_path,
+                                                       make_daemon):
+    """A slice that probes live but wedges its canary job re-degrades,
+    and the recovery backoff doubles -- the lying device waits longer
+    before its next audition.  A 1-slice pool pins the canary job to
+    the reinstated slice (in a wider pool another healthy slice could
+    pick it up and the sequence would race)."""
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        if not degraded:
+            release.wait(60)  # every healthy pickup wedges
+
+    d = make_daemon(runner=runner, job_timeout_s=0.4, wedge_grace_s=0.2,
+                    probe=lambda: "cpu", recover_s=0.2)
+    sl = d.slices[0]
+    try:
+        j1 = client.submit(folder, d.socket_path)
+        client.wait(j1["id"], d.socket_path, timeout=30)
+        _wait_until(lambda: sl.degraded, msg="first wedge degrades")
+        _wait_until(lambda: sl.recoveries >= 1 and not sl.degraded,
+                    timeout=20, msg="recovery reinstates the slice")
+        # the canary job wedges the reinstated slice again
+        j2 = client.submit(folder, d.socket_path)
+        client.wait(j2["id"], d.socket_path, timeout=30)
+        _wait_until(lambda: sl.degraded, timeout=20,
+                    msg="failed canary re-degrades")
+        with d._lock:
+            assert sl.canary is False
+            assert sl.recover_backoff >= 0.4  # doubled from the 0.2 base
+    finally:
+        release.set()
+
+
+def test_canary_gate_consumed_at_pickup_spares_the_next_job(
+        tmp_path, make_daemon):
+    """The gate tightens exactly ONE pickup: with a second job already
+    queued, the executor claims it before the watchdog's settle tick
+    observes the canary's outcome -- an unconsumed gate would tighten
+    (and spuriously reap) that job too on a healthy recovered slice."""
+    from spgemm_tpu.serve.queue import TERMINAL, JobAbandoned
+
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    first = threading.Event()
+
+    def runner(job, degraded=False):
+        if degraded:
+            return
+        if not first.is_set():
+            first.set()
+            unwedge.wait(60)  # the wedge trigger
+            return
+        # healthy post-reinstatement jobs: slow-but-alive well past the
+        # 0.4 s tightened (wedge-grace) deadline, beating throughout
+        deadline = time.time() + 1.2
+        while time.time() < deadline:
+            time.sleep(0.05)
+            job.touch()
+            if job.state in TERMINAL:
+                raise JobAbandoned(job.id)
+
+    d = make_daemon(runner=runner, job_timeout_s=0.0, wedge_grace_s=0.4,
+                    probe=lambda: "cpu", recover_s=0.1)
+    sl = d.slices[0]
+    try:
+        j1 = client.submit(folder, d.socket_path, {"timeout_s": 0.3})
+        client.wait(j1["id"], d.socket_path, timeout=30)
+        _wait_until(lambda: sl.degraded, msg="wedge degrades")
+        unwedge.set()  # straggler aborts before the gate arms
+        _wait_until(lambda: sl.recoveries >= 1 and not sl.degraded,
+                    timeout=20, msg="recovery reinstates")
+        # both queued before the canary runs: j3's pickup follows j2's
+        # abort immediately, ahead of any watchdog settle tick
+        j2 = client.submit(folder, d.socket_path)
+        j3 = client.submit(folder, d.socket_path)
+        r2 = client.wait(j2["id"], d.socket_path, timeout=30)
+        r3 = client.wait(j3["id"], d.socket_path, timeout=60)
+        assert r2["job"]["state"] == "failed"  # the audition, reaped
+        assert r2["job"]["error"]["code"] == protocol.E_JOB_TIMEOUT
+        assert r3["job"]["state"] == "done"  # untightened, unreaped
+        _wait_until(lambda: not sl.canary and sl.canary_job is None,
+                    msg="gate fully settles")
+    finally:
+        unwedge.set()
+
+
+def test_canary_settles_when_reaped_job_outlived_slow_not_wedged(
+        tmp_path, make_daemon):
+    """A canary job reaped under its tightened deadline whose executor
+    MOVES ON (heartbeats, aborts via JobAbandoned -- the slow-not-wedged
+    signature) settles the gate: moving on proves the device executes.
+    Without this, a deadline-less deployment would reap every long job
+    on a healthy recovered slice forever."""
+    from spgemm_tpu.serve.queue import TERMINAL, JobAbandoned
+
+    folder, _ = _chain_folder(tmp_path)
+    unwedge = threading.Event()
+    phase = {"n": 0}
+
+    def runner(job, degraded=False):
+        if degraded:
+            return
+        phase["n"] += 1
+        if phase["n"] == 1:
+            unwedge.wait(60)  # wedge: no beats, no return
+            return
+        # canary: SLOW but alive -- beats like chain_product and aborts
+        # at the next boundary once the watchdog reaped it (2 s: well
+        # past the 0.4 s tightened deadline, short enough that job 3
+        # finishes fast)
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            time.sleep(0.05)
+            job.touch()
+            if job.state in TERMINAL:
+                raise JobAbandoned(job.id)
+
+    # job_timeout_s=0: deadline-less deployment; only the canary gate's
+    # wedge-grace tightening gives job 2 a deadline at all
+    d = make_daemon(runner=runner, job_timeout_s=0.0, wedge_grace_s=0.4,
+                    probe=lambda: "cpu", recover_s=0.1)
+    sl = d.slices[0]
+    try:
+        j1 = client.submit(folder, d.socket_path,
+                           {"timeout_s": 0.3})  # the wedge trigger
+        client.wait(j1["id"], d.socket_path, timeout=30)
+        _wait_until(lambda: sl.degraded, msg="wedge degrades")
+        _wait_until(lambda: sl.recoveries >= 1 and not sl.degraded,
+                    timeout=20, msg="recovery reinstates")
+        j2 = client.submit(folder, d.socket_path)  # no deadline of its own
+        r2 = client.wait(j2["id"], d.socket_path, timeout=30)
+        assert r2["job"]["state"] == "failed"  # reaped under the gate
+        assert r2["job"]["error"]["code"] == protocol.E_JOB_TIMEOUT
+        # the executor outlives the reap (beats, aborts, moves on): the
+        # gate settles instead of dooming every later long job
+        _wait_until(lambda: not sl.canary, timeout=20,
+                    msg="canary settles on slow-not-wedged")
+        assert not sl.degraded
+        # and a later deadline-less job runs unreaped to completion
+        j3 = client.submit(folder, d.socket_path)
+        r3 = client.wait(j3["id"], d.socket_path, timeout=60)
+        assert r3["job"]["state"] == "done"
+    finally:
+        unwedge.set()
+
+
+def test_redegrade_of_degraded_slice_keeps_backoff(tmp_path, make_daemon):
+    """Re-degrading an ALREADY-degraded slice (its CPU-failover executor
+    died or wedged) must keep the accumulated exponential backoff:
+    resetting to the base cadence would resume auditioning a known-dead
+    device as if the failed probes never happened."""
+    d = make_daemon(recover_s=30.0, probe=lambda: "dead")
+    sl = d.slices[0]
+    d._degrade_slice(sl, "first degrade")
+    with d._lock:
+        assert sl.recover_backoff == 30.0  # fresh degrade: base cadence
+        sl.recover_backoff = 120.0  # as accumulated by failed probes
+    d._degrade_slice(sl, "degraded executor died")
+    with d._lock:
+        assert sl.recover_backoff == 120.0  # kept, not reset to base
+
+
+def test_stats_reports_armed_and_triggered_failpoints(
+        tmp_path, make_daemon, monkeypatch):
+    """The chaos surface is inspectable on a live daemon: stats carries
+    the armed points under the current spec and the trigger totals."""
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "warm.load:0.5:3")
+    d = make_daemon()
+    st = client.stats(d.socket_path)
+    assert st["failpoints"]["armed"]["warm.load"] == {
+        "kind": "corrupt", "prob": 0.5, "remaining": 3}
+    assert st["failpoints"]["triggered"] == {}
+
+
+def test_accepts_refuses_live_claim_allows_terminal_overwrite(
+        tmp_path, make_daemon):
+    """The reinstatement race's mutual exclusion, pinned at the claim
+    point: a LIVE claim on the slice (a retired executor still running
+    its last job) refuses the successor's claim and is never clobbered
+    -- deadline reaping and wedge attribution keep their target, and two
+    jobs can never dispatch on one slice's devices -- while a TERMINAL
+    leftover claim (a wedged executor's abandoned slot) must be
+    overwritable or the degraded replacement never serves again."""
+    from spgemm_tpu.serve.queue import Job
+
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    sl = d.slices[0]
+    held = Job("held", "f", "o", {})
+    held.start()  # live: running
+    sl.current = held
+    j = Job("nxt", "f", "o", {})
+    assert d._accepts(sl, j) is False
+    assert sl.current is held  # the live claim was not clobbered
+    held.finish("failed", error={"code": "x", "message": "reaped"})
+    assert d._accepts(sl, j) is True  # wedged leftover: overwrite
+    assert sl.current is j
+    sl.current = None
+
+
+def test_reinstatement_mid_job_serializes_with_straggler(tmp_path,
+                                                         make_daemon):
+    """End-to-end reinstatement race: _spawn_executor replaces an
+    executor MID-JOB (the recovery probe retires a live, actively
+    dispatching generation).  The successor must not claim the next job
+    until the straggler's job is terminal -- one job per slice at a
+    time, sl.current owned by the in-flight job throughout -- and both
+    jobs must complete once the straggler finishes."""
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+    ran = []
+
+    def runner(job, degraded=False):
+        ran.append(job.id)
+        if len(ran) == 1:
+            release.wait(30)  # the straggler's job, in flight
+
+    d = make_daemon(runner=runner)
+    sl = d.slices[0]
+    try:
+        j1 = client.submit(folder, d.socket_path)
+        _wait_until(lambda: sl.current is not None
+                    and sl.current.id == j1["id"],
+                    msg="straggler picks up job 1")
+        # the reinstatement: retire the live generation mid-job
+        d._spawn_executor(sl, degraded=False)
+        j2 = client.submit(folder, d.socket_path)
+        time.sleep(0.6)  # several successor poll cycles
+        cur = sl.current
+        assert cur is not None and cur.id == j1["id"], \
+            "successor clobbered the straggler's live claim"
+        assert d.queue.get(j2["id"]).state == "queued"
+        assert ran == [j1["id"]]
+    finally:
+        release.set()
+    r1 = client.wait(j1["id"], d.socket_path, timeout=30)
+    r2 = client.wait(j2["id"], d.socket_path, timeout=30)
+    assert r1["job"]["state"] == "done"
+    assert r2["job"]["state"] == "done"
+    assert ran == [j1["id"], j2["id"]]
+
+
+# --------------------------------------------------- client retry --
+def test_client_connect_retry_bounds_and_structured_error(tmp_path):
+    path = str(tmp_path / "nobody.sock")
+    t0 = time.time()
+    with pytest.raises(client.ServeError) as ei:
+        client.request({"op": "stats"}, path, retry_total_s=0.4)
+    assert ei.value.code == protocol.E_UNAVAILABLE
+    assert 0.3 <= time.time() - t0 < 5.0  # bounded total wait
+    # retry_total_s=0: exactly one attempt, still the structured error
+    t0 = time.time()
+    with pytest.raises(client.ServeError) as ei:
+        client.request({"op": "stats"}, path, retry_total_s=0)
+    assert ei.value.code == protocol.E_UNAVAILABLE
+    assert time.time() - t0 < 0.2
+
+
+def test_client_connect_retry_rides_out_daemon_restart(tmp_path):
+    """The rollout window: a submit launched while no daemon is bound
+    yet succeeds once the daemon comes up within the retry budget."""
+    folder, _ = _chain_folder(tmp_path)
+    sock = str(tmp_path / "late.sock")
+    d = Daemon(sock, runner=lambda job, degraded=False: None)
+
+    def _late_start():
+        time.sleep(0.4)
+        d.start()
+
+    t = threading.Thread(target=_late_start, daemon=True)
+    t.start()
+    try:
+        resp = client.submit(folder, sock)  # default retry window: 5 s
+        assert resp["ok"] and resp["id"]
+    finally:
+        t.join()
+        d.stop()
+
+
+# --------------------------------------------------- graceful drain --
+def test_stop_drains_then_reaps_with_structured_error(tmp_path,
+                                                      monkeypatch):
+    """stop() (the SIGTERM/shutdown path) waits DRAIN_GRACE_S for
+    in-flight jobs, then reaps stragglers with a structured
+    shutting-down error -- never a hang, never a silent loss."""
+    monkeypatch.setattr(Daemon, "DRAIN_GRACE_S", 0.3)
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        release.wait(60)
+
+    d = Daemon(str(tmp_path / "drain.sock"), runner=runner)
+    d.start()
+    try:
+        j = client.submit(folder, d.socket_path)
+        _wait_until(lambda: d.queue.get(j["id"]).state == "running",
+                    msg="job running")
+        t0 = time.time()
+        d.stop()
+        assert time.time() - t0 < 8.0  # drained, did not hang
+        job = d.queue.get(j["id"])
+        assert job.state == "failed"
+        assert job.error["code"] == protocol.E_SHUTTING_DOWN
+        # a drain reap is routine rollout fallout, not executor death:
+        # its own outcome label keeps "abandoned" alerts meaningful
+        assert d._terminal_totals["drained"] == 1
+        assert d._terminal_totals["abandoned"] == 0
+        assert not os.path.exists(d.socket_path)
+    finally:
+        release.set()
+
+
+def test_stop_lets_fast_jobs_finish_inside_the_grace(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setattr(Daemon, "DRAIN_GRACE_S", 5.0)
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        release.wait(30)
+
+    d = Daemon(str(tmp_path / "drain2.sock"), runner=runner)
+    d.start()
+    j = client.submit(folder, d.socket_path)
+    _wait_until(lambda: d.queue.get(j["id"]).state == "running",
+                msg="job running")
+    threading.Timer(0.2, release.set).start()
+    d.stop()
+    assert d.queue.get(j["id"]).state == "done"  # finished, not reaped
+
+
+# ------------------------------------------- warm flock probe race --
+def test_warm_stat_probe_never_cold_starts_a_daemon(tmp_path):
+    """The `cli warm --stat` flock probe (warmstore.scan) holds the dir
+    lock for microseconds; a daemon's configure() landing inside that
+    window must win via its ~250 ms retry, never run cold for its whole
+    lifetime.  The recovery re-probe path never touches the warm dir
+    (the probe is a subprocess matmul; the replacement executor reuses
+    the already-bound store), so this window is the only flock race."""
+    from spgemm_tpu.ops import warmstore
+
+    warm = str(tmp_path / "w.warm")
+    os.makedirs(warm)
+    stop = threading.Event()
+
+    def prober():
+        while not stop.is_set():
+            warmstore.scan(warm)  # takes + drops the flock each call
+
+    t = threading.Thread(target=prober, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.05)  # prober definitely spinning
+        assert warmstore.configure(warm) is True
+        assert warmstore.active()
+        # and the probe against the now-live owner reports locked
+        # without stealing it
+        info = warmstore.scan(warm)
+        assert info["locked"] is True
+        assert warmstore.active()
+    finally:
+        stop.set()
+        t.join(5.0)
+        warmstore.release()

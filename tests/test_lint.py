@@ -281,6 +281,72 @@ def test_met_registry_covers_live_call_sites():
         assert name in ENGINE_COUNTERS
 
 
+# ------------------------------------------------------------- FPT rule --
+def test_fpt_fixture_each_violation_caught():
+    """Undeclared failpoint names (module and bare-import spellings) and
+    a computed name are findings; declared names stay legal."""
+    findings = lint_file(os.path.join(FIXTURES, "badfailpoint.py"))
+    fpt = [f for f in findings if f.rule == "FPT"]
+    assert len(fpt) == 3 and findings == fpt
+    flagged = [f.line for f in fpt]
+    for needle in ("FPT: undeclared failpoint name",
+                   "FPT: computed failpoint name",
+                   "FPT: undeclared via the bare import"):
+        assert _fixture_lines("badfailpoint.py", needle)[0] in flagged
+    msgs = " ".join(f.message for f in fpt)
+    assert "made.up.point" in msgs and "also.made.up" in msgs
+    assert "utils/failpoints.py" in msgs
+    for needle in ("legal: declared (corrupt kind)",
+                   "legal: declared via the bare import"):
+        assert _fixture_lines("badfailpoint.py", needle)[0] not in flagged
+
+
+def test_fpt_stale_registry_entry_is_a_finding(tmp_path):
+    """The reverse direction: a registry entry no check() site names is
+    flagged AT THE REGISTRY -- and only when the registry module itself
+    is in the linted unit set (fixture runs over partial trees must not
+    call every entry stale)."""
+    import shutil
+
+    from spgemm_tpu.analysis.core import lint_report
+    from spgemm_tpu.utils.failpoints import REGISTRY
+
+    # a partial tree WITHOUT the registry module: quiet
+    site = tmp_path / "site.py"
+    site.write_text("from spgemm_tpu.utils import failpoints\n"
+                    "def f():\n"
+                    "    failpoints.check('warm.load')\n")
+    findings, _ = lint_report([str(site)], doc=False)
+    assert [f for f in findings if f.rule == "FPT"] == []
+
+    # the registry module + one site: every OTHER entry is stale
+    pkg = tmp_path / "utils"
+    pkg.mkdir()
+    shutil.copy(os.path.join(REPO, "spgemm_tpu", "utils",
+                             "failpoints.py"),
+                str(pkg / "failpoints.py"))
+    findings, _ = lint_report([str(site), str(pkg)], doc=False)
+    stale = [f for f in findings if f.rule == "FPT"
+             and "stale failpoint registry entry" in f.message]
+    assert len(stale) == len(REGISTRY) - 1  # all but the checked one
+    assert all(f.file.endswith("failpoints.py") for f in stale)
+    assert not any("'warm.load'" in f.message for f in stale)
+
+
+def test_fpt_registry_covers_live_call_sites():
+    """Every failpoint the chaos harness documents is declared (the repo
+    self-lint enforces site coverage; spot-check the registry side)."""
+    from spgemm_tpu.utils.failpoints import REGISTRY
+
+    for name in ("plan.build", "plan.ensure_exact", "kernel.dispatch",
+                 "delta.diff", "delta.splice", "warm.load", "warm.flush",
+                 "serve.journal", "serve.accept", "serve.readline",
+                 "serve.executor", "serve.heartbeat"):
+        assert name in REGISTRY
+    assert all(fp.kind in ("raise", "hang", "corrupt", "delay")
+               for fp in REGISTRY.values())
+
+
 # ------------------------------------------------------------- DOC rule --
 def test_doc_fixture_drift_caught():
     findings = check_claude_md(FIXTURE_CLAUDE)
@@ -565,10 +631,12 @@ def test_json_report_fixture_run():
     # (callchain) + 1 ops/estimate + 1 ops/delta numeric-scope;
     # badthread/badexcept/stalesup: 3 each; badmetric: undeclared phase
     # + undeclared counter + computed name + 2 deep-profiling + 2
-    # warm-layer near-misses
+    # warm-layer near-misses; badfailpoint: 2 undeclared + 1 computed
+    # (the stale-registry direction stays quiet -- the registry module
+    # is not in the fixture unit set)
     assert report["counts"] == {"FLD": 9, "KNB": 19, "BKD": 5, "THR": 3,
-                                "EXC": 3, "MET": 7, "DOC": 1, "SUP": 3,
-                                "PARSE": 0}
+                                "EXC": 3, "MET": 7, "FPT": 3, "DOC": 1,
+                                "SUP": 3, "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:
         assert set(f) == {"file", "line", "rule", "message"}

@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 from spgemm_tpu.serve import client, protocol
-from spgemm_tpu.serve.daemon import Daemon
+from spgemm_tpu.serve.daemon import Daemon, journal_parse_line
 from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
                                     QueueFull)
 from spgemm_tpu.utils import io_text
@@ -435,7 +435,7 @@ def test_journal_submit_record_precedes_terminal_event(tmp_path,
     d = make_daemon(runner=lambda job, degraded=False: None)
     j = client.submit(folder, d.socket_path)
     client.wait(j["id"], d.socket_path, timeout=30)
-    events = [json.loads(ln)["event"] for ln in
+    events = [journal_parse_line(ln.strip())["event"] for ln in
               open(d.journal_path, encoding="utf-8")]
     assert events == ["submit", "done"]
 
@@ -476,7 +476,7 @@ def test_restart_requeues_unfinished_jobs_from_journal(tmp_path,
         client.wait(j["id"], sock, timeout=120)
         # terminal events landed in the journal: a further restart would
         # re-queue nothing
-        events = [json.loads(ln) for ln in
+        events = [journal_parse_line(ln.strip()) for ln in
                   open(d2.journal_path, encoding="utf-8")]
         done = {e["id"] for e in events if e["event"] == "done"}
         assert {"job-1", "job-2"} <= done
@@ -496,7 +496,7 @@ def test_journal_compacts_at_runtime(tmp_path, make_daemon, monkeypatch):
         client.wait(j["id"], d.socket_path, timeout=30)
     # terminal event #4 compacted submit/done pairs 1-4 away; only jobs
     # 5 and 6 (submitted after the compaction) remain on disk
-    events = [json.loads(ln) for ln in
+    events = [journal_parse_line(ln.strip()) for ln in
               open(d.journal_path, encoding="utf-8")]
     assert len(events) == 4
     assert {e["id"] for e in events} == {"job-5", "job-6"}
@@ -925,7 +925,7 @@ def test_stats_reports_journal_and_terminal_totals(tmp_path, make_daemon):
     st = client.stats(d.socket_path)
     assert st["uptime_s"] >= 0
     assert st["jobs_terminal"] == {"done": 1, "error": 1, "timeout": 0,
-                                   "abandoned": 0}
+                                   "abandoned": 0, "drained": 0}
     journal = st["journal"]
     assert journal["enabled"] is True
     assert journal["path"] == d.journal_path
