@@ -22,7 +22,7 @@ MEMFLAG = $(MEMFLAG_$(MEM))
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test lint lint-sarif bench bench-large warm serve-smoke obs-smoke chaos-smoke clean
+.PHONY: all native run test lint lint-fast lint-sarif lint-cache-clean bench bench-large warm serve-smoke obs-smoke chaos-smoke clean
 
 all: native
 
@@ -51,12 +51,26 @@ test:
 
 # spgemm-lint: package-level invariant checker (FLD fold order incl. the
 # interprocedural taint pass, KNB knob registry, BKD import-time backend
-# touch, THR lock discipline, EXC exception contracts, SUP stale
-# suppressions, DOC doc drift); exit 1 on any finding.
+# touch, THR lock discipline, LCK lock-order deadlock detection, BLK
+# blocking-under-lock, TSI thread-shared inference, EXC exception
+# contracts, SUP stale suppressions, DOC doc drift); exit 1 on any
+# finding.  Per-file results are content-hash cached under .lint_cache/
+# (the linter is env-independent and jax-free, so a warm run re-runs only
+# changed files with byte-identical output).
 lint:
 	$(PY) -m spgemm_tpu.analysis --json
 
-# same run, plus a SARIF 2.1.0 log for CI / editor annotations
+# the inner-loop run: cached like `lint`, but skips the DOC drift checks
+# (knob/metrics/thread-inventory table diffs + CLI help imports)
+lint-fast:
+	$(PY) -m spgemm_tpu.analysis --json --no-doc
+
+# drop the content-hash cache (next run is fully cold)
+lint-cache-clean:
+	rm -rf .lint_cache
+
+# same run as `lint`, plus a SARIF 2.1.0 log for CI / editor annotations
+# (suppressed findings ride along as results with SARIF suppressions)
 lint-sarif:
 	$(PY) -m spgemm_tpu.analysis --json --sarif lint.sarif
 

@@ -28,6 +28,28 @@ structurally:
        `# spgemm-lint: guarded-by(<lock>)` accessed outside a
        `with <lock>:` block is a finding (__init__, *_locked methods,
        Condition aliases exempt; escape: reasoned thr-ok comment).
+  LCK  lock-order rule (lockrules.py): v3 builds an interprocedural
+       lock-acquisition-order graph from `with <lock>:` nests over the
+       call graph; a cycle (two paths acquiring registered locks in
+       opposite orders) or a non-reentrant re-acquisition is a
+       potential-deadlock finding with both witness chains (RLock is
+       exempt from the self-edge but participates in cycles; escape:
+       reasoned lck-ok comment).
+  BLK  blocking-under-lock rule (lockrules.py): a blocking operation
+       (sleep, subprocess, flock/fsync, socket accept/recv/sendall,
+       Queue.get/put, Thread.join, Event/Condition.wait,
+       block_until_ready) reached transitively while a registered lock
+       is held is a finding with the witness chain down to the blocking
+       call (escape: reasoned blk-ok comment, at the call site or at the
+       blocking source).
+  TSI  thread-shared inference (lockrules.py): functions passed to
+       threading.Thread(target=...) are thread roots -- nested defs
+       included (no inherited __init__ write exemption), and a root
+       spawned in a loop or from >= 2 sites counts as two threads by
+       itself; an instance attribute or module global written from
+       >= 2 root-weighted threads without a guarded-by(<lock>)
+       annotation is a finding -- THR's opt-in hole, closed (escape:
+       reasoned tsi-ok comment).
   EXC  exception rule (excrules.py): a broad `except Exception` needs the
        `# noqa: BLE001 -- <reason>` justification; a bare `except:` /
        `except BaseException` must end its handler in `raise` (the
@@ -45,17 +67,17 @@ Everything is stdlib-only: the linter never imports jax, so it can never
 hang on a dead TPU.
 """
 
-from spgemm_tpu.analysis.core import (RULES, Finding, Suppression,
-                                      is_numeric_module, lint_file,
-                                      lint_paths, lint_report, lint_repo,
-                                      repo_root)
+from spgemm_tpu.analysis.core import (RULES, Finding, LintCache, Report,
+                                      Suppression, is_numeric_module,
+                                      lint_file, lint_paths, lint_report,
+                                      lint_repo, lint_run, repo_root)
 from spgemm_tpu.analysis.docrules import (KNOB_TABLE_BEGIN, KNOB_TABLE_END,
                                           check_analysis_help,
                                           check_claude_md, check_cli_help)
 
 __all__ = [
-    "Finding", "Suppression", "RULES", "lint_file", "lint_paths",
-    "lint_report", "lint_repo", "repo_root", "is_numeric_module",
-    "check_analysis_help", "check_claude_md", "check_cli_help",
-    "KNOB_TABLE_BEGIN", "KNOB_TABLE_END",
+    "Finding", "LintCache", "Report", "Suppression", "RULES", "lint_file",
+    "lint_paths", "lint_report", "lint_repo", "lint_run", "repo_root",
+    "is_numeric_module", "check_analysis_help", "check_claude_md",
+    "check_cli_help", "KNOB_TABLE_BEGIN", "KNOB_TABLE_END",
 ]

@@ -11,11 +11,20 @@ machine-readable report object on stdout:
   {"findings": [{"file", "line", "rule", "message"}, ...],
    "counts": {<rule id>: n for every registered rule},
    "suppressions": [{"file", "line", "rule", "reason", "stale"}, ...],
+   "cache": {"enabled", "dir"?, "hits"?, "misses"?, "invalidations"?},
    "clean": bool}
 (the suppression inventory lists EVERY escape-hatch comment in the run --
-fld-proof / thr-ok / exc-ok -- with stale=true for an escape that no longer
-suppresses anything; a stale escape is also a SUP finding).
---sarif F additionally writes a SARIF 2.1.0 log to F (`make lint-sarif`).
+fld-proof / thr-ok / exc-ok / lck-ok / blk-ok / tsi-ok -- with stale=true
+for an escape that no longer suppresses anything; a stale escape is also a
+SUP finding).  --sarif F additionally writes a SARIF 2.1.0 log to F
+(`make lint-sarif`), with suppressed findings carried as results bearing
+SARIF `suppressions` objects.
+
+Per-file results are content-hash cached under `.lint_cache/` by default
+(the linter is proven env-independent and jax-free, so a file's findings
+are a pure function of its bytes + the analysis package's bytes): a warm
+`make lint` re-runs only changed files.  `--no-cache` disables it,
+`--cache-dir` relocates it (tests), `make lint-cache-clean` empties it.
 """
 
 from __future__ import annotations
@@ -41,9 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
         description="spgemm-lint: package-level invariant checker (FLD fold "
                     "order incl. interprocedural taint, KNB knob registry, "
                     "BKD import-time backend touch, THR lock discipline, "
-                    "EXC exception contracts, MET metric registry, FPT "
-                    "failpoint registry, SUP stale suppressions, DOC "
-                    "doc drift)",
+                    "LCK lock-order deadlock detection, BLK blocking-under-"
+                    "lock, TSI thread-shared inference, EXC exception "
+                    "contracts, MET metric registry, FPT failpoint "
+                    "registry, SUP stale suppressions, DOC doc drift)",
         epilog=epilog)
     p.add_argument("paths", nargs="*",
                    help="files/dirs to lint (default: the spgemm_tpu "
@@ -71,6 +81,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--write-metrics-table", action="store_true",
                    help="regenerate the ARCHITECTURE.md metrics-table "
                         "block from the obs/metrics.py registry and exit")
+    p.add_argument("--write-thread-inventory", action="store_true",
+                   help="regenerate the ARCHITECTURE.md thread-inventory "
+                        "block from the concurrency pass (LCK/BLK/TSI) "
+                        "over the default scope and exit")
+    p.add_argument("--no-cache", action="store_true",
+                   help="disable the content-hash per-file result cache "
+                        "(.lint_cache/; the default run caches)")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="cache directory (default: <repo>/.lint_cache)")
     return p
 
 
@@ -104,9 +123,10 @@ def main(argv: list[str] | None = None) -> int:
 
     root = core.repo_root()
     default_claude = os.path.join(root, "CLAUDE.md")
-    if args.write_knob_table or args.write_metrics_table:
-        # both flags compose: "regenerate everything" must not silently
-        # leave the second table stale behind the first's early return
+    if args.write_knob_table or args.write_metrics_table \
+            or args.write_thread_inventory:
+        # the flags compose: "regenerate everything" must not silently
+        # leave a later table stale behind an earlier early return
         rc = 0
         if args.write_knob_table:
             rc = max(rc, _write_block(
@@ -119,6 +139,12 @@ def main(argv: list[str] | None = None) -> int:
                                                      "ARCHITECTURE.md"),
                 docrules.METRICS_TABLE_BEGIN, docrules.METRICS_TABLE_END,
                 docrules.render_metrics_block(), "metrics table"))
+        if args.write_thread_inventory:
+            rc = max(rc, _write_block(
+                args.architecture_md or os.path.join(root,
+                                                     "ARCHITECTURE.md"),
+                docrules.THREAD_TABLE_BEGIN, docrules.THREAD_TABLE_END,
+                docrules.render_thread_block(), "thread inventory"))
         return rc
 
     if args.paths:
@@ -127,21 +153,24 @@ def main(argv: list[str] | None = None) -> int:
     else:
         paths = core.default_paths()
         claude_md = args.claude_md or default_claude
+    cache = None if args.no_cache else core.LintCache(args.cache_dir)
     # the DOC half (knob table + CLI/analysis help) runs only when a
     # CLAUDE.md is in play: default runs always, explicit-path runs only
     # with --claude-md
-    findings, suppressions = core.lint_report(
+    report = core.lint_run(
         paths, claude_md=claude_md,
-        doc=not args.no_doc and claude_md is not None)
+        doc=not args.no_doc and claude_md is not None, cache=cache)
+    findings, suppressions = report.findings, report.suppressions
 
     if args.sarif:
-        sarif.write(args.sarif, findings)
+        sarif.write(args.sarif, findings, report.suppressed)
     if args.as_json:
         counts = collections.Counter(f.rule for f in findings)
         print(json.dumps({
             "findings": [f.to_dict() for f in findings],
             "counts": {rule: counts.get(rule, 0) for rule in core.RULES},
             "suppressions": [s.to_dict() for s in suppressions],
+            "cache": report.cache or {"enabled": False},
             "clean": not findings,
         }, indent=2))
     else:

@@ -57,6 +57,13 @@ class _Module:
     funcs: dict = field(default_factory=dict)       # label -> _Func
     toplevel_calls: list = field(default_factory=list)
     used_escapes: set = field(default_factory=set)  # taint-suppressing lines
+    classes: set = field(default_factory=set)       # class names defined here
+    # module-level singleton instances: `ENGINE = PhaseTimers()` makes
+    # `ENGINE.incr(...)` resolve to PhaseTimers.incr -- the repo's
+    # process-wide registries (timers.ENGINE, trace.RECORDER, events.LOG)
+    # are exactly this shape, and the concurrency pass needs their lock
+    # acquisitions visible through the singleton spelling
+    singletons: dict = field(default_factory=dict)  # local name -> class name
 
 
 def _module_name(unit: LintUnit) -> str:
@@ -94,9 +101,20 @@ def _collect(unit: LintUnit) -> _Module:
             add_import(node)
             return
         if isinstance(node, ast.ClassDef):
+            mod.classes.add(node.name)
             for child in ast.iter_child_nodes(node):
                 visit(child, func, node.name)
             return
+        if (isinstance(node, ast.Assign) and func is None and cls is None
+                and isinstance(node.value, ast.Call)):
+            # module-level singleton: NAME = Cls(...) with Cls defined in
+            # this module (class defs precede their instantiation in file
+            # order, so one pass sees them)
+            cls_name = (dotted_name(node.value.func) or "").rsplit(".", 1)[-1]
+            if cls_name in mod.classes:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        mod.singletons[target.id] = cls_name
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             label = f"{cls}.{node.name}" if cls else node.name
             # a nested def folds into its enclosing function's info (it
@@ -158,7 +176,21 @@ class _Graph:
         return None
 
     def _lookup(self, module: _Module, label: str) -> _Func | None:
-        return module.funcs.get(label)
+        f = module.funcs.get(label)
+        if f is not None:
+            return f
+        # class instantiation: a call spelled `Cls(...)` (or resolving to
+        # the class name) executes Cls.__init__
+        if "." not in label and label in module.classes:
+            return module.funcs.get(f"{label}.__init__")
+        return None
+
+    def _lookup_singleton(self, module: _Module, inst: str,
+                          method: str) -> _Func | None:
+        cls_name = module.singletons.get(inst)
+        if cls_name is None:
+            return None
+        return module.funcs.get(f"{cls_name}.{method}")
 
     def resolve(self, module: _Module, name: str,
                 cls: str | None) -> _Func | None:
@@ -174,6 +206,14 @@ class _Graph:
                     f = self._lookup(target, info[1])
                     if f is not None:
                         return f
+            elif kind == "member" and len(rest) == 1:
+                # imported singleton: `from ...timers import ENGINE;
+                # ENGINE.incr(...)`
+                target = self._resolve_module(info[0])
+                if target is not None:
+                    f = self._lookup_singleton(target, info[1], rest[0])
+                    if f is not None:
+                        return f
             elif kind == "mod" and rest:
                 target = self._resolve_module(
                     ".".join([info[0]] + rest[:-1]))
@@ -181,9 +221,24 @@ class _Graph:
                     f = self._lookup(target, rest[-1])
                     if f is not None:
                         return f
+                if len(rest) >= 2:
+                    # module-attribute singleton: `import ...timers as t;
+                    # t.ENGINE.incr(...)` / `obs_events.LOG.emit(...)`
+                    target = self._resolve_module(
+                        ".".join([info[0]] + rest[:-2]))
+                    if target is not None:
+                        f = self._lookup_singleton(target, rest[-2],
+                                                   rest[-1])
+                        if f is not None:
+                            return f
         if not rest:
             # same-module function (or Class.method spelled directly)
             return self._lookup(module, head)
+        if len(rest) == 1:
+            # same-module singleton: `LOG.emit(...)` under `LOG = EventLog()`
+            f = self._lookup_singleton(module, head, rest[0])
+            if f is not None:
+                return f
         # fully-dotted spelling against the module set, longest prefix
         for split in range(len(parts) - 1, 0, -1):
             target = self.by_name.get(".".join(parts[:split]))
@@ -239,8 +294,19 @@ class _Graph:
         return witness, witness is None and provisional
 
 
-def check(units: list[LintUnit]) -> tuple[list[Finding], list[Finding],
-                                          set[tuple[str, int]]]:
+def build(units: list[LintUnit]) -> tuple[list, "_Graph"]:
+    """Collect the whole-program (modules, graph) pair ONCE per lint
+    run: this pass and the LCK/BLK/TSI concurrency pass (lockrules)
+    both walk the same resolved call graph, and rebuilding it per pass
+    doubles the dominant AST-walk cost of `make lint`."""
+    modules = [_collect(u) for u in units if u.tree is not None]
+    return modules, _Graph(modules)
+
+
+def check(units: list[LintUnit], *,
+          prebuilt: tuple | None = None) -> tuple[list[Finding],
+                                                  list[Finding],
+                                                  set[tuple[str, int]]]:
     """The interprocedural pass over one lint run's unit set.
 
     Returns (findings, raw_findings, used_source_escapes): findings honor
@@ -248,9 +314,8 @@ def check(units: list[LintUnit]) -> tuple[list[Finding], list[Finding],
     suppression audit derives escape usage from the difference), and
     used_source_escapes are (file, line) of escapes that suppressed a
     reduction at its source, which keeps the callee untainted -- also
-    "used" for the audit."""
-    modules = [_collect(u) for u in units if u.tree is not None]
-    graph = _Graph(modules)
+    "used" for the audit.  prebuilt: a build(units) result to reuse."""
+    modules, graph = prebuilt if prebuilt is not None else build(units)
     findings: list[Finding] = []
     raw: list[Finding] = []
     used: set[tuple[str, int]] = set()

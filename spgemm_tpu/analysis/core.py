@@ -18,10 +18,13 @@ from __future__ import annotations
 
 import ast
 import fnmatch
+import hashlib
 import io
+import json
 import os
+import sys
 import tokenize
-from dataclasses import asdict, dataclass
+from dataclasses import asdict, dataclass, field
 
 # FLD scope: the modules on the numeric path, where the reference's
 # wrap-then-mod fold order is load-bearing (SURVEY.md section 2.9).
@@ -50,7 +53,11 @@ BACKEND_PROBE_SUFFIX = "/utils/backend_probe.py"
 FLD_ESCAPE = "spgemm-lint: fld-proof("
 THR_ESCAPE = "spgemm-lint: thr-ok("
 EXC_ESCAPE = "spgemm-lint: exc-ok("
-ESCAPE_MARKERS = {"FLD": FLD_ESCAPE, "THR": THR_ESCAPE, "EXC": EXC_ESCAPE}
+LCK_ESCAPE = "spgemm-lint: lck-ok("
+BLK_ESCAPE = "spgemm-lint: blk-ok("
+TSI_ESCAPE = "spgemm-lint: tsi-ok("
+ESCAPE_MARKERS = {"FLD": FLD_ESCAPE, "THR": THR_ESCAPE, "EXC": EXC_ESCAPE,
+                  "LCK": LCK_ESCAPE, "BLK": BLK_ESCAPE, "TSI": TSI_ESCAPE}
 
 # The rule-id registry: single source for the CLI --help epilog, the JSON
 # counts object, and the SARIF tool.driver.rules metadata (docrules checks
@@ -66,6 +73,20 @@ RULES = {
            "@host_only worker body) outside utils/backend_probe.py",
     "THR": "attribute declared `# spgemm-lint: guarded-by(<lock>)` "
            "accessed without holding the lock; escape: thr-ok(<reason>)",
+    "LCK": "lock-order deadlock hazard: a cycle in the interprocedural "
+           "lock-acquisition-order graph (two paths acquire registered "
+           "locks in opposite orders), or a non-reentrant lock "
+           "re-acquired while already held; escape: lck-ok(<reason>)",
+    "BLK": "blocking operation (sleep, subprocess, flock/fsync, socket "
+           "accept/recv/sendall, Queue.get/put, Thread.join, "
+           "Event/Condition.wait, block_until_ready) reached while a "
+           "registered lock is held, with the witness chain; escape: "
+           "blk-ok(<reason>)",
+    "TSI": "thread-shared inference: an instance attribute or module "
+           "global written from >= 2 thread roots "
+           "(threading.Thread targets) without a guarded-by(<lock>) "
+           "annotation -- THR's opt-in hole, closed; escape: "
+           "tsi-ok(<reason>)",
     "EXC": "broad `except Exception` without a `# noqa: BLE001 -- "
            "<reason>` justification, or a bare except / "
            "`except BaseException` that does not provably re-raise "
@@ -104,7 +125,7 @@ class Suppression:
 
     file: str
     line: int
-    rule: str    # the family the escape belongs to (FLD | THR | EXC)
+    rule: str    # escape family (FLD | THR | EXC | LCK | BLK | TSI)
     reason: str
     stale: bool
 
@@ -195,30 +216,48 @@ class LintUnit:
                         for rule, marker in ESCAPE_MARKERS.items()}
 
 
+def escape_at(escapes: dict[int, str], line: int) -> int | None:
+    """The escape line covering `line` -- the line itself or the one
+    above (the two lines every spgemm-lint escape can attach to).  THE
+    one spelling of the attachment rule: the per-file filter, the
+    suppressed-split, and the lockrules emit paths all call this."""
+    if line in escapes:
+        return line
+    if line - 1 in escapes:
+        return line - 1
+    return None
+
+
 def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
-                                        set[tuple[str, str, int]]]:
+                                        set[tuple[str, str, int]],
+                                        list[tuple[Finding, str]]]:
     """The per-file rule families (FLD/KNB/BKD/THR/EXC) over one unit.
 
     Each escapable family runs ONCE with escapes ignored; the escape
-    filter is applied here, so the same pass yields both the surviving
-    findings and the raw (file, rule, line) triples the suppression audit
-    needs to tell used escapes from stale ones."""
+    filter is applied here, so the same pass yields the surviving
+    findings, the raw (file, rule, line) triples the suppression audit
+    needs to tell used escapes from stale ones, and the suppressed
+    findings with their justifications (the SARIF suppressions surface)."""
     from spgemm_tpu.analysis import (excrules, fptrules, metrules,  # noqa: PLC0415
                                      rules, thrrules)
 
     if unit.tree is None:
-        return [unit.parse_finding], set()
+        return [unit.parse_finding], set(), []
     p = _posix(unit.path)
     findings: list[Finding] = []
     raw: set[tuple[str, str, int]] = set()
+    suppressed: list[tuple[Finding, str]] = []
 
     def escaping(family: list[Finding], rule: str) -> list[Finding]:
-        escapes = set(unit.escapes[rule])
+        escapes = unit.escapes[rule]
         out = []
         for f in family:
             raw.add((f.file, rule, f.line))
-            if f.line not in escapes and f.line - 1 not in escapes:
+            esc = escape_at(escapes, f.line)
+            if esc is None:
                 out.append(f)
+            else:
+                suppressed.append((f, escapes[esc]))
         return out
 
     if unit.numeric:
@@ -232,7 +271,7 @@ def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
     findings += escaping(excrules.check_exc(unit, set()), "EXC")
     findings += metrules.check_met(unit.tree, unit.file)
     findings += fptrules.check_fpt(unit.tree, unit.file)
-    return findings, raw
+    return findings, raw, suppressed
 
 
 def lint_file(path: str, *, numeric: bool | None = None) -> list[Finding]:
@@ -240,8 +279,145 @@ def lint_file(path: str, *, numeric: bool | None = None) -> list[Finding]:
 
     numeric: override the path-based FLD scoping (tests); None = derive
     from the path suffix.  The cross-file passes (interprocedural FLD,
-    suppression audit) need the whole unit set -- use lint_paths."""
+    the LCK/BLK/TSI concurrency pass, the suppression audit) need the
+    whole unit set -- use lint_paths."""
     return _lint_unit(LintUnit(path, numeric=numeric))[0]
+
+
+DEFAULT_CACHE_DIR = ".lint_cache"
+
+
+# registry modules the CACHED per-file rules validate against: MET reads
+# ENGINE_PHASES/ENGINE_COUNTERS from obs/metrics.py, FPT reads REGISTRY
+# from utils/failpoints.py -- a registry edit must invalidate every
+# cached entry even when the call sites' own files are untouched, so
+# both are part of the linter-version signature (paths relative to the
+# spgemm_tpu package root)
+_SIGNATURE_EXTRAS = ("obs/metrics.py", "utils/failpoints.py")
+
+
+def _analysis_signature() -> str:
+    """Content hash of the analysis package itself plus the registry
+    modules the cached rules consult -- the linter-version half of every
+    cache key, so ANY rule or registry change (not just a forgotten
+    version bump) invalidates every cached entry."""
+    h = hashlib.sha256()
+    # results also depend on the running interpreter's ast/tokenize
+    # behavior (f-string tokenization, node shapes shift across
+    # minors): a CI image bump must not serve the old Python's results
+    h.update(sys.version.encode())
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    files = [(name, os.path.join(pkg, name))
+             for name in sorted(os.listdir(pkg)) if name.endswith(".py")]
+    files += [(rel, os.path.join(os.path.dirname(pkg), rel))
+              for rel in _SIGNATURE_EXTRAS]
+    for label, path in files:
+        h.update(label.encode())
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+class LintCache:
+    """Content-hash cache for the per-file rule families.
+
+    One JSON file (default `.lint_cache/cache.json` under the repo root)
+    maps a unit's repo-relative path to its per-file findings, raw
+    triples, and suppressed findings, keyed by (sha256 of the file
+    contents, sha256 of the analysis package).  The linter is proven
+    env-independent and jax-free (tests pin both), so per-file results
+    are a pure function of exactly those two hashes -- a warm `make lint`
+    re-runs only changed files.  The cross-file passes (interprocedural
+    FLD, LCK/BLK/TSI, the FPT registry direction, the suppression audit,
+    DOC) always run live: they are whole-program by definition.
+
+    hit = entry matched; miss = no entry for the file; invalidation =
+    entry present but stale (file or linter changed) and replaced.
+    Writes are atomic (tmp + os.replace) and best-effort: a racing or
+    read-only cache degrades to a cold run, never an error."""
+
+    def __init__(self, directory: str | None = None):
+        self.directory = directory or os.path.join(repo_root(),
+                                                   DEFAULT_CACHE_DIR)
+        self.path = os.path.join(self.directory, "cache.json")
+        self.signature = _analysis_signature()
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+        self._dirty = False
+        self._files: dict[str, dict] = {}
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+            if isinstance(data, dict) and isinstance(data.get("files"),
+                                                     dict):
+                self._files = data["files"]
+        except (OSError, ValueError):
+            self._files = {}
+
+    @staticmethod
+    def content_key(source: str) -> str:
+        return hashlib.sha256(source.encode("utf-8")).hexdigest()
+
+    def get(self, file: str, sha: str):
+        """Cached (findings, raw, suppressed) for a unit, or None."""
+        entry = self._files.get(file)
+        if entry is None:
+            self.misses += 1
+            return None
+        try:
+            if entry.get("sha") != sha \
+                    or entry.get("version") != self.signature:
+                self.invalidations += 1
+                return None
+            findings = [Finding(**f) for f in entry["findings"]]
+            raw = {(r[0], r[1], r[2]) for r in entry["raw"]}
+            suppressed = [(Finding(**f), reason)
+                          for f, reason in entry["suppressed"]]
+        except (AttributeError, KeyError, IndexError, TypeError,
+                ValueError):
+            # structurally malformed entry (hand edit, bad merge, torn
+            # concurrent write that still parses): the cold-run
+            # fallback, never a crash
+            self.invalidations += 1
+            return None
+        self.hits += 1
+        return findings, raw, suppressed
+
+    def put(self, file: str, sha: str, findings, raw, suppressed) -> None:
+        self._files[file] = {
+            "sha": sha, "version": self.signature,
+            "findings": [f.to_dict() for f in findings],
+            "raw": sorted(list(t) for t in raw),
+            "suppressed": [[f.to_dict(), reason]
+                           for f, reason in suppressed],
+        }
+        self._dirty = True
+
+    def prune(self, keep: set[str]) -> None:
+        """Drop entries for files no longer in the linted set (renames,
+        deletions) -- called on default-scope runs so cache.json cannot
+        grow without bound under a long-lived checkout."""
+        for file in [f for f in self._files if f not in keep]:
+            del self._files[file]
+            self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"files": self._files}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # read-only / racing cache dir: next run is just cold
+
+    def stats(self) -> dict:
+        return {"enabled": True, "dir": self.directory, "hits": self.hits,
+                "misses": self.misses,
+                "invalidations": self.invalidations}
 
 
 def _walk_py(path: str) -> list[str]:
@@ -257,59 +433,144 @@ def _walk_py(path: str) -> list[str]:
 
 def _audit_suppressions(units: list[LintUnit],
                         raw: set[tuple[str, str, int]],
-                        extra_used: set[tuple[str, int]]) -> list[Suppression]:
+                        extra_used: set[tuple[str, str, int]]
+                        ) -> list[Suppression]:
     """The suppression inventory.  An escape is USED when the raw run of
     its rule family (escapes ignored -- the (file, rule, line) triples the
-    per-file pass already produced) has a finding on the escape's line or
-    the line below (the two lines an escape can attach to), or -- for
-    FLD -- when it sits on an unordered reduction whose taint it suppresses
-    in the interprocedural pass (extra_used, from callgraph.check)."""
+    per-file AND package-level passes produced) has a finding on the
+    escape's line or the line below (the two lines an escape can attach
+    to), or when it appears in extra_used: (file, rule, escape line) of
+    SOURCE escapes that suppressed taint without an anchored raw finding
+    (an fld-proof on a reduction, a blk-ok on the blocking op itself, a
+    tsi-ok on a non-anchor write line)."""
     out: list[Suppression] = []
     for u in units:
         for rule, escapes in u.escapes.items():
             for line, reason in sorted(escapes.items()):
                 used = ((u.file, rule, line) in raw
                         or (u.file, rule, line + 1) in raw
-                        or (rule == "FLD" and ((u.file, line) in extra_used
-                                               or (u.file, line + 1)
-                                               in extra_used)))
+                        or (u.file, rule, line) in extra_used)
                 out.append(Suppression(u.file, line, rule, reason,
                                        stale=not used))
     return out
 
 
-def lint_report(paths: list[str], *, claude_md: str | None = None,
-                doc: bool = True) -> tuple[list[Finding], list[Suppression]]:
-    """The full v2 run over files/directories: per-file rules, the
-    interprocedural fold-order pass, the suppression audit (stale escapes
-    are SUP findings; the full inventory is returned for --json), and
+@dataclass
+class Report:
+    """One full lint run: surviving findings, the escape inventory, the
+    suppressed findings with their justifications (the SARIF
+    `suppressions` surface), and the cache figures when a LintCache was
+    in play."""
+
+    findings: list[Finding] = field(default_factory=list)
+    suppressions: list[Suppression] = field(default_factory=list)
+    suppressed: list[tuple[Finding, str]] = field(default_factory=list)
+    cache: dict | None = None
+
+
+def _escaped_split(findings: list[Finding], raw: list[Finding],
+                   units_by_file: dict[str, LintUnit], rule: str,
+                   ) -> list[tuple[Finding, str]]:
+    """The raw-minus-surviving findings of a package-level pass, paired
+    with the escape reasons that suppressed them."""
+    survived = set(findings)
+    out = []
+    for f in raw:
+        if f in survived:
+            continue
+        unit = units_by_file.get(f.file)
+        if unit is None:
+            continue
+        escapes = unit.escapes.get(rule, {})
+        esc = escape_at(escapes, f.line)
+        if esc is not None:
+            out.append((f, escapes[esc]))
+    return out
+
+
+def lint_run(paths: list[str], *, claude_md: str | None = None,
+             doc: bool = True, cache: LintCache | None = None) -> Report:
+    """The full v3 run over files/directories: per-file rules (optionally
+    content-hash cached), the interprocedural fold-order pass, the
+    LCK/BLK/TSI concurrency pass, the suppression audit (stale escapes
+    are SUP findings; the full inventory rides the report), and
     optionally the DOC drift checks (claude_md None = skip the table
-    check; the CLI/analysis help checks ride the same flag)."""
-    from spgemm_tpu.analysis import callgraph, docrules, fptrules  # noqa: PLC0415
+    checks; the CLI/analysis help checks ride the same flag)."""
+    from spgemm_tpu.analysis import (callgraph, docrules,  # noqa: PLC0415
+                                     fptrules, lockrules)
 
     units = [LintUnit(f) for path in paths for f in _walk_py(path)]
-    findings: list[Finding] = []
+    units_by_file = {u.file: u for u in units}
+    is_default_scope = list(paths) == default_paths()
+    report = Report()
+    findings = report.findings
     raw: set[tuple[str, str, int]] = set()
     for u in units:
-        unit_findings, unit_raw = _lint_unit(u)
+        cached = None
+        if cache is not None:
+            sha = cache.content_key(u.source)
+            cached = cache.get(u.file, sha)
+        if cached is None:
+            unit_findings, unit_raw, unit_sup = _lint_unit(u)
+            if cache is not None:
+                cache.put(u.file, sha, unit_findings, unit_raw, unit_sup)
+        else:
+            unit_findings, unit_raw, unit_sup = cached
         findings += unit_findings
         raw |= unit_raw
+        report.suppressed += unit_sup
+    if cache is not None:
+        if is_default_scope:
+            cache.prune({u.file for u in units})
+        cache.save()
+        report.cache = cache.stats()
     # the FPT stale-entry direction needs the whole unit set (a registry
     # entry is live if ANY module checks it); it self-gates on the
     # registry module being in scope, so fixture runs stay quiet
     findings += fptrules.check_fpt_registry(units)
-    cg_findings, cg_raw, cg_used = callgraph.check(units)
+    # package-level passes: interprocedural FLD taint, then the
+    # concurrency-soundness pass (lock order / blocking-under-lock /
+    # thread-shared inference) over the same call graph.  Their raw
+    # findings feed the audit exactly like per-file raw runs: an escape
+    # is used iff a raw finding sits ON its line or the line below; their
+    # source-escape sets (taint suppressed at the source, no anchored
+    # finding) arrive as exact (file, rule, escape-line) triples.
+    extra_used: set[tuple[str, str, int]] = set()
+    prebuilt = callgraph.build(units)
+    cg_findings, cg_raw, cg_used = callgraph.check(units,
+                                                   prebuilt=prebuilt)
     findings += cg_findings
-    # interprocedural raw findings feed the audit exactly like per-file
-    # raw runs: a call-site escape is used iff a raw finding sits ON the
-    # escape's line or the line below -- the audit itself checks both, so
-    # only the finding's own line goes into the used set (widening it
-    # here would vouch for an escape two lines above the finding, which
-    # suppresses nothing)
-    used = set(cg_used)
+    report.suppressed += _escaped_split(cg_findings, cg_raw,
+                                        units_by_file, "FLD")
     for f in cg_raw:
-        used.add((f.file, f.line))
-    suppressions = _audit_suppressions(units, raw, used)
+        raw.add((f.file, "FLD", f.line))
+    for file, line in cg_used:
+        extra_used.add((file, "FLD", line))
+    # when this run's unit set IS the default scope and the DOC checks
+    # will want the thread-inventory table, harvest the rows from the
+    # concurrency pass's analysis instead of rebuilding the whole
+    # program a second time inside docrules
+    inv_rows: list | None = None
+    if doc and claude_md is not None and is_default_scope:
+        inv_rows = []
+    lk_suppressed: list = []
+    lk_findings, lk_raw, lk_used = lockrules.check(units,
+                                                   inventory=inv_rows,
+                                                   prebuilt=prebuilt,
+                                                   suppressed=lk_suppressed)
+    findings += lk_findings
+    for f in lk_raw:
+        raw.add((f.file, f.rule, f.line))
+    for rule in ("LCK", "BLK"):
+        report.suppressed += _escaped_split(
+            [f for f in lk_findings if f.rule == rule],
+            [f for f in lk_raw if f.rule == rule], units_by_file, rule)
+    # TSI escapes can sit on non-anchor write lines the anchor-based
+    # split cannot see; the pass hands the pairs over directly
+    report.suppressed += lk_suppressed
+    extra_used |= lk_used
+    suppressions = _audit_suppressions(units, raw, extra_used)
+    report.suppressions = suppressions
     for s in suppressions:
         if s.stale:
             findings.append(Finding(
@@ -320,21 +581,31 @@ def lint_report(paths: list[str], *, claude_md: str | None = None,
     if doc:
         if claude_md is not None:
             findings += docrules.check_claude_md(claude_md)
-            # the metrics table lives in ARCHITECTURE.md beside the
-            # CLAUDE.md in play.  Only a CUSTOM --claude-md with no
-            # sibling ARCHITECTURE.md (fixture runs) skips the check; on
-            # the repo's own doc set a missing/renamed ARCHITECTURE.md is
-            # a DOC finding ("cannot read"), never a silently disabled
-            # drift guard -- symmetric with the knob table.
+            # the metrics and thread-inventory tables live in
+            # ARCHITECTURE.md beside the CLAUDE.md in play.  Only a
+            # CUSTOM --claude-md with no sibling ARCHITECTURE.md (fixture
+            # runs) skips the checks; on the repo's own doc set a
+            # missing/renamed ARCHITECTURE.md is a DOC finding ("cannot
+            # read"), never a silently disabled drift guard -- symmetric
+            # with the knob table.
             doc_dir = os.path.dirname(os.path.abspath(claude_md))
             arch = os.path.join(doc_dir, "ARCHITECTURE.md")
             if os.path.exists(arch) or doc_dir == _posix(repo_root()) \
                     or doc_dir == repo_root():
                 findings += docrules.check_architecture_md(arch)
+                findings += docrules.check_thread_inventory(arch,
+                                                            inv_rows)
         findings += docrules.check_cli_help()
         findings += docrules.check_analysis_help()
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
-    return findings, suppressions
+    return report
+
+
+def lint_report(paths: list[str], *, claude_md: str | None = None,
+                doc: bool = True) -> tuple[list[Finding], list[Suppression]]:
+    """lint_run as the historical (findings, suppressions) pair."""
+    report = lint_run(paths, claude_md=claude_md, doc=doc)
+    return report.findings, report.suppressions
 
 
 def lint_paths(paths: list[str], *, claude_md: str | None = None,

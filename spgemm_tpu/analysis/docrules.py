@@ -29,6 +29,70 @@ KNOB_TABLE_END = "<!-- knob-table:end -->"
 METRICS_TABLE_BEGIN = "<!-- metrics-table:begin -->"
 METRICS_TABLE_END = "<!-- metrics-table:end -->"
 
+THREAD_TABLE_BEGIN = "<!-- thread-inventory:begin -->"
+THREAD_TABLE_END = "<!-- thread-inventory:end -->"
+
+
+def thread_inventory_md(rows: list | None = None) -> str:
+    """The generated thread-inventory table: one row per thread root the
+    LCK/BLK/TSI pass resolves over the DEFAULT lint scope (always the
+    default scope, independent of what a particular run linted, so the
+    committed table has exactly one truth) -- root function, spawner,
+    locks it may transitively hold, shared attrs it writes.
+
+    rows: precomputed inventory rows from a lint run whose unit set WAS
+    the default scope (lint_run passes them through so the default
+    `make lint` builds the whole-program analysis once, not twice);
+    None = build the analysis here."""
+    from spgemm_tpu.analysis import core, lockrules  # noqa: PLC0415
+
+    if rows is None:
+        units = [core.LintUnit(f) for path in core.default_paths()
+                 for f in core._walk_py(path)]
+        rows = lockrules.inventory_rows(units)
+    lines = ["| thread root | spawned by | locks it may hold "
+             "| shared state it writes |",
+             "|---|---|---|---|"]
+    for row in rows:
+        def cell(items):
+            return ", ".join(f"`{i}`" for i in items) if items else "—"
+        lines.append(f"| `{row['root']}` | {cell(row['spawners'])} "
+                     f"| {cell(row['locks'])} | {cell(row['writes'])} |")
+    return "\n".join(lines)
+
+
+def render_thread_block() -> str:
+    """The full marked block, ready to paste into ARCHITECTURE.md."""
+    return (f"{THREAD_TABLE_BEGIN}\n{thread_inventory_md()}\n"
+            f"{THREAD_TABLE_END}")
+
+
+def check_thread_inventory(path: str,
+                           rows: list | None = None) -> list[Finding]:
+    """Diff the committed thread-inventory table against the one the
+    concurrency pass generates from the default scope (the same
+    keep-it-generated contract as the knob and metrics tables;
+    regenerate with `--write-thread-inventory`)."""
+    if rows is None:
+        # generating the table means a full default-scope analysis:
+        # don't pay it just to learn the file is unreadable or has no
+        # markers -- those findings compare nothing
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            text = None
+        if text is None or THREAD_TABLE_BEGIN not in text \
+                or THREAD_TABLE_END not in text:
+            return _check_marked_block(path, THREAD_TABLE_BEGIN,
+                                       THREAD_TABLE_END, "",
+                                       "thread inventory",
+                                       "--write-thread-inventory")
+    return _check_marked_block(path, THREAD_TABLE_BEGIN, THREAD_TABLE_END,
+                               thread_inventory_md(rows),
+                               "thread inventory",
+                               "--write-thread-inventory")
+
 
 def render_knob_block() -> str:
     """The full marked block, ready to paste into CLAUDE.md."""

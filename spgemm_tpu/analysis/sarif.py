@@ -4,9 +4,15 @@
 writes one run with the full rule-id registry as tool.driver.rules and one
 result per finding -- the shape GitHub code scanning and SARIF-aware
 editors consume.  The contract test (tests/test_lint.py) pins the schema
-shape; stale suppressions travel as ordinary SUP results, and the full
-escape inventory stays a --json feature (SARIF's per-result suppressions
-model suppressed results, not escape comments)."""
+shape; stale suppressions travel as ordinary SUP results.
+
+Escaped findings are NOT dropped: each suppressed finding is emitted as a
+result carrying a SARIF `suppressions` object (`kind: "inSource"`, the
+escape comment's reason as the `justification`), so code scanning can
+audit every escape instead of watching findings silently vanish.  An
+active finding carries an explicit empty `suppressions` array -- the
+SARIF 2.1.0 convention that lets a consumer distinguish "not suppressed"
+from "suppression state unknown"."""
 
 from __future__ import annotations
 
@@ -18,8 +24,28 @@ SARIF_VERSION = "2.1.0"
 SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
 
 
-def render(findings: list[Finding]) -> dict:
-    """The SARIF log object (plain dict, json.dump-ready)."""
+def _result(f: Finding, suppressions: list[dict]) -> dict:
+    return {
+        "ruleId": f.rule,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.file},
+                "region": {"startLine": f.line},
+            },
+        }],
+        "suppressions": suppressions,
+    }
+
+
+def render(findings: list[Finding],
+           suppressed: list[tuple[Finding, str]] = ()) -> dict:
+    """The SARIF log object (plain dict, json.dump-ready).
+
+    suppressed: (finding, justification) pairs for findings an in-source
+    escape comment suppressed -- emitted as results with a populated
+    `suppressions` array."""
     return {
         "$schema": SARIF_SCHEMA,
         "version": SARIF_VERSION,
@@ -36,22 +62,16 @@ def render(findings: list[Finding]) -> dict:
                     "shortDescription": {"text": doc},
                 } for rule_id, doc in RULES.items()],
             }},
-            "results": [{
-                "ruleId": f.rule,
-                "level": "error",
-                "message": {"text": f.message},
-                "locations": [{
-                    "physicalLocation": {
-                        "artifactLocation": {"uri": f.file},
-                        "region": {"startLine": f.line},
-                    },
-                }],
-            } for f in findings],
+            "results": [_result(f, []) for f in findings] + [
+                _result(f, [{"kind": "inSource",
+                             "justification": reason}])
+                for f, reason in suppressed],
         }],
     }
 
 
-def write(path: str, findings: list[Finding]) -> None:
+def write(path: str, findings: list[Finding],
+          suppressed: list[tuple[Finding, str]] = ()) -> None:
     with open(path, "w", encoding="utf-8") as f:
-        json.dump(render(findings), f, indent=2)
+        json.dump(render(findings, suppressed), f, indent=2)
         f.write("\n")

@@ -46,6 +46,21 @@ GUARD_MARKER = "spgemm-lint: guarded-by("
 _CONDITION_WRAPPERS = {"Condition"}  # threading.Condition(lock) aliases lock
 
 
+def guard_on_assignment(ann: dict[int, str],
+                        node: ast.AST) -> str | None:
+    """The guard name an annotation binds to `node` -- on ANY line the
+    (possibly wrapped) assignment spans: a multi-line dict literal
+    carries its comment on the closing line, and an annotation that
+    silently fails to bind is worse than no annotation.  THE one
+    binding rule: THR (enforcement) and TSI (the annotated-state
+    exemption) must agree on it, so both call this."""
+    for ln in range(node.lineno,
+                    (getattr(node, "end_lineno", None) or node.lineno) + 1):
+        if ln in ann:
+            return ann[ln]
+    return None
+
+
 def _guard_annotations(comments: dict[int, str]) -> dict[int, str]:
     """1-indexed line -> declared lock name (leading `self.` stripped).
     Scans real comments only (core.comment_map), so a quoted marker in a
@@ -106,9 +121,10 @@ class _Scope:
             names = [n for n in map(name_of, targets) if n is not None]
             if not names:
                 continue
-            if node.lineno in ann:
+            guard = guard_on_assignment(ann, node)
+            if guard is not None:
                 for n in names:
-                    self.guards[n] = ann[node.lineno]
+                    self.guards[n] = guard
             value = getattr(node, "value", None)
             if (isinstance(value, ast.Call)
                     and (dotted_name(value.func) or "").rsplit(".", 1)[-1]

@@ -136,12 +136,14 @@ def configure(path: str | None = None) -> bool:
         locked = False
         for attempt in range(6):
             try:
+                # spgemm-lint: blk-ok(LOCK_NB flock never blocks; bind-time only, before any serving thread contends for _LOCK)
                 fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
                 locked = True
                 break
             except OSError:
                 if attempt < 5:
                     import time  # noqa: PLC0415
+                    # spgemm-lint: blk-ok(bounded 0.3s total bind-time retry; configure runs before the daemon serves, so no thread contends for _LOCK yet)
                     time.sleep(0.05)
         if not locked:
             fh.close()

@@ -1284,6 +1284,7 @@ class Daemon:
                 self.degraded = all(s.degraded for s in self.slices)
                 if not self.degraded:
                     self.degrade_reason = None
+                # spgemm-lint: lck-ok(_spawn_executor's `with self._lock:` branch is gated on degraded is not None, and this call passes degraded=None -- the re-acquiring path is unreachable; the atomicity argument above is why the call must stay under the lock)
                 self._spawn_executor(sl)
         if not live:
             obs_events.emit("slice_recover_probe", slice=sl.name,

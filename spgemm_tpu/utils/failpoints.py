@@ -234,6 +234,7 @@ def check(name: str) -> bool:
         _hang(name)
         return False
     if fp.kind == "delay":
+        # spgemm-lint: blk-ok(chaos injection: the delay IS the injected fault, armed only under SPGEMM_TPU_FAILPOINTS -- blocking wherever the site sits, locks included, is the point)
         time.sleep(DELAY_S)
         return False
     return True  # corrupt
@@ -248,6 +249,7 @@ def _hang(name: str) -> None:
         spec = knobs.get("SPGEMM_TPU_FAILPOINTS")
         if not spec or _arm_for(name) is None:
             return
+        # spgemm-lint: blk-ok(chaos injection: the hang IS the injected wedge the watchdog must detect, armed only under SPGEMM_TPU_FAILPOINTS)
         time.sleep(HANG_POLL_S)
 
 

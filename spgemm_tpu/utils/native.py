@@ -60,6 +60,7 @@ def get_lib():
                                   for s in (_SRC, _SYM_SRC, _FOLD_SRC)))
         except OSError:
             needs_build = not os.path.exists(_SO)
+        # spgemm-lint: blk-ok(one-shot memoized build: the lock MUST cover the g++ run so a second thread can neither double-compile nor CDLL a half-written .so; cold path, bounded by the 120s subprocess timeout)
         if needs_build and not _build():
             return None
         try:

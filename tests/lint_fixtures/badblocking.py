@@ -1,0 +1,49 @@
+"""Seeded BLK violations: blocking operations under a registered lock --
+direct, transitive through a call edge, and via a typed resource
+(Queue.get) -- plus the legal shapes (no lock held; the condition's own
+wait; a reasoned blk-ok escape).  NOT part of the package -- linted by
+tests/test_lint.py only.
+"""
+
+import queue
+import threading
+import time
+
+_LOCK = threading.Lock()
+_COND = threading.Condition(_LOCK)
+_Q = queue.Queue()
+
+
+def direct():
+    with _LOCK:
+        time.sleep(0.1)  # BLK: sleeping while holding _LOCK
+
+
+def transitive():
+    with _LOCK:
+        helper()  # BLK: reaches subprocess.run while _LOCK is held
+
+
+def helper():
+    import subprocess
+    subprocess.run(["true"])  # legal alone: no lock held here
+
+
+def typed_queue():
+    with _LOCK:
+        return _Q.get()  # BLK: Queue.get blocks while _LOCK is held
+
+
+def legal_no_lock():
+    time.sleep(0.1)  # legal: nothing held
+
+
+def legal_condition_wait():
+    with _COND:
+        _COND.wait(0.1)  # legal: wait releases the condition's own lock
+
+
+def escaped():
+    with _LOCK:
+        # spgemm-lint: blk-ok(seeded: bounded poll with the lock deliberately held, reviewable reason)
+        time.sleep(0.0)

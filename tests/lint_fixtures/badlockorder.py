@@ -1,0 +1,48 @@
+"""Seeded LCK violations: an inverted lock-acquisition order (the
+two-witness deadlock cycle) and a non-reentrant re-acquisition through a
+call edge.  NOT part of the package -- linted by tests/test_lint.py only.
+"""
+
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+_R = threading.RLock()
+
+
+def a_then_b():
+    with _A:
+        with _B:  # LCK: acquires B while holding A (one half of the cycle)
+            pass
+
+
+def b_then_a():
+    with _B:
+        with _A:  # LCK: acquires A while holding B (the inversion)
+            pass
+
+
+def reenters():
+    with _A:
+        helper()  # LCK: helper re-acquires _A -- self-deadlock
+
+
+def helper():
+    with _A:  # legal alone: no lock held on entry from a clean caller
+        pass
+
+
+def legal_nested_same_order():
+    with _A:
+        with _B:  # same A->B order as a_then_b: an edge, not a new cycle
+            pass
+
+
+def legal_rlock_reentry():
+    with _R:
+        rlock_helper()  # legal: RLock re-entry is its documented use-case
+
+
+def rlock_helper():
+    with _R:  # no self-edge finding -- reentrant by construction
+        pass

@@ -1,0 +1,61 @@
+"""Seeded TSI violations: an instance attribute written from two thread
+roots without a guarded-by annotation, a loop-spawned single target
+(multi-instance: one root, many threads), and a nested-def target spawned
+from two sites -- plus the legal shapes (annotated state, __init__
+writes, single-root writes, a reasoned tsi-ok escape on a single-writer
+handoff slot).  NOT part of the package -- linted by tests/test_lint.py
+only.
+"""
+
+import threading
+
+_SHARED = 0
+
+
+def spawn_workers():
+    def worker():
+        global _SHARED
+        _SHARED = 1  # TSI: nested-def root, two spawn sites
+
+    threading.Thread(target=worker, daemon=True).start()
+    threading.Thread(target=worker, daemon=True).start()
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.guarded = 0  # spgemm-lint: guarded-by(_lock)
+        self.done = 0     # legal here: __init__ happens-before publication
+        self.beat = 0.0
+        self.solo = 0
+        threading.Thread(target=self._loop_a, daemon=True).start()
+        threading.Thread(target=self._loop_b, daemon=True).start()
+
+    def _loop_a(self):
+        self.done += 1  # TSI: two-root write without guarded-by
+        # spgemm-lint: tsi-ok(seeded: single-writer beat slot, the reader tolerates staleness by design)
+        self.beat = 1.0
+        with self._lock:
+            self.guarded += 1  # legal: annotated (THR owns it)
+        self._helper()
+
+    def _loop_b(self):
+        self.done += 1  # the second root's write of the same attr
+        # spgemm-lint: tsi-ok(seeded: single-writer beat slot, the reader tolerates staleness by design)
+        self.beat = 2.0
+
+    def _helper(self):
+        self.solo = 1  # legal: reached from one root only
+
+
+class ConnServer:
+    def __init__(self):
+        self.hits = 0
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            threading.Thread(target=self._handle, daemon=True).start()
+
+    def _handle(self):
+        self.hits += 1  # TSI: multi-instance root (loop-spawned target)

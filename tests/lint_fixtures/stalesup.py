@@ -21,3 +21,18 @@ def handled():
     # spgemm-lint: exc-ok(seeded-stale: the handler below is narrow)
     except ValueError:
         return 0
+
+
+def ordered():
+    # spgemm-lint: lck-ok(seeded-stale: no lock-order edge anywhere here)
+    return 2
+
+
+def unblocked():
+    # spgemm-lint: blk-ok(seeded-stale: nothing blocking below)
+    return 3
+
+
+def unshared():
+    # spgemm-lint: tsi-ok(seeded-stale: no thread-shared write here)
+    return 4

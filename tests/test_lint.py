@@ -1,8 +1,11 @@
 """spgemm-lint: the repo self-lints clean (tier-1 gate), and each seeded
 fixture violation (FLD incl. the interprocedural pass / KNB / BKD / THR /
-EXC / SUP / DOC) is caught with the correct rule ID -- both in-process and
-through the `python -m spgemm_tpu.analysis --json` / `--sarif` reports
-that CI consumes."""
+LCK / BLK / TSI / EXC / SUP / DOC) is caught with the correct rule ID --
+both in-process and through the `python -m spgemm_tpu.analysis --json` /
+`--sarif` reports that CI consumes -- plus the v3 contracts: the
+content-hash result cache (warm runs hit, edits invalidate, output stays
+byte-identical), SARIF `suppressions` objects on escaped findings, and
+the generated ARCHITECTURE.md thread-inventory table."""
 
 import json
 import os
@@ -403,6 +406,66 @@ def test_write_metrics_table_regenerates(tmp_path):
     assert arch.read_text().endswith("\ntail\n")
 
 
+def test_doc_thread_inventory_current_and_tamper_fails(tmp_path):
+    """The generated ARCHITECTURE.md thread-inventory table is held to
+    the concurrency pass's output exactly like the knob and metrics
+    tables are to their registries."""
+    good = tmp_path / "ARCHITECTURE.md"
+    good.write_text("# arch\n\n" + docrules.render_thread_block() + "\n")
+    assert docrules.check_thread_inventory(str(good)) == []
+    tampered = good.read_text().replace("Daemon._watchdog_loop",
+                                        "Daemon._gone_loop")
+    assert tampered != good.read_text()
+    good.write_text(tampered)
+    findings = docrules.check_thread_inventory(str(good))
+    assert [f.rule for f in findings] == ["DOC"]
+    assert "drifted" in findings[0].message
+    good.write_text("# no markers at all\n")
+    findings = docrules.check_thread_inventory(str(good))
+    assert [f.rule for f in findings] == ["DOC"]
+    assert "markers missing" in findings[0].message
+
+
+def test_write_thread_inventory_regenerates(tmp_path):
+    """`--write-thread-inventory` rewrites the marked block in place,
+    after which the DOC check passes."""
+    arch = tmp_path / "ARCHITECTURE.md"
+    arch.write_text("# doc\n" + docrules.THREAD_TABLE_BEGIN + "\nstale\n"
+                    + docrules.THREAD_TABLE_END + "\ntail\n")
+    rc = _run(["-m", "spgemm_tpu.analysis", "--write-thread-inventory",
+               "--architecture-md", str(arch)])
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert docrules.check_thread_inventory(str(arch)) == []
+    assert arch.read_text().startswith("# doc\n")
+    assert arch.read_text().endswith("\ntail\n")
+
+
+def test_thread_inventory_covers_live_daemon_roots():
+    """Spot-check the generated rows: the resident daemon's thread
+    population (PRs 12-13) resolves as roots -- executors, watchdog,
+    accept loop, recovery probe, the event-log writer, the plan-ahead
+    worker -- so the table the docs commit actually inventories the
+    threads the concurrency pass reasons about."""
+    md = docrules.thread_inventory_md()
+    for root in ("serve.daemon.Daemon._executor_loop",
+                 "serve.daemon.Daemon._watchdog_loop",
+                 "serve.daemon.Daemon._accept_loop",
+                 "serve.daemon.Daemon._recover_probe",
+                 "obs.events.EventLog._writer_loop",
+                 "chain._PlanAheadWorker._work",
+                 # nested-def targets resolve as roots in their own
+                 # right: the degrade probe and the OOC pipeline workers
+                 "serve.daemon.Daemon._degrade_slice._run_probe",
+                 "ops.spgemm.spgemm_outofcore._lander",
+                 "ops.spgemm.spgemm_outofcore._stager"):
+        assert f"`{root}`" in md
+    # the executor root's row names the locks it may transitively hold
+    executor_row = next(ln for ln in md.splitlines()
+                        if "Daemon._executor_loop" in ln)
+    assert "serve.daemon.Daemon._lock" in executor_row
+    assert "ops.warmstore._LOCK" in executor_row
+
+
 # ----------------------------------------------------------- PARSE rule --
 def test_syntax_error_gets_its_own_rule_id(tmp_path):
     """A broken file means NO rule ran on it: its finding must not be
@@ -466,6 +529,721 @@ def test_thr_guard_deletion_turns_lint_red(tmp_path):
         p.write_text(mutated)
         thr = [f for f in lint_file(str(p)) if f.rule == "THR"]
         assert thr, f"deleting a lock guard in {rel} must turn lint red"
+
+
+# ------------------------------------------------------------- LCK rule --
+def test_lck_fixture_each_violation_caught():
+    """The seeded lock-order fixture: the A->B vs B->A inversion is a
+    cycle finding carrying BOTH witness chains, and the call-edge
+    re-acquisition is the non-reentrant self-deadlock finding; the
+    same-order nest stays a legal edge."""
+    findings = core.lint_paths([os.path.join(FIXTURES, "badlockorder.py")],
+                               doc=False)
+    assert [f.rule for f in findings] == ["LCK", "LCK"]
+    by_line = {f.line: f.message for f in findings}
+    cycle_line = _fixture_lines("badlockorder.py", "one half of the cycle")[0]
+    self_line = _fixture_lines("badlockorder.py", "self-deadlock")[0]
+    assert set(by_line) == {cycle_line, self_line}
+    legal = _fixture_lines("badlockorder.py", "an edge, not a new cycle")[0]
+    assert legal not in by_line
+    cycle = by_line[cycle_line]
+    assert "lock-order cycle" in cycle
+    assert "a_then_b" in cycle and "b_then_a" in cycle  # both witnesses
+    assert "._A`" in cycle and "._B`" in cycle
+    self_edge = by_line[self_line]
+    assert "re-acquired while already held" in self_edge
+    assert "reenters -> helper" in self_edge  # the witness chain
+    assert "non-reentrant" in self_edge
+    # RLock re-entry through a call edge is its documented use-case --
+    # never a self-edge finding
+    rlock = _fixture_lines("badlockorder.py", "RLock re-entry")[0]
+    assert rlock not in by_line
+
+
+def test_lck_multi_item_with_inversion_caught(tmp_path):
+    """Review regression: `with A, B:` acquires left-to-right exactly
+    like nested withs -- the single-statement spelling of one half of an
+    AB/BA inversion must still close the cycle."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_A = threading.Lock()\n"
+        "_B = threading.Lock()\n"
+        "def f():\n"
+        "    with _A, _B:\n"
+        "        pass\n"
+        "def g():\n"
+        "    with _B:\n"
+        "        with _A:\n"
+        "            pass\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["LCK"]
+    assert "lock-order cycle" in findings[0].message
+
+
+def test_lck_conditionally_defined_module_lock_registers(tmp_path):
+    """Review regression: a lock assigned inside a module-level try/if
+    block still executes at module scope -- it must register (hazards
+    on it checked), while function-local assignments must not leak into
+    the module registry."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "try:\n"
+        "    _L = threading.Lock()\n"
+        "except ImportError:\n"
+        "    _L = None\n"
+        "def reenters():\n"
+        "    with _L:\n"
+        "        helper()\n"
+        "def helper():\n"
+        "    with _L:\n"
+        "        pass\n"
+        "def local_only():\n"
+        "    _M = threading.Lock()\n"   # a local, not a module lock
+        "    with _M:\n"
+        "        pass\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["LCK"]
+    assert "re-acquired while already held" in findings[0].message
+
+
+def test_lck_escaped_anchor_does_not_vouch_for_other_sites(tmp_path):
+    """Review regression: an lck-ok on one re-acquisition site argues
+    THAT site's unreachability only -- the same hazard spelled at
+    another site still turns lint red (the live finding moves to the
+    first unescaped site; the escape stays used, not stale)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "def escaped_path():\n"
+        "    with _L:\n"
+        "        # spgemm-lint: lck-ok(seeded: this branch is gated unreachable)\n"
+        "        helper()\n"
+        "def other_path():\n"
+        "    with _L:\n"
+        "        helper()\n"
+        "def helper():\n"
+        "    with _L:\n"
+        "        pass\n")
+    findings, suppressions = core.lint_report([str(p)], doc=False)
+    lck = [f for f in findings if f.rule == "LCK"]
+    assert len(lck) == 1 and lck[0].line == 9  # the unescaped site
+    assert findings == lck  # in particular: no stale-escape SUP
+    assert len(suppressions) == 1 and not suppressions[0].stale
+
+
+def test_lck_direct_self_recursion_caught(tmp_path):
+    """Review regression: `with self._lock: self.step(...)` recursing
+    into ITSELF is the one-edge re-acquisition deadlock -- the self
+    call edge must not be dropped."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def step(self, n):\n"
+        "        with self._lock:\n"
+        "            if n:\n"
+        "                self.step(n - 1)\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["LCK"]
+    assert "re-acquired while already held" in findings[0].message
+
+
+def test_lck_rlock_still_participates_in_order_cycles(tmp_path):
+    """The RLock self-edge exemption must not blind the cycle detector:
+    an RLock acquired in opposite orders against a plain Lock deadlocks
+    exactly like two Locks -- still a finding."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_L = threading.Lock()\n"
+        "_R = threading.RLock()\n"
+        "def l_then_r():\n"
+        "    with _L:\n"
+        "        with _R:\n"
+        "            pass\n"
+        "def r_then_l():\n"
+        "    with _R:\n"
+        "        with _L:\n"
+        "            pass\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["LCK"]
+    assert "lock-order cycle" in findings[0].message
+
+
+# ------------------------------------------------------------- BLK rule --
+def test_blk_fixture_each_violation_caught():
+    """The seeded blocking-under-lock fixture: direct sleep, transitive
+    subprocess.run through a call edge, and the typed Queue.get are
+    findings; no-lock blocking, the condition's own wait, and the
+    reasoned blk-ok escape stay legal."""
+    findings, suppressions = core.lint_report(
+        [os.path.join(FIXTURES, "badblocking.py")], doc=False)
+    assert [f.rule for f in findings] == ["BLK"] * 3
+    flagged = [f.line for f in findings]
+    for needle in ("BLK: sleeping while holding",
+                   "BLK: reaches subprocess.run",
+                   "BLK: Queue.get blocks"):
+        assert _fixture_lines("badblocking.py", needle)[0] in flagged
+    for needle in ("legal: nothing held", "legal: wait releases",
+                   "time.sleep(0.0)"):
+        assert _fixture_lines("badblocking.py", needle)[0] not in flagged
+    by_line = {f.line: f.message for f in findings}
+    trans = by_line[_fixture_lines("badblocking.py",
+                                   "BLK: reaches subprocess.run")[0]]
+    # the witness chain down to the blocking call, with its file:line
+    assert "transitive -> helper -> `subprocess.run`" in trans
+    assert "badblocking.py:" in trans
+    # the escape is inventoried, in use (source escape on the sleep)
+    blk = [s for s in suppressions if s.rule == "BLK"]
+    assert len(blk) == 1 and not blk[0].stale
+
+
+def test_blk_cond_wait_through_helper_discharges_own_lock(tmp_path):
+    """Review regression: a Condition.wait hoisted into a helper still
+    releases the condition's own lock -- the canonical cond-var pattern
+    must not be flagged through the call edge; a SECOND held lock
+    staying held across the wait still is."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._other = threading.Lock()\n"
+        "    def ok(self):\n"
+        "        with self._cond:\n"
+        "            self._wait_helper()\n"
+        "    def bad(self):\n"
+        "        with self._other:\n"
+        "            with self._cond:\n"
+        "                self._wait_helper()\n"
+        "    def _wait_helper(self):\n"
+        "        self._cond.wait()\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    blk = [f for f in findings if f.rule == "BLK"]
+    assert len(blk) == 1  # only the _other-held route
+    assert "_other" in blk[0].message and "_cond.wait" in blk[0].message
+
+
+def test_blk_cond_wait_does_not_shadow_later_blocking_op(tmp_path):
+    """Review regression: the per-function block summary keeps one
+    witness PER released lock -- a Condition.wait in a helper must not
+    hide a plain sleep behind the same call edge when the caller's held
+    lock is the one the wait releases."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "    def caller(self):\n"
+        "        with self._cv:\n"
+        "            self._helper()\n"
+        "    def _helper(self):\n"
+        "        self._cv.wait()\n"
+        "        time.sleep(1)\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["BLK"]
+    assert "time.sleep" in findings[0].message
+
+
+def test_blk_lock_shadow_param_not_module_lock(tmp_path):
+    """Review regression: a parameter/local shadowing a registered
+    lock's name is NOT the module lock -- blocking under it must not be
+    misattributed (which would also fabricate LCK order edges)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def f(_LOCK):\n"
+        "    with _LOCK:\n"
+        "        time.sleep(0.1)\n")
+    assert core.lint_paths([str(p)], doc=False) == []
+
+
+def test_tsi_for_and_with_as_targets_recorded(tmp_path):
+    """Review regression: `for self.cur in ...:` and
+    `with open() as self.fh:` write the attribute like any assignment
+    -- two-root spellings of either must fire."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._w1).start()\n"
+        "        threading.Thread(target=self._w2).start()\n"
+        "    def _w1(self):\n"
+        "        for self.cur in range(3):\n"
+        "            pass\n"
+        "        with open('/dev/null') as self.fh:\n"
+        "            pass\n"
+        "    def _w2(self):\n"
+        "        for self.cur in range(3):\n"
+        "            pass\n"
+        "        with open('/dev/null') as self.fh:\n"
+        "            pass\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["TSI", "TSI"]
+    msgs = " ".join(f.message for f in findings)
+    assert ".cur`" in msgs and ".fh`" in msgs
+
+
+def test_blk_from_import_spelling_caught(tmp_path):
+    """Review regression: `from time import sleep` / import aliases
+    resolve to the canonical blocking spelling -- an import-style
+    refactor must not disarm the rule."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "from time import sleep\n"
+        "import subprocess as sp\n"
+        "_LOCK = threading.Lock()\n"
+        "def f():\n"
+        "    with _LOCK:\n"
+        "        sleep(1)\n"
+        "def g():\n"
+        "    with _LOCK:\n"
+        "        sp.run(['true'])\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["BLK", "BLK"]
+    assert [f.line for f in findings] == [7, 10]
+
+
+def test_tsi_call_binding_target_does_not_root_the_callee(tmp_path):
+    """Review regression: in `t = pick(worker_a, worker_b);
+    Thread(target=t)` the candidates are the ARGUMENTS -- `pick` runs
+    synchronously on the spawning thread and must not become a root
+    (its writes would inflate root counts and pollute the inventory)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_G = 0\n"
+        "def pick(a, b):\n"
+        "    global _G\n"
+        "    _G = 1\n"           # synchronous write: no reaching root
+        "    return a\n"
+        "def spawn():\n"
+        "    t = pick(worker_a, worker_b)\n"
+        "    threading.Thread(target=t).start()\n"
+        "def worker_a():\n"
+        "    global _G\n"
+        "    _G = 2\n"           # ONE root writes _G: no finding
+        "def worker_b():\n"
+        "    pass\n")
+    assert core.lint_paths([str(p)], doc=False) == []
+
+
+def test_blk_through_nested_def_called_under_lock(tmp_path):
+    """A nested def invoked SYNCHRONOUSLY while the lock is held blocks
+    under the lock like any helper: the intra-module nested-label call
+    edge carries the witness chain (nested defs are separate records,
+    not folds, since the thread-root rework)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def outer():\n"
+        "    def slow():\n"
+        "        time.sleep(0.1)\n"
+        "    with _LOCK:\n"
+        "        slow()\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["BLK"]
+    assert "outer -> outer.slow -> `time.sleep`" in findings[0].message
+
+
+def test_tsi_thread_spawned_in_loop_else_not_multi_instance(tmp_path):
+    """Review regression: a for/while `else` block runs exactly once,
+    after the loop -- a thread spawned there is single-instance and its
+    private writes stay legal."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_G = 0\n"
+        "def spawn(items):\n"
+        "    for it in items:\n"
+        "        pass\n"
+        "    else:\n"
+        "        threading.Thread(target=worker).start()\n"
+        "def worker():\n"
+        "    global _G\n"
+        "    _G = 1\n")
+    assert core.lint_paths([str(p)], doc=False) == []
+
+
+def test_tsi_tuple_unpacking_write_caught(tmp_path):
+    """Review regression: `self.a, self.b = ...` writes both attributes
+    -- the unpacking spelling must not reopen the hole the rule
+    closes."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._w1).start()\n"
+        "        threading.Thread(target=self._w2).start()\n"
+        "    def _w1(self):\n"
+        "        self.a, self.b = 1, 2\n"
+        "    def _w2(self):\n"
+        "        self.a, self.b = 3, 4\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["TSI", "TSI"]
+    msgs = " ".join(f.message for f in findings)
+    assert ".a`" in msgs and ".b`" in msgs
+
+
+def test_tsi_nonanchor_escape_suppression_carried(tmp_path):
+    """Review regression: a tsi-ok on a NON-anchor write line suppresses
+    the finding -- and the (finding, reason) pair still reaches the
+    report's suppressed surface (SARIF must audit the escape, not watch
+    the finding vanish)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "_G = 0\n"
+        "def spawn():\n"
+        "    threading.Thread(target=w1).start()\n"
+        "    threading.Thread(target=w2).start()\n"
+        "def w1():\n"
+        "    global _G\n"
+        "    _G = 1\n"
+        "def w2():\n"
+        "    global _G\n"
+        "    # spgemm-lint: tsi-ok(seeded: non-anchor escape)\n"
+        "    _G = 2\n")
+    report = core.lint_run([str(p)], doc=False)
+    assert report.findings == []
+    pairs = [(f, reason) for f, reason in report.suppressed
+             if f.rule == "TSI"]
+    assert len(pairs) == 1
+    assert "non-anchor escape" in pairs[0][1]
+    # the escape is inventoried in use, not stale
+    tsi = [s for s in report.suppressions if s.rule == "TSI"]
+    assert len(tsi) == 1 and not tsi[0].stale
+
+
+def test_blk_sibling_nested_def_call_resolves(tmp_path):
+    """Review regression: a nested def calling its SIBLING nested def
+    resolves by ascending through enclosing function scopes -- a
+    blocking op behind that hop while the lock is held is still a
+    finding (the OOC stager/lander helper shape)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def outer():\n"
+        "    def a():\n"
+        "        b()\n"
+        "    def b():\n"
+        "        time.sleep(1)\n"
+        "    with _LOCK:\n"
+        "        a()\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["BLK"]
+    assert "outer -> outer.a -> outer.b -> `time.sleep`" \
+        in findings[0].message
+
+
+def test_nested_name_never_resolves_to_sibling_method(tmp_path):
+    """The ascent stops at function scopes: a bare call inside a method
+    must not resolve to a sibling METHOD of the class (Python name
+    resolution would not either)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "class W:\n"
+        "    def m(self):\n"
+        "        with _LOCK:\n"
+        "            sleeper()\n"      # NOT W.sleeper: no finding
+        "    def sleeper(self):\n"
+        "        time.sleep(1)\n")
+    assert core.lint_paths([str(p)], doc=False) == []
+
+
+def test_blk_escape_on_unreached_op_goes_stale(tmp_path):
+    """Review regression: a blk-ok on a blocking op that is never
+    reached with a lock held (e.g. the hazard was fixed by hoisting but
+    the escape was forgotten) suppresses nothing -- SUP must report it
+    stale, not let the dead justification outlive the code."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import time\n"
+        "def poll():\n"
+        "    # spgemm-lint: blk-ok(left behind after the hoist)\n"
+        "    time.sleep(0.1)\n")
+    findings, suppressions = core.lint_report([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["SUP"]
+    assert len(suppressions) == 1 and suppressions[0].stale
+
+
+def test_blk_source_escapes_on_lock_held_routes_stay_used(tmp_path):
+    """The counterpart: source blk-oks whose ops ARE reached under a
+    lock are in use -- including a SECOND escaped route behind the first
+    (the failpoints delay+hang shape), which a single-witness summary
+    would miss."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "import time\n"
+        "_LOCK = threading.Lock()\n"
+        "def entry():\n"
+        "    with _LOCK:\n"
+        "        helper()\n"
+        "def helper():\n"
+        "    # spgemm-lint: blk-ok(seeded: bounded injected delay)\n"
+        "    time.sleep(0.1)\n"
+        "    deeper()\n"
+        "def deeper():\n"
+        "    # spgemm-lint: blk-ok(seeded: the second escaped route)\n"
+        "    time.sleep(0.2)\n")
+    report = core.lint_run([str(p)], doc=False)
+    assert report.findings == []  # both routes escaped at source
+    assert len(report.suppressions) == 2
+    assert not any(s.stale for s in report.suppressions)
+    # the transitively-suppressed call-site finding still reaches the
+    # SARIF suppressions surface, reason attached from the source escape
+    pairs = [(f, r) for f, r in report.suppressed if f.rule == "BLK"]
+    assert len(pairs) == 1
+    assert "bounded injected delay" in pairs[0][1]
+
+
+def test_tsi_threading_local_writes_exempt(tmp_path):
+    """threading.local() is per-thread by construction: writes through
+    a registered local (the flight recorder's span stack) are not
+    shared state."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self._tls = threading.local()\n"
+        "        threading.Thread(target=self._w1).start()\n"
+        "        threading.Thread(target=self._w2).start()\n"
+        "    def _w1(self):\n"
+        "        self._tls.stack = [1]\n"
+        "    def _w2(self):\n"
+        "        self._tls.stack = [2]\n")
+    assert core.lint_paths([str(p)], doc=False) == []
+
+
+def test_tsi_module_singleton_attr_write_caught(tmp_path):
+    """Review regression: `STATE.flag = ...` mutates the module-level
+    singleton exactly like `STATE['k'] = ...` -- attribute spelling must
+    not be invisible to TSI."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class Holder:\n"
+        "    pass\n"
+        "STATE = Holder()\n"
+        "def spawn():\n"
+        "    threading.Thread(target=w1).start()\n"
+        "    threading.Thread(target=w2).start()\n"
+        "def w1():\n"
+        "    STATE.flag = True\n"
+        "def w2():\n"
+        "    STATE.flag = False\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["TSI"]
+    assert "STATE" in findings[0].message
+
+
+def test_cache_malformed_entry_falls_back_cold(tmp_path):
+    """A structurally malformed (but valid-JSON) cache entry is a
+    counted invalidation and a cold re-run, never a crash -- the
+    best-effort contract."""
+    d = tmp_path / "c"
+    d.mkdir()
+    (d / "cache.json").write_text(json.dumps({"files": {
+        "a.py": "not-a-dict",
+        "b.py": {"sha": "s", "version": core._analysis_signature()},
+    }}))
+    cache = core.LintCache(str(d))
+    assert cache.get("a.py", "s") is None   # non-dict entry
+    assert cache.get("b.py", "s") is None   # missing findings/raw keys
+    assert cache.invalidations == 2 and cache.hits == 0
+
+
+def test_tsi_nested_def_thread_in_init_not_exempt(tmp_path):
+    """The review regression verbatim: a closure defined in __init__ and
+    passed to Thread(target=...) runs AFTER publication -- its writes
+    must not inherit __init__'s happens-before exemption, and with a
+    second root writing the same attr the race is a finding."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        def warm():\n"
+        "            self.state = 'warm'\n"
+        "        threading.Thread(target=warm).start()\n"
+        "        threading.Thread(target=self._serve).start()\n"
+        "    def _serve(self):\n"
+        "        self.state = 'serving'\n")
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["TSI"]
+    assert "state" in findings[0].message
+    assert "__init__.warm" in findings[0].message
+
+
+# ------------------------------------------------------------- TSI rule --
+def test_tsi_fixture_each_violation_caught():
+    """The seeded thread-shared fixture: an attribute written from both
+    Thread targets, a module global written by a nested-def root spawned
+    from two sites, and an attribute written by a loop-spawned
+    multi-instance root are findings; annotated state, __init__ writes,
+    single-root writes, and the reasoned tsi-ok handoff slot stay
+    legal."""
+    findings, suppressions = core.lint_report(
+        [os.path.join(FIXTURES, "badshared.py")], doc=False)
+    assert [f.rule for f in findings] == ["TSI"] * 3
+    by_line = {f.line: f.message for f in findings}
+    nested = _fixture_lines("badshared.py", "nested-def root")[0]
+    first = _fixture_lines("badshared.py", "TSI: two-root write")[0]
+    second = _fixture_lines("badshared.py", "the second root's write")[0]
+    multi = _fixture_lines("badshared.py", "multi-instance root")[0]
+    assert set(by_line) == {nested, first, multi}
+    two_root = by_line[first]
+    assert "2 thread roots" in two_root
+    assert "Worker._loop_a" in two_root and "Worker._loop_b" in two_root
+    assert f"badshared.py:{first}" in two_root  # every write site named
+    assert f"badshared.py:{second}" in two_root
+    # the nested-def target resolves as a root in its own right, and its
+    # two spawn sites make it multi-instance by themselves
+    assert "spawn_workers.worker" in by_line[nested]
+    assert "multi-instance" in by_line[nested]
+    # one loop-spawned target = many threads: one root suffices
+    assert "ConnServer._handle" in by_line[multi]
+    assert "multi-instance" in by_line[multi]
+    for needle in ("legal: annotated", "happens-before publication",
+                   "legal: reached from one root", "self.beat = 1.0",
+                   "self.beat = 2.0"):
+        assert _fixture_lines("badshared.py", needle)[0] not in by_line
+    # both tsi-ok escapes on the beat slot are inventoried, in use
+    tsi = [s for s in suppressions if s.rule == "TSI"]
+    assert len(tsi) == 2 and not any(s.stale for s in tsi)
+
+
+def test_tsi_single_spawn_single_root_stays_quiet(tmp_path):
+    """The precision boundary: ONE thread spawned once on one target
+    writing its own private state is not a race -- no finding (the
+    multi-instance weighting fires only on loop spawns and multi-site
+    spawns)."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        threading.Thread(target=self._work).start()\n"
+        "    def _work(self):\n"
+        "        self.n = 1\n")
+    assert core.lint_paths([str(p)], doc=False) == []
+
+
+def test_tsi_loop_variable_target_not_multi_instance(tmp_path):
+    """The daemon's for-over-(target, name)-tuples start() spelling
+    spawns each bound function ONCE: a loop whose iteration rebinds the
+    target must not mark those roots multi-instance (each root's private
+    writes stay legal); two DISTINCT roots writing one attr still
+    fire."""
+    p = tmp_path / "h.py"
+    p.write_text(
+        "import threading\n"
+        "class W:\n"
+        "    def start(self):\n"
+        "        for target in (self._a, self._b):\n"
+        "            threading.Thread(target=target).start()\n"
+        "    def _a(self):\n"
+        "        self.a_private = 1\n"   # one root: legal
+        "        self.shared = 1\n"      # two roots: finding
+        "    def _b(self):\n"
+        "        self.b_private = 1\n"   # one root: legal
+        "        self.shared = 2\n")     # the second root's write
+    findings = core.lint_paths([str(p)], doc=False)
+    assert [f.rule for f in findings] == ["TSI"]
+    assert "shared" in findings[0].message
+    assert "multi-instance" not in findings[0].message
+
+
+# ------------------------------- v3 guard-deletion spot-checks (live copies) --
+def test_lck_escape_deletion_turns_lint_red(tmp_path):
+    """Acceptance spot-check on a FIXTURE COPY of serve/daemon.py: the
+    one live lock-order hazard (the recovery probe re-entering
+    _spawn_executor under self._lock) is held green only by its reasoned
+    lck-ok escape -- deleting the escape (equivalently, reordering the
+    call away from it) must produce the LCK self-deadlock finding, so
+    the analysis provably binds to the live module."""
+    src = open(os.path.join(REPO, "spgemm_tpu", "serve",
+                            "daemon.py")).read()
+    p = tmp_path / "daemon.py"
+    p.write_text(src)
+    assert core.lint_paths([str(p)], doc=False) == []
+    kept = [ln for ln in src.splitlines()
+            if "spgemm-lint: lck-ok(" not in ln]
+    assert len(kept) == len(src.splitlines()) - 1, \
+        "the lck-ok escape drifted in serve/daemon.py"
+    p.write_text("\n".join(kept) + "\n")
+    lck = [f for f in core.lint_paths([str(p)], doc=False)
+           if f.rule == "LCK"]
+    assert lck, "deleting the lck-ok escape must turn lint red"
+    assert "re-acquired while already held" in lck[0].message
+    assert "_spawn_executor" in lck[0].message
+
+
+def test_blk_sleep_under_lock_turns_lint_red(tmp_path):
+    """Acceptance spot-check on a FIXTURE COPY of ops/warmstore.py: the
+    copy lints clean (its real flock/sleep sites carry reasoned blk-ok
+    escapes), and adding one time.sleep inside a `with _LOCK:` block
+    must produce a BLK finding."""
+    src = open(os.path.join(REPO, "spgemm_tpu", "ops",
+                            "warmstore.py")).read()
+    p = tmp_path / "warmstore.py"
+    p.write_text(src)
+    assert core.lint_paths([str(p)], doc=False) == []
+    guarded = ("def directory() -> str | None:\n"
+               "    with _LOCK:\n        return _DIR")
+    mutated = src.replace(
+        guarded, "def directory() -> str | None:\n    with _LOCK:\n"
+                 "        time.sleep(0.2)\n        return _DIR")
+    assert mutated != src, "anchor drifted in ops/warmstore.py"
+    p.write_text(mutated)
+    blk = [f for f in core.lint_paths([str(p)], doc=False)
+           if f.rule == "BLK"]
+    assert blk, "a sleep under _LOCK in warmstore must turn lint red"
+    assert "time.sleep" in blk[0].message and "_LOCK" in blk[0].message
+
+
+def test_tsi_guard_strip_turns_lint_red(tmp_path):
+    """Acceptance spot-check on a FIXTURE COPY of serve/daemon.py:
+    stripping the guarded-by annotation from Daemon.degraded -- written
+    from the watchdog and recovery-probe thread roots -- must produce a
+    TSI finding (the THR opt-in hole stays closed on the live module)."""
+    src = open(os.path.join(REPO, "spgemm_tpu", "serve",
+                            "daemon.py")).read()
+    annotated = ("self.degraded = False                    "
+                 "# spgemm-lint: guarded-by(_lock)")
+    mutated = src.replace(annotated, "self.degraded = False")
+    assert mutated != src, "annotation anchor drifted in serve/daemon.py"
+    p = tmp_path / "daemon.py"
+    p.write_text(mutated)
+    tsi = [f for f in core.lint_paths([str(p)], doc=False)
+           if f.rule == "TSI"]
+    assert tsi, \
+        "stripping guarded-by from a two-root attribute must turn lint red"
+    assert "degraded" in tsi[0].message
+    assert "thread roots" in tsi[0].message
+    assert "guarded-by" in tsi[0].message
 
 
 # ------------------------------------------------------------- EXC rule --
@@ -578,11 +1356,14 @@ def test_interprocedural_fld_import_alias_resolves(tmp_path):
 # --------------------------------------------------- suppression audit --
 def test_stale_suppressions_reported():
     """An escape comment on a line that no longer produces the underlying
-    finding is itself a finding (SUP), for every escape family."""
+    finding is itself a finding (SUP), for every escape family -- the
+    three v2 spellings AND the v3 concurrency ones (lck-ok / blk-ok /
+    tsi-ok), all in the one inventory."""
     findings, suppressions = core.lint_report(
         [os.path.join(FIXTURES, "stalesup.py")], doc=False)
-    assert [f.rule for f in findings] == ["SUP"] * 3
-    assert {s.rule for s in suppressions} == {"FLD", "THR", "EXC"}
+    assert [f.rule for f in findings] == ["SUP"] * 6
+    assert {s.rule for s in suppressions} == {"FLD", "THR", "EXC",
+                                              "LCK", "BLK", "TSI"}
     assert all(s.stale for s in suppressions)
     assert all("seeded-stale" in s.reason for s in suppressions)
     assert [f.line for f in findings] == [s.line for s in sorted(
@@ -618,9 +1399,9 @@ def test_used_suppressions_inventoried_not_stale():
 def test_json_report_fixture_run():
     """The machine-readable report: every rule family present with the
     correct rule ID, (file, line, rule, message) per finding, the full
-    suppression inventory, exit 1."""
-    rc = _run(["-m", "spgemm_tpu.analysis", "--json", FIXTURES,
-               "--claude-md", FIXTURE_CLAUDE])
+    suppression inventory, the cache block, exit 1."""
+    rc = _run(["-m", "spgemm_tpu.analysis", "--json", "--no-cache",
+               FIXTURES, "--claude-md", FIXTURE_CLAUDE])
     assert rc.returncode == 1, rc.stderr[-2000:]
     report = json.loads(rc.stdout)
     assert report["clean"] is False
@@ -629,39 +1410,158 @@ def test_json_report_fixture_run():
     # reads; badbackend: 3 import-time touches; badplanner: 2
     # @host_only-body touches; FLD: 5 per-module + 2 interprocedural
     # (callchain) + 1 ops/estimate + 1 ops/delta numeric-scope;
-    # badthread/badexcept/stalesup: 3 each; badmetric: undeclared phase
-    # + undeclared counter + computed name + 2 deep-profiling + 2
-    # warm-layer near-misses; badfailpoint: 2 undeclared + 1 computed
-    # (the stale-registry direction stays quiet -- the registry module
-    # is not in the fixture unit set)
+    # badthread/badexcept: 3 each; badlockorder: cycle + self-edge;
+    # badblocking: direct + transitive + typed-queue; badshared:
+    # two-root write + nested-def two-site root + loop-spawned
+    # multi-instance root; stalesup: one stale escape per family (6);
+    # badmetric: undeclared phase + undeclared counter + computed name
+    # + 2 deep-profiling + 2 warm-layer near-misses; badfailpoint: 2
+    # undeclared + 1 computed (the stale-registry direction stays quiet
+    # -- the registry module is not in the fixture unit set)
     assert report["counts"] == {"FLD": 9, "KNB": 19, "BKD": 5, "THR": 3,
+                                "LCK": 2, "BLK": 3, "TSI": 3,
                                 "EXC": 3, "MET": 7, "FPT": 3, "DOC": 1,
-                                "SUP": 3, "PARSE": 0}
+                                "SUP": 6, "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:
         assert set(f) == {"file", "line", "rule", "message"}
         assert f["rule"] in core.RULES
         assert isinstance(f["line"], int) and f["line"] >= 1
     # the suppression inventory: every escape comment in the run, with
-    # the three stalesup.py seeds marked stale
+    # the six stalesup.py seeds marked stale
     sup = report["suppressions"]
     assert all(set(s) == {"file", "line", "rule", "reason", "stale"}
                for s in sup)
-    assert sum(s["stale"] for s in sup) == 3
+    assert sum(s["stale"] for s in sup) == 6
     assert all(s["file"].endswith("stalesup.py")
                for s in sup if s["stale"])
-    assert len(sup) == 8  # 3 stale + thr-ok + exc-ok + fld escapes in use
+    # 6 stale + thr-ok + exc-ok + 3 fld escapes + blk-ok (badblocking)
+    # + 2 tsi-ok (badshared) in use
+    assert len(sup) == 14
+    # --no-cache: the cache block reports disabled, nothing else
+    assert report["cache"] == {"enabled": False}
 
 
-def test_json_report_clean_repo_run():
-    """`make lint` contract: the default run exits 0 with a clean report
-    (and never needs a backend -- the linter is jax-free by design).  The
-    repo's own escape inventory rides along, all in use."""
-    rc = _run(["-m", "spgemm_tpu.analysis", "--json"])
+def test_json_report_clean_repo_run_cold_then_warm(tmp_path):
+    """`make lint` contract, cold AND warm: the default run exits 0 with
+    a clean report (and never needs a backend -- the linter is jax-free
+    by design), the repo's own escape inventory rides along all in use
+    -- including the reasoned lck-ok/blk-ok escapes the concurrency pass
+    surfaced -- and a second run on the unchanged tree is served from
+    the content-hash cache: hits > 0, zero misses, byte-identical
+    output.  Timing-assertion-free by design: the hit/miss figures, not
+    the wall clock, are the contract."""
+    args = ["-m", "spgemm_tpu.analysis", "--json",
+            "--cache-dir", str(tmp_path / "cache")]
+    rc = _run(args)
     assert rc.returncode == 0, rc.stdout + rc.stderr[-2000:]
-    report = json.loads(rc.stdout)
-    assert report["clean"] is True and report["findings"] == []
-    assert not any(s["stale"] for s in report["suppressions"])
+    cold = json.loads(rc.stdout)
+    assert cold["clean"] is True and cold["findings"] == []
+    assert not any(s["stale"] for s in cold["suppressions"])
+    rules_in_use = {s["rule"] for s in cold["suppressions"]}
+    assert {"LCK", "BLK"} <= rules_in_use
+    cache = cold["cache"]
+    assert cache["enabled"] is True
+    assert cache["hits"] == 0 and cache["invalidations"] == 0
+    assert cache["misses"] > 0  # a fresh cache dir: every unit is cold
+    rc2 = _run(args)
+    assert rc2.returncode == 0, rc2.stdout + rc2.stderr[-2000:]
+    warm = json.loads(rc2.stdout)
+    assert warm["cache"]["hits"] == cache["misses"]
+    assert warm["cache"]["misses"] == 0
+    assert warm["cache"]["invalidations"] == 0
+    for key in ("findings", "counts", "suppressions", "clean"):
+        assert warm[key] == cold[key]
+
+
+# ---------------------------------------------- content-hash result cache --
+def test_cache_warm_fixture_run_byte_identical(tmp_path):
+    """The fixture tree (a run WITH findings) twice through one cache
+    dir: the cold run misses every unit, the warm run hits every unit
+    and re-runs none -- with byte-identical findings, counts, and
+    suppressions either way (cached per-file results feed the
+    whole-program passes exactly like live ones)."""
+    args = ["-m", "spgemm_tpu.analysis", "--json",
+            "--cache-dir", str(tmp_path / "cache"), FIXTURES,
+            "--claude-md", FIXTURE_CLAUDE]
+    cold = json.loads(_run(args).stdout)
+    warm = json.loads(_run(args).stdout)
+    assert cold["cache"]["hits"] == 0 and cold["cache"]["misses"] > 0
+    assert warm["cache"]["hits"] == cold["cache"]["misses"]
+    assert warm["cache"]["misses"] == 0
+    assert warm["cache"]["invalidations"] == 0
+    for key in ("findings", "counts", "suppressions", "clean"):
+        assert warm[key] == cold[key]
+
+
+def test_cache_invalidates_on_edit(tmp_path):
+    """Editing one file invalidates exactly that entry (counted as an
+    invalidation, not a miss); untouched files still hit."""
+    tree = tmp_path / "tree"
+    tree.mkdir()
+    (tree / "a.py").write_text("def f():\n    return 1\n")
+    (tree / "b.py").write_text("def g():\n    return 2\n")
+    cdir = str(tmp_path / "cache")
+    args = ["-m", "spgemm_tpu.analysis", "--json", "--cache-dir", cdir,
+            str(tree)]
+    r1 = json.loads(_run(args).stdout)
+    assert r1["cache"] == {"enabled": True, "dir": cdir, "hits": 0,
+                           "misses": 2, "invalidations": 0}
+    (tree / "a.py").write_text("def f():\n    return 3\n")
+    r2 = json.loads(_run(args).stdout)
+    assert r2["cache"]["hits"] == 1
+    assert r2["cache"]["invalidations"] == 1
+    assert r2["cache"]["misses"] == 0
+
+
+def test_cache_signature_covers_rule_registries():
+    """The cached per-file rules validate against obs/metrics.py (MET)
+    and utils/failpoints.py (FPT): both must feed the linter-version
+    signature, or a registry edit would replay stale cached results
+    while the call sites' files are untouched."""
+    assert set(core._SIGNATURE_EXTRAS) == {"obs/metrics.py",
+                                           "utils/failpoints.py"}
+    for rel in core._SIGNATURE_EXTRAS:
+        assert os.path.exists(os.path.join(REPO, "spgemm_tpu", rel))
+
+
+def test_cache_prunes_dead_entries(tmp_path):
+    """Entries for files renamed or deleted out of the scope are dropped
+    on prune (default-scope runs call it), so cache.json cannot grow
+    without bound."""
+    d = str(tmp_path / "c")
+    cache = core.LintCache(d)
+    cache.put("a.py", "sha", [], set(), [])
+    cache.put("gone.py", "sha", [], set(), [])
+    cache.save()
+    c2 = core.LintCache(d)
+    c2.prune({"a.py"})
+    c2.save()
+    c3 = core.LintCache(d)
+    assert c3.get("a.py", "sha") is not None
+    assert c3.get("gone.py", "sha") is None and c3.misses == 1
+
+
+def test_cache_keyed_on_analysis_package_content():
+    """The linter-version half of the key is the analysis package's own
+    content hash: ANY rule change invalidates every entry -- there is no
+    version constant to forget to bump."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        cache = core.LintCache(d)
+        cache.put("x.py", "sha-of-x", [], set(), [])
+        cache.save()
+        fresh = core.LintCache(d)
+        assert fresh.get("x.py", "sha-of-x") is not None
+        assert fresh.hits == 1
+        skewed = core.LintCache(d)
+        skewed.signature = "not-the-analysis-package-hash"
+        assert skewed.get("x.py", "sha-of-x") is None
+        assert skewed.invalidations == 1
+        # and a content change on the file side invalidates too
+        assert fresh.get("x.py", "different-bytes") is None
+        assert fresh.invalidations == 1
 
 
 # ------------------------------------------------------ SARIF emission --
@@ -683,7 +1583,9 @@ def test_sarif_output_schema_shape(tmp_path):
     assert driver["name"] == "spgemm-lint"
     assert [r["id"] for r in driver["rules"]] == list(core.RULES)
     assert all(r["shortDescription"]["text"] for r in driver["rules"])
-    assert len(run["results"]) == 6  # 3 THR + 3 EXC
+    # 3 THR + 3 EXC active, plus the fixtures' two escaped findings
+    # (thr-ok + exc-ok) carried as results with SARIF suppressions
+    assert len(run["results"]) == 8
     for res in run["results"]:
         assert res["ruleId"] in core.RULES
         assert res["level"] == "error"
@@ -691,6 +1593,17 @@ def test_sarif_output_schema_shape(tmp_path):
         loc = res["locations"][0]["physicalLocation"]
         assert loc["artifactLocation"]["uri"].endswith(".py")
         assert loc["region"]["startLine"] >= 1
+    active = [r for r in run["results"] if not r["suppressions"]]
+    escaped = [r for r in run["results"] if r["suppressions"]]
+    assert len(active) == 6 and len(escaped) == 2
+    # an active finding carries the explicit empty array (SARIF's "not
+    # suppressed", distinct from "suppression state unknown")
+    assert all(r["suppressions"] == [] for r in active)
+    for res in escaped:
+        (sup,) = res["suppressions"]
+        assert sup["kind"] == "inSource"
+        assert sup["justification"]  # the escape reason, auditable
+    assert {r["ruleId"] for r in escaped} == {"THR", "EXC"}
 
 
 def test_sarif_clean_run_empty_results(tmp_path):
@@ -711,7 +1624,7 @@ def test_analysis_import_is_jax_free():
         "import sys\n"
         "import spgemm_tpu.analysis\n"
         "from spgemm_tpu.analysis import callgraph, core, excrules, "
-        "sarif, thrrules\n"
+        "lockrules, sarif, thrrules\n"
         "core.lint_repo()\n"
         "bad = [m for m in sys.modules\n"
         "       if m == 'jax' or m.startswith(('jax.', 'jaxlib'))]\n"
