@@ -97,10 +97,16 @@ serve-smoke:
 # `metrics` scrape (phase + plan-cache series must move, and the deep-
 # profiling families -- compile accounting with nonzero cost, span-fed
 # phase histograms, estimator/delta prediction accuracy, event-log
-# counters -- must appear and move), `cli profile --json` reports a
-# compile record with nonzero FLOPs, `cli events --tail` returns the
-# submit's lifecycle records, trace dumped and validated through the real
-# `cli trace-dump`, clean shutdown; exits nonzero on any step.
+# counters -- must appear and move, plus the SLO quantile/error-ratio
+# families), `cli profile --json` reports a compile record with nonzero
+# FLOPs, `cli events --tail` returns the submit's lifecycle records,
+# trace dumped and validated through the real `cli trace-dump`, clean
+# shutdown; then the SLO burn leg -- an armed serve.executor wedge must
+# flip spgemm_slo_burn_active, land an slo_burn event whose trace_id is
+# the client-minted submit trace, and `cli trace-dump --merge` must
+# stitch the client's ring dump + the daemon's dump into ONE Perfetto
+# trace resolving that id to spans from both processes; exits nonzero
+# on any step.
 obs-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m spgemm_tpu.serve.obs_smoke

@@ -28,11 +28,16 @@ resident daemon owning the device whose warm jit/plan/crossover caches are
 reused across jobs, vs this run-once entrypoint paying them per
 invocation.  `metrics` scrapes the daemon's Prometheus text-format
 surface and `trace-dump` serializes its span flight recorder as
-Perfetto/Chrome trace_event JSON (spgemm_tpu/obs/).  `profile` reports
-the daemon's deep-profiling accounts (jit compile wall + cost/memory
-analyses per engine site, HBM watermarks, estimator/delta prediction
-accuracy) and `events` tails its structured event log (obs/events.py
-JSONL: job lifecycle, watchdog transitions, fallbacks with reasons).
+Perfetto/Chrome trace_event JSON (spgemm_tpu/obs/) -- `trace-dump
+--merge DIR [--trace ID]` stitches per-process/per-rank dumps into one
+trace with labeled process tracks on a shared wall-clock timeline.
+`profile` reports the daemon's deep-profiling accounts (jit compile
+wall + cost/memory analyses per engine site, HBM watermarks,
+estimator/delta prediction accuracy), `events` tails its structured
+event log (obs/events.py JSONL: job lifecycle, watchdog transitions,
+fallbacks with reasons; `--follow` streams the rotating sink live),
+and `slo` reports the SLO engine (obs/slo.py: per-tenant rolling
+latency quantiles, error ratio, queue-wait share, burn-rate state).
 `warm --stat|--clear` inspects or empties the persistent warm-start
 store (ops/warmstore: the on-disk plan/delta entries + xla compilation
 cache a restarted spgemmd rehydrates from).
@@ -357,10 +362,15 @@ def _subcommands() -> dict:
         from spgemm_tpu.serve import client  # noqa: PLC0415
         return client.main_events(argv)
 
+    def slo(argv: list[str]) -> int:
+        from spgemm_tpu.serve import client  # noqa: PLC0415
+        return client.main_slo(argv)
+
     return {"knobs": run_knobs, "serve": serve,
             "submit": submit, "status": status,
             "metrics": metrics, "trace-dump": trace_dump,
-            "profile": profile, "events": events, "warm": run_warm}
+            "profile": profile, "events": events, "slo": slo,
+            "warm": run_warm}
 
 
 def run(argv: list[str] | None = None) -> int:
@@ -375,7 +385,7 @@ def run(argv: list[str] | None = None) -> int:
     # scratch dir does not swallow the subcommand
     if (argv and argv[0] in ("knobs", "serve", "submit", "status",
                              "metrics", "trace-dump", "profile", "events",
-                             "warm")
+                             "slo", "warm")
             and not os.path.exists(os.path.join(argv[0], "size"))):
         return _subcommands()[argv[0]](argv[1:])
     parser = build_parser()

@@ -258,3 +258,80 @@ LOG = EventLog()
 def emit(kind: str, **fields) -> None:
     """Module-level convenience: LOG.emit."""
     LOG.emit(kind, **fields)
+
+
+# ------------------------------------------------------------- follow --
+def _read_records(path: str, offset: int) -> tuple[int, list[dict]]:
+    """Complete JSONL records in `path` from byte `offset` on: returns
+    (offset past the last complete line, parsed records).  A trailing
+    half-written line is left for the next poll; a malformed line is
+    skipped (its bytes are consumed -- the writer never rewrites)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return offset, []
+    end = data.rfind(b"\n")
+    if end < 0:
+        return offset, []
+    records = []
+    for line in data[: end + 1].splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+    return offset + end + 1, records
+
+
+def follow_file(path: str, last_seq: int = 0, last_ts: float = 0.0,
+                poll_s: float = 0.2, stop=None):
+    """Yield records appended to the rotating event JSONL at `path`
+    (the `cli events --follow` engine): polls the file, survives a
+    rotation boundary (detected by INODE change, not just shrinkage --
+    a burst can grow the fresh file past the old read offset within one
+    poll; the tail of `<path>.1` beyond the old offset is drained
+    first) without dropping or duplicating a line.  Dedup is on each
+    record's (ts, seq) pair, not seq alone: a restarted daemon resets
+    its seq counter while appending to the same file, and its records
+    carry newer wall timestamps -- seq regression with a newer ts is a
+    new generation, not a duplicate.  `stop` (optional callable) ends
+    the generator when truthy (tests); the CLI ends it with Ctrl-C."""
+    offset = 0
+    last_ino: int | None = None
+    # (wall ts, seq): generation-safe dedup -- pass the newest
+    # already-printed record's ts alongside its seq, or file re-reads of
+    # the same records (their real ts beats a zero) would duplicate
+    last_key = (last_ts, last_seq)
+
+    def _emit_new(records):
+        nonlocal last_key
+        for rec in records:
+            key = (rec.get("ts", 0.0), rec.get("seq", 0))
+            if key > last_key:
+                last_key = key
+                yield rec
+
+    while True:
+        if stop is not None and stop():
+            return
+        try:
+            st = os.stat(path)
+            size, ino = st.st_size, st.st_ino
+        except OSError:
+            size, ino = 0, None  # sink not created yet (or mid-rotation)
+        rotated = (last_ino is not None and ino is not None
+                   and ino != last_ino) or size < offset
+        if rotated:
+            # the bytes past our offset moved to <path>.1 -- drain them
+            # before reading the fresh file from 0
+            _, old_tail = _read_records(path + ".1", offset)
+            yield from _emit_new(old_tail)
+            offset = 0
+        if ino is not None:
+            last_ino = ino
+        offset, records = _read_records(path, offset)
+        yield from _emit_new(records)
+        time.sleep(poll_s)

@@ -214,7 +214,9 @@ _METRICS = (
            "serve/daemon.py", labels=("slice",)),
     Metric("spgemmd_tenant_queue_depth", "gauge",
            "Jobs queued per fair-queuing tenant (tenants with no queued "
-           "or in-flight jobs are retired from the series).",
+           "or in-flight jobs are retired from the series).  Label "
+           "cardinality is bounded: the top-K tenants by recency keep "
+           "their own label, the rest aggregate into one `other` row.",
            "serve/daemon.py", labels=("tenant",)),
     Metric("spgemmd_queue_depth", "gauge",
            "Jobs currently waiting in the admission FIFO.",
@@ -336,6 +338,39 @@ _METRICS = (
            "Current on-disk size of the active event-log file (0 when "
            "no file sink is configured).",
            "obs/events.py"),
+    # ---- SLO engine (obs/slo.py) ----
+    Metric("spgemm_slo_latency_seconds", "gauge",
+           "Rolling-window per-tenant job latency quantile (p50/p95/p99 "
+           "from the SLO engine's fixed-bucket digest, merged over the "
+           "tenant's slices; window = SPGEMM_TPU_SLO_WINDOW_S).  Tenant "
+           "label cardinality is bounded at the source (top-K by "
+           "recency, evictions counted).",
+           "obs/slo.py", labels=("tenant", "quantile")),
+    Metric("spgemm_slo_error_ratio", "gauge",
+           "Rolling-window per-tenant error ratio (failed jobs / total "
+           "jobs in the SLO window).",
+           "obs/slo.py", labels=("tenant",)),
+    Metric("spgemm_slo_queue_wait_share", "gauge",
+           "Rolling-window per-tenant queue-wait share: queued seconds "
+           "/ (queued + execute) seconds -- whether a slow tenant is "
+           "waiting on the pool or on its own jobs.",
+           "obs/slo.py", labels=("tenant",)),
+    Metric("spgemm_slo_burn_active", "gauge",
+           "1 while the (tenant, slice) window is burning its error "
+           "budget in BOTH burn windows (fast = window/12, slow = full "
+           "window; objectives from SPGEMM_TPU_SLO_TARGET_S / "
+           "SPGEMM_TPU_SLO_ERROR_PCT) -- the transition emitted a "
+           "structured slo_burn event whose trace_id resolves via "
+           "`cli trace-dump --merge` to the newest bad job's stitched "
+           "trace; 0 (or the series absent) otherwise.",
+           "obs/slo.py", labels=("slice", "tenant")),
+    Metric("spgemm_slo_tenants_evicted_total", "counter",
+           "Tenants evicted from the SLO engine's top-K-by-recency "
+           "window set (their rolling windows dropped) -- the "
+           "cardinality bound that keeps a tenant-id-per-request "
+           "client from growing the engine or the scrape without "
+           "bound.",
+           "obs/slo.py"),
 )
 
 REGISTRY: dict[str, Metric] = {m.name: m for m in _METRICS}

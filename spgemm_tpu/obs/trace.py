@@ -207,10 +207,28 @@ RECORDER = FlightRecorder()
 
 
 # ------------------------------------------------------- Perfetto export --
-def to_trace_events(spans: list[dict] | None = None) -> list[dict]:
+# metadata event carrying the process's wall-clock origin: the span `ts`
+# axis is microseconds since this module's monotonic _BASE, which differs
+# per process -- the anchor lets the merge tool put per-process dumps on
+# one shared timeline (clock skew across hosts notwithstanding)
+CLOCK_ORIGIN_META = "spgemm_clock_origin"
+
+
+def wall_origin_us() -> float:
+    """The wall-clock time (epoch microseconds) corresponding to this
+    process's span-timestamp origin (_BASE)."""
+    return (time.time() - (time.perf_counter() - _BASE)) * 1e6
+
+
+def to_trace_events(spans: list[dict] | None = None,
+                    process_name: str | None = None) -> list[dict]:
     """Chrome/Perfetto trace_event JSON array for the given spans (default:
     the live ring).  Complete events ('X') carry ts+dur; instants stay
-    'i'; one metadata event per thread names it in the viewer."""
+    'i'; metadata events name the process and every thread in the viewer
+    and anchor the timeline to wall clock (CLOCK_ORIGIN_META) so
+    `cli trace-dump --merge` can stitch per-process dumps."""
+    import sys  # noqa: PLC0415 -- only for the default process label
+
     if spans is None:
         spans = RECORDER.snapshot()
     pid = os.getpid()
@@ -229,16 +247,25 @@ def to_trace_events(spans: list[dict] | None = None) -> list[dict]:
         if ev["ph"] == "X":
             ev["dur"] = s.get("dur", 0.0)
         events.append(ev)
-    meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
-             "args": {"name": name}} for tid, name in sorted(named_tids.items())]
+    if process_name is None:
+        process_name = (os.path.basename(sys.argv[0] or "python")
+                        + f":{pid}")
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": process_name}},
+            {"name": CLOCK_ORIGIN_META, "ph": "M", "pid": pid, "tid": 0,
+             "args": {"wall_origin_us": round(wall_origin_us(), 3)}}]
+    meta += [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+              "args": {"name": name}}
+             for tid, name in sorted(named_tids.items())]
     return meta + events
 
 
-def dump_json(path: str, spans: list[dict] | None = None) -> str:
+def dump_json(path: str, spans: list[dict] | None = None,
+              process_name: str | None = None) -> str:
     """Write the trace_event array to `path` (parent dirs created) and
     return the path -- the one serializer behind `cli trace-dump`, the
     daemon's postmortem auto-dump, and bench.py's detail.trace_path."""
-    events = to_trace_events(spans)
+    events = to_trace_events(spans, process_name=process_name)
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
     tmp = path + ".tmp"
@@ -246,3 +273,107 @@ def dump_json(path: str, spans: list[dict] | None = None) -> str:
         json.dump(events, f, separators=(",", ":"))
     os.replace(tmp, path)  # a reader never sees a torn dump
     return path
+
+
+# ------------------------------------------------------- trace stitching --
+def filter_trace(events: list[dict], trace_id: str) -> list[dict]:
+    """The events whose `trace_id` tag equals `trace_id`, plus the
+    metadata tracks (process/thread names, clock anchors) still backing
+    a surviving event -- an `slo_burn` trace id resolves to exactly one
+    flame view, not a ring's worth of unrelated jobs."""
+    keep = [ev for ev in events
+            if ev.get("ph") == "M"
+            or (ev.get("args") or {}).get("trace_id") == trace_id]
+    live = {(ev.get("pid"), ev.get("tid")) for ev in keep
+            if ev.get("ph") != "M"}
+    live_pids = {pid for pid, _tid in live}
+    out = []
+    for ev in keep:
+        if ev.get("ph") == "M":
+            if ev.get("pid") not in live_pids:
+                continue
+            if ev.get("name") == "thread_name" \
+                    and (ev.get("pid"), ev.get("tid")) not in live:
+                continue
+        out.append(ev)
+    return out
+
+
+def merge_trace_files(paths: list[str],
+                      trace_id: str | None = None) -> list[dict]:
+    """Stitch per-process/per-rank trace dumps into ONE Perfetto
+    trace_event array (`cli trace-dump --merge <dir>`):
+
+      * every file keeps its own process track -- colliding pids (two
+        dumps of one restarted daemon) are remapped to fresh ids, and a
+        file without a `process_name` metadata event gets one from its
+        filename, so the viewer shows distinct labeled tracks;
+      * timelines align on each dump's CLOCK_ORIGIN_META wall-clock
+        anchor (span `ts` axes are per-process monotonic origins):
+        every file's events shift onto the earliest anchor's axis; a
+        legacy dump without an anchor merges unshifted;
+      * `trace_id` filters to one trace's events (filter_trace), so an
+        slo_burn event's trace context opens as a single flame view
+        from client submit to slice fold.
+
+    Raises ValueError on a file that is not a trace_event array."""
+    loaded: list[tuple[str, list[dict], float | None]] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            events = json.load(f)
+        if not isinstance(events, list):
+            raise ValueError(f"{path} is not a trace_event JSON array")
+        origin = None
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == CLOCK_ORIGIN_META:
+                anchor = (ev.get("args") or {}).get("wall_origin_us")
+                if isinstance(anchor, (int, float)):
+                    origin = float(anchor)
+                break
+        loaded.append((path, events, origin))
+    anchors = [origin for _, _, origin in loaded if origin is not None]
+    base = min(anchors) if anchors else 0.0
+    claimed: dict[int, str] = {}  # merged pid -> owning file
+    merged_meta: list[dict] = []
+    merged_events: list[dict] = []
+    for path, events, origin in loaded:
+        shift = (origin - base) if origin is not None else 0.0
+        remap: dict[int, int] = {}
+        used: set[int] = set()  # merged pids this file already occupies
+        named: set[int] = set()
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == CLOCK_ORIGIN_META:
+                continue  # internal anchor: consumed by the shift above
+            pid = ev.get("pid", 0)
+            new = remap.get(pid)
+            if new is None:
+                new = pid
+                while claimed.get(new, path) != path or new in used:
+                    new += 1  # collision: walk to a fresh process id
+                claimed[new] = path
+                used.add(new)
+                remap[pid] = new
+            ev = dict(ev)
+            ev["pid"] = new
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + shift, 3)
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    named.add(new)
+                merged_meta.append(ev)
+            else:
+                merged_events.append(ev)
+        label = os.path.basename(path)
+        for suffix in (".trace.json", ".json"):
+            if label.endswith(suffix):
+                label = label[: -len(suffix)]
+                break
+        for pid in set(remap.values()) - named:
+            merged_meta.append({"name": "process_name", "ph": "M",
+                                "pid": pid, "tid": 0,
+                                "args": {"name": label}})
+    merged_events.sort(key=lambda ev: ev.get("ts", 0.0))
+    merged = merged_meta + merged_events
+    if trace_id is not None:
+        merged = filter_trace(merged, trace_id)
+    return merged

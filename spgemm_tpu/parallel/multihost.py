@@ -54,6 +54,7 @@ import os
 import numpy as np
 
 from spgemm_tpu.chain import chain_product
+from spgemm_tpu.obs import trace as obs_trace
 from spgemm_tpu.parallel.chainpart import partition_chain
 from spgemm_tpu.utils import knobs
 from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
@@ -277,4 +278,9 @@ def run_distributed(folder: str, k: int, n: int, loader, multiply=None,
     my = parts[r] if r < len(parts) else None
     mine = loader(my[0], my[1]) if my is not None else None
     log.info("process %d/%d owns chain[%s]", r, p, my)
-    return chain_product_multihost(mine, k, multiply=multiply, **kwargs)
+    # every span this rank emits carries its rank/world tags, so the
+    # per-rank trace dumps `cli trace-dump --merge` stitches show which
+    # host folded what (the slice tag's multihost analog)
+    with obs_trace.RECORDER.tagged(rank=r, world=p):
+        return chain_product_multihost(mine, k, multiply=multiply,
+                                       **kwargs)

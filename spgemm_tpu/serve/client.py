@@ -14,6 +14,8 @@ import socket
 import sys
 import time
 
+from spgemm_tpu.obs import events as obs_events
+from spgemm_tpu.obs import trace as obs_trace
 from spgemm_tpu.serve import protocol
 
 # one server-side wait is bounded (Daemon.MAX_WAIT_SLICE_S), so wait()
@@ -91,16 +93,40 @@ def request(msg: dict, socket_path: str | None = None,
     daemon-unavailable ServeError; other OSError flavors raise as
     before.  Raises ServeError on an error response.
 
-    Requests advertise the LOWEST protocol version that carries their
-    features (v1 unless the caller stamped a higher `v` -- submit does,
-    when a tenant rides along): a v2 daemon accepts v1 requests, so the
-    upgraded client keeps working against a still-v1 daemon during a
-    rolling upgrade instead of tripping its strict version check."""
+    Version negotiation is the capability table's, not per call site:
+    the request advertises protocol.version_for(msg) -- the LOWEST
+    version carrying its optional fields (tenant: v2, trace: v3) -- so
+    a newer daemon serves old-shaped requests and an old daemon never
+    sees a version it must reject for a feature the request does not
+    use.  When an older daemon still rejects (its version-mismatch
+    answer names what it accepts), the request retries ONCE at the best
+    mutually-spoken version with the too-new fields stripped
+    (protocol.strip_for_version; the daemon supplies the fallback:
+    default tenant, minted trace) -- rolling upgrades work in both
+    directions."""
     path = socket_path or protocol.default_socket_path()
     if retry_total_s is None:
         retry_total_s = CONNECT_RETRY_TOTAL_S
+    version = protocol.version_for(msg)
+    try:
+        return _request_once(msg, version, path, timeout, retry_total_s)
+    except ServeError as e:
+        if e.code != protocol.E_BAD_REQUEST:
+            raise
+        accepted = protocol.accepted_from_error(e.message)
+        best = max((a for a in accepted
+                    if a in protocol.ACCEPTED_VERSIONS and a < version),
+                   default=None)
+        if best is None:
+            raise
+        return _request_once(protocol.strip_for_version(msg, best), best,
+                             path, timeout, retry_total_s)
+
+
+def _request_once(msg: dict, version: int, path: str,
+                  timeout: float | None, retry_total_s: float) -> dict:
     with _connect(path, timeout, retry_total_s) as sock:
-        sock.sendall(protocol.encode({"v": 1, **msg}))
+        sock.sendall(protocol.encode({"v": version, **msg}))
         for line in protocol.read_lines(sock):
             resp = json.loads(line)
             if not resp.get("ok"):
@@ -114,7 +140,14 @@ def request(msg: dict, socket_path: str | None = None,
 
 def submit(folder: str, socket_path: str | None = None,
            options: dict | None = None, timeout: float | None = None,
-           tenant: str | None = None) -> dict:
+           tenant: str | None = None, trace: str | None = None) -> dict:
+    """Enqueue a chain job.  The client MINTS the end-to-end trace
+    context here (or threads through the caller's `trace`) and emits a
+    `client_submit` span under it into the local flight recorder -- the
+    client-side end of the stitched trace `cli trace-dump --merge`
+    assembles (dump this process's ring with obs.trace.dump_json).  The
+    version stamp and any downgrade against an older daemon are the
+    capability table's business (see request())."""
     # paths resolve CLIENT-side: the daemon's cwd is not the submitter's,
     # so a relative folder/output/checkpoint_dir sent verbatim would be
     # checked (and written!) against the wrong tree -- and journal replay
@@ -123,15 +156,18 @@ def submit(folder: str, socket_path: str | None = None,
     for key in ("output", "checkpoint_dir"):
         if options.get(key):
             options[key] = os.path.abspath(options[key])
+    trace = trace or protocol.mint_trace()
     msg = {"op": "submit", "folder": os.path.abspath(folder),
-           "options": options}
+           "options": options, "trace": trace}
     if tenant is not None:
-        # the optional fair-queuing identity needs protocol v2; without
-        # it the request stays fully v1-shaped (version stamp included),
-        # so legacy daemons keep serving upgraded clients
         msg["tenant"] = tenant
-        msg["v"] = protocol.PROTOCOL_VERSION
-    return request(msg, socket_path, timeout=timeout)
+    t0 = time.perf_counter()
+    with obs_trace.RECORDER.tagged(trace_id=trace):
+        try:
+            return request(msg, socket_path, timeout=timeout)
+        finally:
+            obs_trace.RECORDER.point("client_submit",
+                                     time.perf_counter() - t0)
 
 
 def status(job_id: str, socket_path: str | None = None) -> dict:
@@ -193,6 +229,20 @@ def events(n: int = 50, socket_path: str | None = None) -> list[dict]:
     return request({"op": "events", "n": n}, socket_path)["events"]
 
 
+def events_info(n: int = 50, socket_path: str | None = None) -> dict:
+    """The `events` op's full answer: {events: [...], log: <sink
+    stats>} -- the log block carries the on-disk JSONL path the
+    --follow mode tails."""
+    return request({"op": "events", "n": n}, socket_path)
+
+
+def slo(socket_path: str | None = None) -> dict:
+    """The daemon's SLO engine report (obs/slo.py): per-tenant rolling
+    latency quantiles / error ratio / queue-wait share, per-(tenant,
+    slice) burn state, declared objectives."""
+    return request({"op": "slo"}, socket_path)["slo"]
+
+
 def shutdown(socket_path: str | None = None) -> dict:
     return request({"op": "shutdown"}, socket_path)
 
@@ -224,6 +274,12 @@ def main_submit(argv: list[str] | None = None) -> int:
                         "daemon round-robins across tenants and may cap "
                         "per-tenant in-flight jobs, "
                         "SPGEMM_TPU_SERVE_TENANT_INFLIGHT)")
+    p.add_argument("--trace", default=None, metavar="HEX32",
+                   help="thread an existing 128-bit trace context "
+                        "(32 lowercase hex chars) through the job "
+                        "(default: the client mints one; either way it "
+                        "is echoed in the response and stamps every "
+                        "span/event of the job)")
     p.add_argument("--failover", action="store_true",
                    help="run the job with chain failover enabled")
     p.add_argument("--wait", action="store_true",
@@ -238,7 +294,7 @@ def main_submit(argv: list[str] | None = None) -> int:
         ("failover", args.failover or None)) if v is not None}
     try:
         resp = submit(args.folder, args.socket, options,
-                      tenant=args.tenant)
+                      tenant=args.tenant, trace=args.trace)
         if args.wait:
             resp = wait(resp["id"], args.socket)
     except (ServeError, OSError) as e:
@@ -333,14 +389,17 @@ def main_profile(argv: list[str] | None = None) -> int:
 
 
 def main_events(argv: list[str] | None = None) -> int:
-    """`spgemm_tpu events [--tail N]`: the running daemon's newest
-    structured event-log records, one JSON object per line."""
+    """`spgemm_tpu events [--tail N] [--follow]`: the running daemon's
+    newest structured event-log records, one JSON object per line;
+    --follow then streams new records as they land (tailing the
+    rotating on-disk JSONL next to the journal, surviving a rotation
+    boundary without dropping or duplicating lines; Ctrl-C exits 0)."""
     p = argparse.ArgumentParser(
         prog="spgemm_tpu events",
         description="print the running spgemmd daemon's newest "
                     "structured event-log records (job lifecycle, "
                     "watchdog reap/degrade, est/delta fallbacks, compile "
-                    "records) as JSONL")
+                    "records, slo_burn transitions) as JSONL")
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
@@ -348,37 +407,147 @@ def main_events(argv: list[str] | None = None) -> int:
                    help="newest N records (default 50; bounded by the "
                         "daemon's in-process event ring -- the on-disk "
                         "<socket>.events.jsonl holds the longer history)")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="after the tail, keep streaming records as the "
+                        "daemon appends them (polls the rotating JSONL "
+                        "sink; records are deduplicated by their seq, "
+                        "so a rotation boundary neither drops nor "
+                        "repeats a line; Ctrl-C exits 0)")
     args = p.parse_args(argv)
     try:
-        recs = events(args.tail, args.socket)
+        resp = events_info(args.tail, args.socket)
     except (ServeError, OSError) as e:
         print(f"events failed: {e}", file=sys.stderr)
         return 1
-    for rec in recs:
+    last_seq, last_ts = 0, 0.0
+    for rec in resp["events"]:
+        last_seq = max(last_seq, rec.get("seq", 0))
+        last_ts = max(last_ts, rec.get("ts", 0.0))
         print(json.dumps(rec, separators=(",", ":")))
+    if not args.follow:
+        return 0
+    path = (resp.get("log") or {}).get("path")
+    if not path:
+        print("events --follow: the daemon has no on-disk event sink "
+              "to tail (SPGEMM_TPU_OBS_EVENTS=0?)", file=sys.stderr)
+        return 1
+    try:
+        for rec in obs_events.follow_file(path, last_seq=last_seq,
+                                          last_ts=last_ts):
+            print(json.dumps(rec, separators=(",", ":")), flush=True)
+    except KeyboardInterrupt:
+        return 0
+    return 0
+
+
+def main_slo(argv: list[str] | None = None) -> int:
+    """`spgemm_tpu slo [--json]`: the running daemon's SLO report --
+    declared objectives, per-tenant rolling latency quantiles / error
+    ratio / queue-wait share, and per-(tenant, slice) burn-rate state
+    (a burning window names the trace context that resolves via
+    `trace-dump --merge` to the newest bad job's stitched trace)."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu slo",
+        description="report the running spgemmd daemon's SLO engine: "
+                    "objectives, per-tenant rolling-window latency "
+                    "quantiles (p50/p95/p99), error ratio, queue-wait "
+                    "share, and multi-window burn-rate state")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
+                        "or <tmpdir>/spgemmd-<uid>.sock)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="full machine-readable report")
+    args = p.parse_args(argv)
+    try:
+        rep = slo(args.socket)
+    except (ServeError, OSError) as e:
+        print(f"slo failed: {e}", file=sys.stderr)
+        return 1
+    if args.as_json:
+        print(json.dumps(rep, indent=2))
+        return 0
+    obj = rep.get("objectives", {})
+    if obj.get("enabled"):
+        print(f"objectives: target_s={obj['target_s']:g} "
+              f"error_pct={obj['error_pct']:g} "
+              f"window_s={obj['window_s']:g}")
+    else:
+        print("objectives: none declared (accounting-only; set "
+              "SPGEMM_TPU_SLO_TARGET_S to arm burn-rate evaluation)")
+    for tenant, row in rep.get("tenants", {}).items():
+        lat = row["latency_s"]
+        print(f"tenant {tenant}: jobs={row['jobs']} "
+              f"p50={lat['p50']:g}s p95={lat['p95']:g}s "
+              f"p99={lat['p99']:g}s "
+              f"error_ratio={row['error_ratio']:g} "
+              f"queue_share={row['queue_wait_share']:g}")
+    for b in rep.get("burn", []):
+        if not b.get("active") and not b.get("bad"):
+            continue
+        state = "BURNING" if b.get("active") else "ok"
+        print(f"burn {b['tenant']}/{b['slice']}: {state} "
+              f"fast={b.get('fast_burn', 0):g} "
+              f"slow={b.get('slow_burn', 0):g} "
+              f"bad={b.get('bad', 0)}/{b.get('jobs', 0)} "
+              f"trace={b.get('trace_id')}")
+    print(f"tenants_evicted={rep.get('tenants_evicted', 0)} "
+          f"records={rep.get('records', 0)}")
     return 0
 
 
 def main_trace_dump(argv: list[str] | None = None) -> int:
-    """`spgemm_tpu trace-dump`: serialize the daemon's span flight
-    recorder as Perfetto/Chrome trace_event JSON (open the file at
-    https://ui.perfetto.dev or chrome://tracing)."""
+    """`spgemm_tpu trace-dump [--merge DIR] [--trace ID]`: serialize the
+    daemon's span flight recorder as Perfetto/Chrome trace_event JSON
+    (open the file at https://ui.perfetto.dev or chrome://tracing), OR
+    stitch a directory of per-process/per-rank dumps into ONE Perfetto
+    file with distinct labeled process tracks and a shared wall-clock
+    timeline; --trace filters either mode down to one trace context's
+    events (the flame view an slo_burn event's trace_id resolves to)."""
     p = argparse.ArgumentParser(
         prog="spgemm_tpu trace-dump",
         description="dump the running spgemmd daemon's span flight "
-                    "recorder as Perfetto/Chrome trace_event JSON")
+                    "recorder as Perfetto/Chrome trace_event JSON, or "
+                    "(--merge) stitch per-process dumps into one trace")
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
+    p.add_argument("--merge", default=None, metavar="DIR",
+                   help="instead of scraping a daemon, stitch every "
+                        "*.json trace dump under DIR (client ring dumps, "
+                        "daemon trace-dumps, <socket>.flight/ postmortems, "
+                        "per-rank dumps) into one Perfetto file: distinct "
+                        "process tracks per dump, timelines aligned on "
+                        "each dump's wall-clock anchor")
+    p.add_argument("--trace", default=None, metavar="ID",
+                   help="keep only events carrying this 128-bit trace "
+                        "context (trace_id tag), plus the metadata "
+                        "tracks still backing them")
     p.add_argument("--output", "-o", default=None, metavar="FILE",
                    help="write the trace_event array here "
                         "(default: stdout)")
     args = p.parse_args(argv)
-    try:
-        events = trace(args.socket)
-    except (ServeError, OSError) as e:
-        print(f"trace-dump failed: {e}", file=sys.stderr)
-        return 1
+    if args.merge:
+        import glob  # noqa: PLC0415
+
+        paths = sorted(glob.glob(os.path.join(args.merge, "*.json")))
+        if not paths:
+            print(f"trace-dump --merge: no *.json dumps under "
+                  f"{args.merge}", file=sys.stderr)
+            return 1
+        try:
+            events = obs_trace.merge_trace_files(paths,
+                                                 trace_id=args.trace)
+        except (OSError, ValueError) as e:
+            print(f"trace-dump --merge failed: {e}", file=sys.stderr)
+            return 1
+    else:
+        try:
+            events = trace(args.socket)
+        except (ServeError, OSError) as e:
+            print(f"trace-dump failed: {e}", file=sys.stderr)
+            return 1
+        if args.trace:
+            events = obs_trace.filter_trace(events, args.trace)
     if args.output:
         with open(args.output, "w", encoding="utf-8") as f:
             json.dump(events, f, separators=(",", ":"))

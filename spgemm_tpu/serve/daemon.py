@@ -90,6 +90,7 @@ import zlib
 from spgemm_tpu.obs import events as obs_events
 from spgemm_tpu.obs import metrics as obs_metrics
 from spgemm_tpu.obs import profile as obs_profile
+from spgemm_tpu.obs import slo as obs_slo
 from spgemm_tpu.obs import trace as obs_trace
 from spgemm_tpu.ops import warmstore
 from spgemm_tpu.parallel import mesh as mesh_mod
@@ -520,7 +521,10 @@ class Daemon:
                 job = Job(ev["id"], ev["folder"], ev["output"],
                           ev.get("options", {}),
                           timeout_s=ev.get("timeout_s", 0.0),
-                          tenant=ev.get("tenant", protocol.DEFAULT_TENANT))
+                          tenant=ev.get("tenant", protocol.DEFAULT_TENANT),
+                          # pre-v3 journal records carry no trace
+                          # context: the Job mints a fresh one
+                          trace_id=ev.get("trace"))
             except (KeyError, TypeError) as e:
                 log.warning("journal: skipping malformed record %r (%r)",
                             ev, e)
@@ -864,11 +868,14 @@ class Daemon:
             try:
                 # every span this job's work emits (executor thread + the
                 # plan-ahead / OOC workers it spawns, which adopt the
-                # attribution) carries the job id AND the slice name;
-                # queue wait is the first per-job phase so a scraper sees
+                # attribution) carries the job id, the END-TO-END trace
+                # context (client-minted at submit, protocol v3 -- not
+                # the job id: the id is this daemon's namespace, the
+                # trace crosses processes) AND the slice name; queue
+                # wait is the first per-job phase so a scraper sees
                 # admission latency
                 with obs_trace.RECORDER.tagged(job_id=job.id,
-                                               trace_id=job.id,
+                                               trace_id=job.trace_id,
                                                slice=sl.name):
                     obs_events.emit("job_start", degraded=degraded,
                                     folder=job.folder, slice=sl.name,
@@ -983,7 +990,8 @@ class Daemon:
         """Bookkeeping for a terminal transition THIS daemon committed
         (call only when Job.finish returned True): daemon-lifetime outcome
         totals + the job-wall histogram behind `stats` and the Prometheus
-        surface, plus the fair queue's per-tenant in-flight release."""
+        surface, the fair queue's per-tenant in-flight release, and one
+        record into the SLO engine's rolling (tenant, slice) window."""
         self.queue.release(job)
         snap = job.snapshot()
         started = snap["started_at"] or snap["submitted_at"]
@@ -997,6 +1005,18 @@ class Daemon:
             for le in hist["buckets"]:
                 if wall <= le:
                     hist["buckets"][le] += 1
+        # the SLO record (outside _lock: the engine has its own lock and
+        # daemon/engine locks must never nest): queue wait = admission to
+        # pickup (the whole wall for a job reaped before it ever started)
+        queue_wait = max(0.0, (snap["started_at"]
+                               or snap["finished_at"]
+                               or snap["submitted_at"])
+                         - snap["submitted_at"])
+        obs_slo.SLO.observe(tenant=job.tenant,
+                            slice_name=job.slice or "unplaced",
+                            wall_s=wall, queue_wait_s=queue_wait,
+                            error=outcome != "done",
+                            trace_id=job.trace_id)
 
     def _flight_dump(self, name: str) -> str | None:
         """Snapshot the span flight recorder next to the journal
@@ -1098,8 +1118,11 @@ class Daemon:
                 from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
                 ENGINE.incr("serve_reaps")
                 obs_trace.RECORDER.instant("serve_reap",
-                                           job_id=job.id, slice=sl.name)
+                                           job_id=job.id,
+                                           trace_id=job.trace_id,
+                                           slice=sl.name)
                 obs_events.emit("watchdog_reap", job_id=job.id,
+                                trace_id=job.trace_id,
                                 timeout_s=job.timeout_s, slice=sl.name)
                 self._observe_terminal(job, "timeout")
                 self._flight_dump(job.id)
@@ -1116,6 +1139,7 @@ class Daemon:
                 sl.reaped = None
                 self._flight_dump(f"{reaped.id}.wedged")
                 obs_events.emit("watchdog_wedge", job_id=reaped.id,
+                                trace_id=reaped.trace_id,
                                 grace_s=self._wedge_grace_s,
                                 slice=sl.name)
                 self._degrade_slice(sl, f"executor wedged on reaped job "
@@ -1399,6 +1423,8 @@ class Daemon:
             return self._op_profile()
         if op == "events":
             return self._op_events(msg)
+        if op == "slo":
+            return self._op_slo()
         return self._op_shutdown()
 
     def _op_submit(self, msg: dict) -> dict:
@@ -1429,6 +1455,16 @@ class Daemon:
                 protocol.E_BAD_REQUEST,
                 f"tenant must be 1-{protocol.TENANT_MAX_LEN} chars of "
                 f"[A-Za-z0-9._:-], got {tenant!r}")
+        # the optional end-to-end trace context (protocol v3): present
+        # but malformed is a bad-request (a client that tried to thread
+        # a trace must hear it failed, not silently get a re-mint);
+        # absent (v1/v2 clients) = the Job mints one
+        trace_ctx = msg.get("trace")
+        if trace_ctx is not None and not protocol.valid_trace(trace_ctx):
+            return protocol.error(
+                protocol.E_BAD_REQUEST,
+                f"trace must be {protocol.TRACE_HEX_LEN} lowercase hex "
+                f"chars (a 128-bit trace context), got {trace_ctx!r}")
         # option VALUES are validated at admission like option names: a
         # bad round_size/backend must answer bad-request here, not fail
         # the job later with an opaque job-error from inside the runner
@@ -1473,7 +1509,7 @@ class Daemon:
             job_id = f"job-{self._next_id}"
             self._next_id += 1
         job = Job(job_id, folder, output, options, timeout_s=timeout_s,
-                  tenant=tenant)
+                  tenant=tenant, trace_id=trace_ctx)
         # estimator-priced placement, decided at admission (cheap: a
         # price-book stat lookup, never a file parse) and carried on the
         # job for the slice executors' accept predicates
@@ -1488,7 +1524,7 @@ class Daemon:
         self._journal_append({"event": "submit", "id": job.id,
                               "folder": folder, "output": output,
                               "options": options, "timeout_s": timeout_s,
-                              "tenant": tenant})
+                              "tenant": tenant, "trace": job.trace_id})
         try:
             depth = self.queue.submit(job)
         except QueueFull as e:
@@ -1506,8 +1542,10 @@ class Daemon:
                 "SPGEMM_TPU_SERVE_TENANT_INFLIGHT", id=None)
         obs_events.emit("job_submit", job_id=job.id, folder=folder,
                         queued=depth, tenant=tenant,
+                        trace_id=job.trace_id,
                         placement=job.placement)
-        return protocol.ok(id=job.id, state=job.state, queued=depth)
+        return protocol.ok(id=job.id, state=job.state, queued=depth,
+                           trace=job.trace_id)
 
     def _op_status(self, msg: dict, wait: bool) -> dict:
         job_id = msg.get("id")
@@ -1626,6 +1664,7 @@ class Daemon:
             trace=obs_trace.RECORDER.stats(),
             events=obs_events.LOG.stats(),
             profile=obs_profile.summary(),
+            slo=obs_slo.SLO.report(),
             flight_dir=self.flight_dir,
             plan_cache=cache,
             delta=delta_stats,
@@ -1676,9 +1715,21 @@ class Daemon:
                 ("spgemm_slice_recoveries_total", labels,
                  row["recoveries"]),
             ]
-        for tenant, row in self.queue.tenants().items():
-            samples.append(("spgemmd_tenant_queue_depth",
-                            {"tenant": tenant}, row["queued"]))
+        # per-tenant series are cardinality-bounded at the scrape: the
+        # top TENANT_RETAIN tenants by recency keep their own label, the
+        # rest aggregate into one `other` row -- a tenant-id-per-request
+        # client cannot grow the scrape without bound (the SLO families
+        # apply the same cap inside the engine)
+        tenant_rows = sorted(self.queue.tenants().items(),
+                             key=lambda kv: kv[1].get("last_seen", 0.0),
+                             reverse=True)
+        depths: dict[str, int] = {}
+        for i, (tenant, row) in enumerate(tenant_rows):
+            label = tenant if i < obs_slo.TENANT_RETAIN else "other"
+            depths[label] = depths.get(label, 0) + row["queued"]
+        samples += [("spgemmd_tenant_queue_depth", {"tenant": tenant}, n)
+                    for tenant, n in sorted(depths.items())]
+        samples += obs_slo.SLO.samples()
         return protocol.ok(
             content_type="text/plain; version=0.0.4; charset=utf-8",
             text=obs_metrics.render(samples))
@@ -1707,6 +1758,12 @@ class Daemon:
                                   f"n must be an integer, got {n!r}")
         return protocol.ok(events=obs_events.LOG.tail(n),
                            log=obs_events.LOG.stats())
+
+    def _op_slo(self) -> dict:
+        """The SLO engine's rolling objective report (obs/slo.py):
+        per-tenant latency quantiles / error ratio / queue-wait share,
+        per-(tenant, slice) burn state, declared objectives."""
+        return protocol.ok(slo=obs_slo.SLO.report())
 
     def _op_shutdown(self) -> dict:
         self._stop.set()

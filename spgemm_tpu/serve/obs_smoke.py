@@ -27,7 +27,20 @@ observability contract:
   * the `trace` op returns Perfetto/Chrome trace_event JSON whose spans
     carry the job id, and `spgemm_tpu.cli trace-dump -o F` round-trips it
     through the real CLI to a valid JSON file;
+  * the SLO engine judges (obs/slo.py): the per-tenant latency quantile
+    and error-ratio families render and move after the submit;
   * shutdown is clean.
+
+Then the SLO burn + trace-stitching leg: a SECOND daemon starts with an
+armed `serve.executor:1:1` failpoint (the backend-wedge signature), a
+tight wedge grace, and declared objectives -- its first submit wedges,
+the watchdog reaps it, `spgemm_slo_burn_active{tenant=,slice=}` must
+flip to 1, an `slo_burn` event must land whose trace_id is EXACTLY the
+trace context the client minted at submit, and `cli trace-dump --merge`
+over the client's own ring dump + the daemon's trace-dump must stitch
+one Perfetto file in which that trace id resolves to spans from BOTH
+processes (client_submit on the client pid, the wedged job's spans on
+the daemon pid) -- client submit to slice execution, one flame view.
 
 Any step failing exits nonzero.  This process itself stays jax-free (the
 client and the generator are pure numpy) -- only the daemon touches a
@@ -86,10 +99,16 @@ def main() -> int:
     mats = [banded_block_sparse(64, k, 1, rng, "full") for _ in range(n)]
     io_text.write_chain_dir(folder, mats, k)
 
+    # declared objectives arm the SLO engine's burn evaluation (the
+    # accounting families render regardless); generous target -- this
+    # leg's jobs must all land GOOD
+    env = {**os.environ, "SPGEMM_TPU_SLO_TARGET_S": "60",
+           "SPGEMM_TPU_SLO_WINDOW_S": "600"}
     proc = subprocess.Popen(
         [sys.executable, "-m", "spgemm_tpu.cli", "serve",
          "--socket", sock, "--device", "cpu", "-v"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
     try:
         deadline = time.time() + 120
         while not os.path.exists(sock):
@@ -164,6 +183,17 @@ def main() -> int:
             return _fail(proc, "event-log counter did not move across "
                                "the submit")
 
+        # SLO engine families (obs/slo.py): the rolling window judged
+        # the done job -- quantiles render and move, zero error ratio
+        p50 = 'spgemm_slo_latency_seconds{quantile="0.5",tenant="default"}'
+        if after.get(p50, 0) <= 0:
+            return _fail(proc, "SLO latency quantile series did not "
+                               "appear/move after the submit")
+        if after.get('spgemm_slo_error_ratio{tenant="default"}',
+                     None) != 0.0:
+            return _fail(proc, "SLO error ratio should be 0.0 after one "
+                               "done job")
+
         # `cli profile --json` through the real CLI: >= 1 compile record
         # with nonzero cost (the acceptance gate)
         rc = subprocess.run(
@@ -232,9 +262,150 @@ def main() -> int:
     finally:
         if proc.poll() is None:
             proc.kill()
-    print(f"obs-smoke: OK (phase+plan-cache+compile+accuracy series "
-          f"moved, profile/events CLIs answered, {len(events)} trace "
-          f"events, {len(tagged)} tagged {job_id}, clean shutdown)")
+    rc = _slo_burn_leg(tmp, folder)
+    if rc != 0:
+        return rc
+    print(f"obs-smoke: OK (phase+plan-cache+compile+accuracy+SLO series "
+          f"moved, profile/events/slo CLIs answered, {len(events)} trace "
+          f"events, {len(tagged)} tagged {job_id}, burn leg stitched, "
+          f"clean shutdown)")
+    return 0
+
+
+def _slo_burn_leg(tmp: str, folder: str) -> int:
+    """The SLO-burn + end-to-end-trace leg: an armed serve.executor
+    wedge must flip spgemm_slo_burn_active, land an slo_burn event
+    carrying the client-minted trace context, and that trace id must
+    resolve via `cli trace-dump --merge` to ONE stitched Perfetto trace
+    holding spans from both the client process and the daemon."""
+    from spgemm_tpu.obs import trace as obs_trace  # noqa: PLC0415
+    from spgemm_tpu.serve import client  # noqa: PLC0415
+
+    sock = os.path.join(tmp, "d2.sock")
+    env = {**os.environ,
+           "SPGEMM_TPU_SLO_TARGET_S": "60",
+           "SPGEMM_TPU_SLO_WINDOW_S": "600",
+           "SPGEMM_TPU_SERVE_WEDGE_GRACE_S": "2",
+           # the backend-wedge signature, once: the first pickup hangs
+           "SPGEMM_TPU_FAILPOINTS": "serve.executor:1:1"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+         "--socket", sock, "--device", "cpu", "-v"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(sock):
+            if proc.poll() is not None:
+                return _fail(proc, "burn-leg daemon exited before "
+                                   "binding its socket")
+            if time.time() > deadline:
+                return _fail(proc, "burn-leg daemon never bound its "
+                                   "socket")
+            time.sleep(0.1)
+
+        out = os.path.join(tmp, "matrix.wedge")
+        resp = client.submit(folder, sock,
+                             {"output": out, "timeout_s": 1.0})
+        job_id, trace_id = resp["id"], resp.get("trace")
+        if not isinstance(trace_id, str) or len(trace_id) != 32:
+            return _fail(proc, f"submit returned no 128-bit trace "
+                               f"context (got {trace_id!r})")
+        resp = client.wait(job_id, sock, timeout=120)
+        if resp["job"]["state"] != "failed" \
+                or resp["job"]["error"]["code"] != "job-timeout":
+            return _fail(proc, f"wedged job should have been reaped "
+                               f"job-timeout, got {resp['job']}")
+
+        # the reap fed the SLO window: the burn gauge must flip to 1
+        burning = None
+        deadline = time.time() + 60
+        while time.time() < deadline and burning is None:
+            scraped = parse_prometheus(client.metrics(sock))
+            for series, value in scraped.items():
+                if series.startswith("spgemm_slo_burn_active{") \
+                        and value == 1.0:
+                    burning = series
+            if burning is None:
+                time.sleep(0.2)
+        if burning is None:
+            return _fail(proc, "spgemm_slo_burn_active never flipped "
+                               "after the wedge reap")
+        if 'tenant="default"' not in burning:
+            return _fail(proc, f"burn gauge carries the wrong tenant: "
+                               f"{burning}")
+
+        # the slo op + the slo_burn event both resolve to the SUBMIT's
+        # client-minted trace context -- the alert-to-trace join
+        rep = client.slo(sock)
+        active = [b for b in rep["burn"] if b["active"]]
+        if not active or active[0].get("trace_id") != trace_id:
+            return _fail(proc, f"slo report's burning window does not "
+                               f"carry the submit's trace context "
+                               f"(want {trace_id}, got {active})")
+        recs = client.events(200, sock)
+        burn_evs = [r for r in recs if r.get("kind") == "slo_burn"]
+        if not burn_evs:
+            return _fail(proc, "no slo_burn event landed")
+        if burn_evs[-1].get("trace_id") != trace_id:
+            return _fail(proc, f"slo_burn event trace_id "
+                               f"{burn_evs[-1].get('trace_id')} != "
+                               f"submit trace {trace_id}")
+
+        # stitch client + daemon into one flame view keyed on the trace
+        stitch = os.path.join(tmp, "stitch")
+        obs_trace.dump_json(os.path.join(stitch, "client.trace.json"),
+                            process_name="obs-smoke-client")
+        rc = subprocess.run(
+            [sys.executable, "-m", "spgemm_tpu.cli", "trace-dump",
+             "--socket", sock, "-o",
+             os.path.join(stitch, "daemon.trace.json")],
+            capture_output=True, text=True, timeout=60)
+        if rc.returncode != 0:
+            return _fail(proc, f"burn-leg trace-dump failed: "
+                               f"{rc.stderr[-500:]}")
+        merged_path = os.path.join(tmp, "merged.trace.json")
+        rc = subprocess.run(
+            [sys.executable, "-m", "spgemm_tpu.cli", "trace-dump",
+             "--merge", stitch, "--trace", trace_id, "-o", merged_path],
+            capture_output=True, text=True, timeout=60)
+        if rc.returncode != 0:
+            return _fail(proc, f"cli trace-dump --merge failed: "
+                               f"{rc.stderr[-500:]}")
+        with open(merged_path, encoding="utf-8") as f:
+            merged = json.load(f)
+        spans = [ev for ev in merged if ev.get("ph") != "M"]
+        if not spans:
+            return _fail(proc, "merged trace holds no spans for the "
+                               "burn trace id")
+        pids = {ev["pid"] for ev in spans}
+        names = {ev["name"] for ev in spans}
+        if len(pids) < 2:
+            return _fail(proc, f"merge did not stitch client AND daemon "
+                               f"tracks (pids {pids}, names {names})")
+        if "client_submit" not in names:
+            return _fail(proc, "merged trace lacks the client_submit "
+                               "span")
+        if not any((ev.get("args") or {}).get("job_id") == job_id
+                   for ev in spans):
+            return _fail(proc, f"merged trace lacks the wedged job's "
+                               f"daemon-side spans ({job_id}; saw "
+                               f"{sorted(names)})")
+
+        client.shutdown(sock)
+        try:
+            rcode = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            return _fail(proc, "burn-leg daemon did not exit after "
+                               "shutdown")
+        if rcode != 0:
+            return _fail(proc, f"burn-leg daemon exited {rcode} after "
+                               f"shutdown")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    print(f"obs-smoke: burn leg OK ({burning} -> slo_burn trace "
+          f"{trace_id} stitched across {len(pids)} processes)")
     return 0
 
 

@@ -10,15 +10,29 @@ a structured `bad-request` error -- the daemon must survive garbage input
 (acceptance-gated in tests/test_serve.py), so decode failures never
 propagate past the connection handler.
 
+Version negotiation is centralized in the FIELD_MIN_VERSION capability
+table below: a client stamps version_for(msg) -- the lowest version
+carrying its optional fields (tenant: v2, trace: v3) -- and downgrades
+via strip_for_version() when an older daemon's version-mismatch answer
+names its accepted versions (accepted_from_error), so rolling upgrades
+work in both directions without per-field stamping at call sites.
+
 Ops:
-  submit   {folder, options?, tenant?} -> {id, state, queued}
+  submit   {folder, options?, tenant?, trace?} -> {id, state, queued,
+                                       trace}
                                        (tenant: optional fair-queuing
                                        identity -- deficit-round-robin
                                        across tenants with an optional
                                        per-tenant in-flight cap,
                                        SPGEMM_TPU_SERVE_TENANT_INFLIGHT;
                                        absent = the shared "default"
-                                       tenant, exactly the v1 behavior)
+                                       tenant, exactly the v1 behavior.
+                                       trace: optional 128-bit hex trace
+                                       context the client minted -- every
+                                       span/event/journal record of the
+                                       job carries it; absent/v1/v2 = the
+                                       daemon mints one, returned either
+                                       way)
   status   {id}                     -> {job: <snapshot>}
   wait     {id, timeout?}           -> {job: <snapshot>} (blocks until the
                                        job is terminal or timeout elapses;
@@ -46,7 +60,11 @@ Ops:
   events   {n?}                     -> {events: [newest n JSONL records]}
                                        -- the structured event log's ring
                                        (obs/events.py; `spgemm_tpu.cli
-                                       events --tail N`)
+                                       events --tail N [--follow]`)
+  slo      {}                       -> {slo: <SLO engine report>} -- the
+                                       rolling per-tenant objective
+                                       accounts + burn state (obs/slo.py;
+                                       `spgemm_tpu.cli slo [--json]`)
   shutdown {}                       -> {stopping: true}
 
 jax-free by design: the client must be importable (and the protocol
@@ -62,13 +80,57 @@ import tempfile
 
 from spgemm_tpu.utils import knobs
 
-PROTOCOL_VERSION = 2
+PROTOCOL_VERSION = 3
 
 # versions the daemon still speaks: v2 added the optional submit `tenant`
-# field (absent = DEFAULT_TENANT), which a v1 daemon would have rejected
-# as an unknown key had it been an option -- v1 requests parse unchanged,
-# so old clients keep working against a new daemon
-ACCEPTED_VERSIONS = (1, 2)
+# field (absent = DEFAULT_TENANT), v3 the optional submit `trace` field
+# (absent = the daemon mints the trace context) -- v1/v2 requests parse
+# unchanged, so old clients keep working against a new daemon
+ACCEPTED_VERSIONS = (1, 2, 3)
+
+# THE capability table (one per protocol growth, not one ad-hoc stamp
+# per call site): each optional request field -> the lowest protocol
+# version whose daemons understand it.  Clients consult version_for()
+# to stamp the lowest version carrying their request's fields (a
+# still-v2 daemon's strict version check must keep serving an upgraded
+# client that uses no v3 feature), and strip_for_version() to shed
+# too-new fields when a version-mismatch answer forces a downgrade
+# (the daemon then supplies the field's fallback: default tenant,
+# minted trace).
+FIELD_MIN_VERSION = {"tenant": 2, "trace": 3}
+
+
+def version_for(msg: dict) -> int:
+    """The lowest protocol version carrying every optional field in
+    `msg` (1 when none rides) -- the one negotiation rule, replacing
+    per-field version stamping at call sites."""
+    return max([1, *(v for field, v in FIELD_MIN_VERSION.items()
+                     if msg.get(field) is not None)])
+
+
+def strip_for_version(msg: dict, version: int) -> dict:
+    """`msg` without the fields a v<=`version` daemon would not
+    understand (the rolling-downgrade half of the capability table)."""
+    return {k: v for k, v in msg.items()
+            if FIELD_MIN_VERSION.get(k, 1) <= version}
+
+
+def accepted_from_error(message: str) -> tuple[int, ...]:
+    """Parse the daemon's accepted versions out of its version-mismatch
+    error message (`protocol version mismatch: ... (accepts v1/v2) ...`
+    -- the stable wording every daemon generation has used); empty when
+    the message is not a version-mismatch answer.  ANCHORED to the
+    message prefix on purpose: other bad-request answers echo
+    client-supplied values verbatim (a tenant/trace of literally
+    `accepts v1/v2`), and a spoofed match would downgrade-and-strip a
+    field the daemon explicitly rejected -- the client must hear that
+    rejection, not silently retry without the field."""
+    if not message.startswith("protocol version mismatch"):
+        return ()
+    m = re.search(r"accepts ((?:v\d+/?)+)", message)
+    if not m:
+        return ()
+    return tuple(int(part[1:]) for part in m.group(1).split("/") if part)
 
 # the tenant every v1 (or tenant-less v2) submit maps to
 DEFAULT_TENANT = "default"
@@ -78,7 +140,7 @@ DEFAULT_TENANT = "default"
 TENANT_MAX_LEN = 64
 
 OPS = ("submit", "status", "wait", "stats", "metrics", "trace", "profile",
-       "events", "shutdown")
+       "events", "slo", "shutdown")
 
 # server-side bound on one request line: a peer streaming newline-free
 # bytes must exhaust THIS, not the daemon's memory (real requests are a
@@ -120,6 +182,26 @@ def valid_tenant(tenant) -> bool:
     """True iff `tenant` is an acceptable wire tenant name."""
     return (isinstance(tenant, str) and 0 < len(tenant) <= TENANT_MAX_LEN
             and _TENANT_RE.match(tenant) is not None)
+
+
+# 128-bit trace context, lowercase hex (protocol v3 submit field): the
+# client mints it, every span/event/journal record of the job carries
+# it, and `cli trace-dump --merge` stitches per-process dumps on it
+TRACE_HEX_LEN = 32
+_TRACE_RE = re.compile(r"^[0-9a-f]{32}$")
+
+
+def valid_trace(trace) -> bool:
+    """True iff `trace` is a well-formed wire trace context."""
+    return (isinstance(trace, str)
+            and _TRACE_RE.match(trace) is not None)
+
+
+def mint_trace() -> str:
+    """A fresh 128-bit trace context (client-side at submit; the daemon
+    falls back to minting for v1/v2 submits and journal replays of
+    pre-v3 records)."""
+    return os.urandom(TRACE_HEX_LEN // 2).hex()
 
 
 class ProtocolError(Exception):

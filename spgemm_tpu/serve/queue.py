@@ -86,12 +86,18 @@ class Job:
 
     def __init__(self, job_id: str, folder: str, output: str,
                  options: dict, timeout_s: float = 0.0,
-                 tenant: str = protocol.DEFAULT_TENANT):
+                 tenant: str = protocol.DEFAULT_TENANT,
+                 trace_id: str | None = None):
         self.id = job_id
         self.folder = folder
         self.output = output
         self.options = options
         self.tenant = tenant
+        # the end-to-end trace context (protocol v3): client-minted when
+        # the submit carried one, else minted here -- every span/event/
+        # journal record of this job carries it, and the merge tool
+        # (cli trace-dump --merge) stitches per-process dumps on it
+        self.trace_id = trace_id or protocol.mint_trace()
         self.timeout_s = timeout_s  # 0 = no deadline
         self.state = "queued"                   # spgemm-lint: guarded-by(_lock)
         self.error: dict | None = None          # spgemm-lint: guarded-by(_lock)
@@ -185,6 +191,7 @@ class Job:
                 "output": self.output,
                 "options": dict(self.options),
                 "tenant": self.tenant,
+                "trace": self.trace_id,
                 "state": self.state,
                 "error": self.error,
                 "detail": dict(self.detail),
@@ -231,6 +238,10 @@ class JobQueue:
         self._queued = 0                   # spgemm-lint: guarded-by(_lock)
         self._inflight: dict[str, int] = {}  # spgemm-lint: guarded-by(_lock)
         self._served: dict[str, int] = {}  # spgemm-lint: guarded-by(_lock)
+        # newest submit wall-clock per live tenant: the recency key the
+        # daemon's scrape-label cap (top-K + `other`) sorts on; retired
+        # with the tenant's other per-tenant state in release()
+        self._last_seen: dict[str, float] = {}  # spgemm-lint: guarded-by(_lock)
         self._jobs: dict[str, Job] = {}    # spgemm-lint: guarded-by(_lock)
         self._lock = threading.Lock()
         self._avail = threading.Condition(self._lock)
@@ -267,6 +278,7 @@ class JobQueue:
             self._queued += 1
             self._inflight[job.tenant] = \
                 self._inflight.get(job.tenant, 0) + 1
+            self._last_seen[job.tenant] = time.time()
             # release() frees an in-flight slot only for jobs that took
             # one: a job whose submit RAISED (queue-full / tenant-cap)
             # may still be finished + observed by the caller, and must
@@ -338,6 +350,7 @@ class JobQueue:
                 if job.tenant in self._rr:
                     self._rr.remove(job.tenant)
                 self._served.pop(job.tenant, None)
+                self._last_seen.pop(job.tenant, None)
 
     def get(self, job_id: str) -> Job | None:
         with self._lock:
@@ -371,5 +384,6 @@ class JobQueue:
                 | set(self._served)
             return {t: {"queued": len(self._queues.get(t, ())),
                         "inflight": self._inflight.get(t, 0),
-                        "served": self._served.get(t, 0)}
+                        "served": self._served.get(t, 0),
+                        "last_seen": self._last_seen.get(t, 0.0)}
                     for t in sorted(names)}

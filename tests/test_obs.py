@@ -219,6 +219,188 @@ def test_obs_import_and_use_is_jax_free():
     assert rc.returncode == 0, rc.stderr[-2000:]
 
 
+# ------------------------------------------------------ trace stitching --
+def test_merge_trace_files_round_trips_two_subprocesses(tmp_path):
+    """Satellite: ring dumps from two REAL processes stitch into one
+    Perfetto array -- distinct labeled process tracks, the internal
+    clock anchors consumed, and --trace filtering down to one trace
+    context keeps both processes' contributions."""
+    code = (
+        "import sys\n"
+        "from spgemm_tpu.obs import trace\n"
+        "from spgemm_tpu.utils.timers import PhaseTimers\n"
+        "t = PhaseTimers()\n"
+        "with trace.RECORDER.tagged(trace_id=sys.argv[2]):\n"
+        "    with t.phase('plan'):\n"
+        "        pass\n"
+        "with trace.RECORDER.tagged(trace_id='f' * 32):\n"
+        "    t.record('assembly', 0.25)\n"
+        "trace.dump_json(sys.argv[1], process_name=sys.argv[3])\n")
+    tid = "ab" * 16
+    paths = []
+    for i in (1, 2):
+        path = str(tmp_path / f"p{i}.trace.json")
+        rc = subprocess.run(
+            [sys.executable, "-c", code, path, tid, f"proc{i}"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert rc.returncode == 0, rc.stderr[-2000:]
+        paths.append(path)
+    merged = trace.merge_trace_files(paths)
+    spans = [ev for ev in merged if ev["ph"] != "M"]
+    pids = {ev["pid"] for ev in spans}
+    assert len(pids) == 2
+    proc_names = {ev["args"]["name"] for ev in merged
+                  if ev["ph"] == "M" and ev["name"] == "process_name"}
+    assert proc_names == {"proc1", "proc2"}
+    assert not any(ev["name"] == trace.CLOCK_ORIGIN_META for ev in merged)
+    # filter to one trace context: only its spans survive, and BOTH
+    # processes' tracks are retained (the end-to-end flame view)
+    only = trace.merge_trace_files(paths, trace_id=tid)
+    fspans = [ev for ev in only if ev["ph"] != "M"]
+    assert fspans and all(ev["args"]["trace_id"] == tid for ev in fspans)
+    assert {ev["pid"] for ev in fspans} == pids
+    assert {ev["name"] for ev in fspans} == {"plan"}
+
+
+def test_merge_remaps_colliding_pids(tmp_path):
+    """Two dumps from one process (same pid) must stitch as two DISTINCT
+    process tracks, not interleave into one."""
+    t = PhaseTimers()
+    with t.phase("plan"):
+        pass
+    p1 = trace.dump_json(str(tmp_path / "a.trace.json"))
+    p2 = trace.dump_json(str(tmp_path / "b.trace.json"))
+    merged = trace.merge_trace_files([p1, p2])
+    pids = {ev["pid"] for ev in merged}
+    assert len(pids) == 2
+
+
+def test_merge_aligns_timelines_on_wall_anchor(tmp_path):
+    """Per-process span timestamps sit on per-process monotonic origins;
+    the merge shifts every file onto the earliest wall-clock anchor's
+    axis so cross-process ordering is correct in the viewer."""
+    def dump(path, pid, origin_us, name):
+        events = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": name}},
+            {"name": trace.CLOCK_ORIGIN_META, "ph": "M", "pid": pid,
+             "tid": 0, "args": {"wall_origin_us": origin_us}},
+            {"name": name, "cat": "spgemm", "ph": "X", "ts": 5.0,
+             "dur": 1.0, "pid": pid, "tid": 1, "args": {}},
+        ]
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(events, f)
+        return str(path)
+    pa = dump(tmp_path / "a.json", 1, 1000.0, "early")
+    pb = dump(tmp_path / "b.json", 2, 31000.0, "late")
+    merged = trace.merge_trace_files([pa, pb])
+    ts = {ev["name"]: ev["ts"] for ev in merged if ev["ph"] == "X"}
+    assert ts["early"] == 5.0          # the earliest anchor is the axis
+    assert ts["late"] == 30005.0       # shifted by the anchor delta
+    # merged spans come out time-ordered on the shared axis
+    spans = [ev for ev in merged if ev["ph"] == "X"]
+    assert [ev["name"] for ev in spans] == ["early", "late"]
+
+
+# ------------------------------------------------------- events --follow --
+def test_follow_file_streams_and_survives_rotation(tmp_path):
+    """Satellite: the --follow engine polls the rotating JSONL and a
+    rotation boundary neither drops nor duplicates a record (seq-deduped,
+    the old file's tail is drained from <path>.1)."""
+    from spgemm_tpu.obs import events as obs_events
+
+    path = str(tmp_path / "e.jsonl")
+
+    def write(recs, p=path):
+        with open(p, "a", encoding="utf-8") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    write([{"seq": i, "kind": "a"} for i in range(1, 4)])
+    gen = obs_events.follow_file(path, last_seq=0, poll_s=0.01)
+    assert [next(gen)["seq"] for _ in range(3)] == [1, 2, 3]
+    # two records land in the old file, THEN it rotates and a new one
+    # starts fresh: the follow must yield 4, 5 (from .1's tail) then 6
+    write([{"seq": 4, "kind": "a"}, {"seq": 5, "kind": "a"}])
+    __import__("os").replace(path, path + ".1")
+    write([{"seq": 6, "kind": "a"}])
+    assert [next(gen)["seq"] for _ in range(3)] == [4, 5, 6]
+
+
+def test_follow_file_survives_daemon_restart_seq_reset(tmp_path):
+    """A restarted daemon appends to the SAME file but resets its seq
+    counter at 1: dedup is on (ts, seq), so a seq regression with a
+    newer wall timestamp is a new generation, never a duplicate to
+    swallow."""
+    from spgemm_tpu.obs import events as obs_events
+
+    path = str(tmp_path / "e.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(1, 4):
+            f.write(json.dumps({"seq": i, "ts": 1000.0 + i}) + "\n")
+    gen = obs_events.follow_file(path, last_seq=0, poll_s=0.01)
+    assert [next(gen)["seq"] for _ in range(3)] == [1, 2, 3]
+    # daemon restart: seq resets to 1, wall clock moved on
+    with open(path, "a", encoding="utf-8") as f:
+        for i in range(1, 3):
+            f.write(json.dumps({"seq": i, "ts": 2000.0 + i}) + "\n")
+    got = [next(gen) for _ in range(2)]
+    assert [r["seq"] for r in got] == [1, 2]
+    assert all(r["ts"] > 2000.0 for r in got)
+
+
+def test_follow_file_detects_rotation_by_inode(tmp_path):
+    """A burst can rotate AND grow the fresh file past the old read
+    offset within one poll -- rotation must be detected by inode
+    change, not just file shrinkage, or both gaps' records drop."""
+    from spgemm_tpu.obs import events as obs_events
+
+    path = str(tmp_path / "e.jsonl")
+
+    def write(recs, p=path):
+        with open(p, "a", encoding="utf-8") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+
+    write([{"seq": 1, "kind": "a"}])
+    gen = obs_events.follow_file(path, last_seq=0, poll_s=0.01)
+    assert next(gen)["seq"] == 1
+    # records 2-3 land, the file rotates, and the NEW file grows PAST
+    # the follower's old offset before the next poll
+    write([{"seq": 2, "kind": "a"}, {"seq": 3, "kind": "a"}])
+    __import__("os").replace(path, path + ".1")
+    write([{"seq": 4, "kind": "a", "pad": "x" * 200},
+           {"seq": 5, "kind": "a"}])
+    assert [next(gen)["seq"] for _ in range(4)] == [2, 3, 4, 5]
+
+
+def test_follow_file_last_seq_skips_already_printed(tmp_path):
+    from spgemm_tpu.obs import events as obs_events
+
+    path = str(tmp_path / "e.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        for i in range(1, 6):
+            f.write(json.dumps({"seq": i}) + "\n")
+    gen = obs_events.follow_file(path, last_seq=3, poll_s=0.01)
+    assert next(gen)["seq"] == 4 and next(gen)["seq"] == 5
+
+
+def test_read_records_leaves_partial_tail_for_next_poll(tmp_path):
+    from spgemm_tpu.obs.events import _read_records
+
+    path = str(tmp_path / "e.jsonl")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(json.dumps({"seq": 1}) + "\n")
+        f.write('{"seq": 2')  # torn mid-write: no newline yet
+    off, recs = _read_records(path, 0)
+    assert [r["seq"] for r in recs] == [1]
+    with open(path, "a", encoding="utf-8") as f:
+        f.write(', "kind": "x"}\n')
+    off2, recs2 = _read_records(path, off)
+    assert [r["seq"] for r in recs2] == [2]
+    assert off2 > off
+
+
 # ------------------------------------------------- attribution threading --
 def test_attribution_token_carries_scope_and_tags_to_worker():
     """The worker-thread contract (chain plan-ahead, OOC staging): a

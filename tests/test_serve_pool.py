@@ -222,12 +222,43 @@ def test_protocol_v1_requests_still_served(make_daemon):
     assert resp["ok"] is True and resp["daemon"] == "spgemmd"
 
 
-def test_client_requests_stay_v1_unless_tenant_used(tmp_path, make_daemon,
-                                                    monkeypatch):
-    """Rolling-upgrade compatibility the other way: the upgraded client
-    stamps v1 on every request that carries no v2 feature (a still-v1
-    daemon's strict version check would reject a blanket v2 stamp), and
-    bumps to v2 exactly when a tenant rides along."""
+def test_version_for_is_the_capability_table():
+    """Satellite: ONE negotiation rule (protocol.version_for over the
+    FIELD_MIN_VERSION capability table) replaces per-field stamping --
+    the lowest version carrying the request's optional fields."""
+    assert protocol.version_for({"op": "stats"}) == 1
+    assert protocol.version_for({"op": "submit", "folder": "f"}) == 1
+    assert protocol.version_for({"op": "submit", "tenant": "a"}) == 2
+    assert protocol.version_for({"op": "submit",
+                                 "trace": "ab" * 16}) == 3
+    assert protocol.version_for({"op": "submit", "tenant": "a",
+                                 "trace": "ab" * 16}) == 3
+    # the downgrade half: stripping sheds exactly the too-new fields
+    msg = {"op": "submit", "folder": "f", "tenant": "a",
+           "trace": "ab" * 16}
+    assert protocol.strip_for_version(msg, 2) == {
+        "op": "submit", "folder": "f", "tenant": "a"}
+    assert protocol.strip_for_version(msg, 1) == {
+        "op": "submit", "folder": "f"}
+    # the daemon's version-mismatch wording parses back to its versions
+    assert protocol.accepted_from_error(
+        "protocol version mismatch: daemon speaks v2 (accepts v1/v2), "
+        "request carries v=3") == (1, 2)
+    assert protocol.accepted_from_error("something else") == ()
+    # ANCHORED: a bad-request that merely ECHOES client data containing
+    # the accepts wording (e.g. a trace of literally `accepts v1/v2`)
+    # must not read as a version mismatch -- a spoofed match would
+    # silently strip-and-retry a field the daemon explicitly rejected
+    assert protocol.accepted_from_error(
+        "trace must be 32 lowercase hex chars (a 128-bit trace "
+        "context), got 'accepts v1/v2'") == ()
+
+
+def test_client_stamps_lowest_version_for_fields(tmp_path, make_daemon,
+                                                 monkeypatch):
+    """The upgraded client stamps v1 on a featureless request and the
+    capability-table version exactly when a versioned field rides along
+    (submit always carries the client-minted v3 trace context)."""
     folder, _ = _chain_folder(tmp_path)
     d = make_daemon(runner=lambda job, degraded=False: None)
     sent = []
@@ -239,10 +270,37 @@ def test_client_requests_stay_v1_unless_tenant_used(tmp_path, make_daemon,
     client.stats(d.socket_path)
     client.submit(folder, d.socket_path)
     reqs = [m for m in sent if "op" in m]
-    assert [m["v"] for m in reqs] == [1, 1]
-    client.submit(folder, d.socket_path, tenant="alice")
-    reqs = [m for m in sent if "op" in m]
-    assert reqs[-1]["v"] == protocol.PROTOCOL_VERSION
+    assert [m["v"] for m in reqs] == [1, 3]
+    assert protocol.valid_trace(reqs[-1]["trace"])
+
+
+def test_client_downgrades_against_older_daemon(tmp_path, make_daemon,
+                                                monkeypatch):
+    """Rolling upgrade, new-client-vs-old-daemon direction: the older
+    daemon's version-mismatch answer names what it accepts, and the
+    client retries ONCE at the best mutually-spoken version with the
+    too-new fields stripped -- the daemon then supplies the fallback
+    (it mints the trace the stripped request no longer carries)."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    # simulate a v2-era daemon: its strict version gate rejects v3
+    monkeypatch.setattr(protocol, "ACCEPTED_VERSIONS", (1, 2))
+    sent = []
+    real_encode = protocol.encode
+    monkeypatch.setattr(client.protocol, "encode",
+                        lambda msg: sent.append(msg) or real_encode(msg))
+    resp = client.submit(folder, d.socket_path, tenant="alice")
+    reqs = [m for m in sent if m.get("op") == "submit"]
+    assert [m["v"] for m in reqs] == [3, 2]
+    assert "trace" not in reqs[1] and reqs[1]["tenant"] == "alice"
+    assert resp["ok"] and resp["id"]
+    # a genuinely bad request surfaces after the one downgrade retry
+    # (v3 -> version gate -> v2 -> folder check), never a retry loop
+    with pytest.raises(client.ServeError) as ei:
+        client.submit(str(tmp_path / "missing"), d.socket_path)
+    assert ei.value.code == protocol.E_BAD_REQUEST
+    assert "chain input" in ei.value.message
+    assert len([m for m in sent if m.get("op") == "submit"]) == 4
 
 
 def test_accept_claims_slice_under_the_queue_lock(tmp_path, make_daemon):
@@ -557,6 +615,166 @@ def test_client_wait_backs_off_between_slices(tmp_path, make_daemon,
     assert 2 <= len(calls) <= 12
     gaps = [b - a for a, b in zip(calls, calls[1:])]
     assert max(gaps) > 0.15  # the backoff actually grew past the slice
+
+
+# ------------------------------------------------- end-to-end trace ------
+def test_submit_trace_context_threads_through(tmp_path, make_daemon):
+    """The client-minted 128-bit trace context rides the submit, the
+    status snapshot, and every span the job emits -- replacing the
+    job-id-as-trace_id aliasing (the id is one daemon's namespace, the
+    trace crosses processes)."""
+    from spgemm_tpu.obs import trace as obs_trace
+    obs_trace.RECORDER.clear()  # job ids repeat across in-process daemons
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    want = protocol.mint_trace()
+    resp = client.submit(folder, d.socket_path, trace=want)
+    assert resp["trace"] == want
+    final = client.wait(resp["id"], d.socket_path, timeout=30)
+    assert final["job"]["state"] == "done"
+    assert final["job"]["trace"] == want
+    spans = [ev for ev in client.trace(d.socket_path)
+             if (ev.get("args") or {}).get("job_id") == resp["id"]]
+    assert spans
+    assert all(ev["args"]["trace_id"] == want for ev in spans)
+
+
+def test_submit_without_trace_gets_daemon_minted_one(tmp_path,
+                                                     make_daemon):
+    """v1/v2 submits (no trace field) fall back to a daemon-minted
+    context -- the trace is never absent, never the job id."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(10.0)
+        s.connect(d.socket_path)
+        s.sendall(protocol.encode({"v": 2, "op": "submit",
+                                   "folder": folder, "tenant": "legacy"}))
+        resp = json.loads(next(protocol.read_lines(s)))
+    assert resp["ok"] is True
+    assert protocol.valid_trace(resp["trace"])
+    assert resp["trace"] != resp["id"]
+
+
+def test_submit_malformed_trace_is_bad_request(tmp_path, make_daemon):
+    """A client that tried to thread a trace must hear it failed, not
+    silently get a re-mint."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    for bad in ("short", "G" * 32, "AB" * 16, 7):
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(10.0)
+            s.connect(d.socket_path)
+            s.sendall(protocol.encode({"v": 3, "op": "submit",
+                                       "folder": folder, "trace": bad}))
+            resp = json.loads(next(protocol.read_lines(s)))
+        assert resp["ok"] is False
+        assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+
+
+def test_journal_replay_restores_trace_context(tmp_path):
+    """A restarted daemon re-queues a journaled job under its ORIGINAL
+    trace context -- the stitched trace survives the restart."""
+    from spgemm_tpu.serve.daemon import Daemon, journal_frame
+    sock = str(tmp_path / "dj.sock")
+    trace_id = protocol.mint_trace()
+    rec = {"event": "submit", "id": "job-7", "folder": str(tmp_path),
+           "output": str(tmp_path / "o"), "options": {},
+           "timeout_s": 0.0, "tenant": "t", "trace": trace_id}
+    with open(sock + ".journal", "w", encoding="utf-8") as f:
+        f.write(journal_frame(rec))
+    done = threading.Event()
+    seen = {}
+
+    def runner(job, degraded=False):
+        seen["trace"] = job.trace_id
+        done.set()
+
+    d = Daemon(sock, runner=runner)
+    d.start()
+    try:
+        assert done.wait(10), "replayed job never ran"
+        assert seen["trace"] == trace_id
+    finally:
+        d.stop()
+
+
+def test_pool_trace_dump_carries_per_slice_tracks(tmp_path, make_daemon):
+    """Satellite: a 2-slice daemon's Perfetto export names each slice
+    executor's thread (thread_name metadata tracks) and the two slices'
+    job span sets are DISJOINT -- concurrent jobs never bleed spans
+    across slices."""
+    from spgemm_tpu.obs import trace as obs_trace
+    obs_trace.RECORDER.clear()  # job ids repeat across in-process daemons
+    folder, _ = _chain_folder(tmp_path)
+    started, release = [], threading.Event()
+
+    def runner(job, degraded=False):
+        started.append(job.id)
+        release.wait(30)
+
+    d = make_daemon(runner=runner, slices="2", n_devices=2)
+    try:
+        ids = [client.submit(folder, d.socket_path)["id"]
+               for _ in range(2)]
+        _wait_until(lambda: len(started) == 2,
+                    msg="both jobs running on their slices")
+    finally:
+        release.set()
+    for jid in ids:
+        resp = client.wait(jid, d.socket_path, timeout=30)
+        assert resp["job"]["state"] == "done"
+    events = client.trace(d.socket_path)
+    thread_names = {ev["args"]["name"] for ev in events
+                    if ev.get("ph") == "M"
+                    and ev["name"] == "thread_name"}
+    assert any("spgemmd-executor-s0w1" in n for n in thread_names)
+    assert any("spgemmd-executor-s1w1" in n for n in thread_names)
+    assert any(ev.get("ph") == "M" and ev["name"] == "process_name"
+               for ev in events)
+    by_slice: dict = {}
+    for ev in events:
+        args = ev.get("args") or {}
+        if args.get("slice") and args.get("job_id"):
+            by_slice.setdefault(args["slice"], set()).add(args["job_id"])
+    assert set(by_slice) == {"s0w1", "s1w1"}
+    jobs_a, jobs_b = by_slice["s0w1"], by_slice["s1w1"]
+    assert jobs_a and jobs_b and jobs_a.isdisjoint(jobs_b)
+    assert jobs_a | jobs_b == set(ids)
+
+
+def test_tenant_label_cardinality_capped_on_scrape(tmp_path, make_daemon,
+                                                   monkeypatch):
+    """Satellite: a tenant-id-per-request client cannot grow the scrape
+    without bound -- past the top-K-by-recency cap the remaining
+    tenants' queue depths aggregate into one `other` row."""
+    from spgemm_tpu.obs import slo as obs_slo
+    monkeypatch.setattr(obs_slo, "TENANT_RETAIN", 3)
+    folder, _ = _chain_folder(tmp_path)
+    release = threading.Event()
+
+    def runner(job, degraded=False):
+        release.wait(30)
+
+    d = make_daemon(runner=runner)
+    try:
+        for i in range(6):
+            client.submit(folder, d.socket_path, tenant=f"t{i}")
+        _wait_until(lambda: any(s.current for s in d.slices),
+                    msg="first job picked up")
+        text = client.metrics(d.socket_path)
+        rows = [line for line in text.splitlines()
+                if line.startswith("spgemmd_tenant_queue_depth{")]
+        assert len(rows) <= 4  # top 3 by recency + the `other` aggregate
+        assert any('tenant="other"' in line for line in rows)
+        # nothing is dropped, only aggregated: depths still sum to the
+        # queued total (6 submitted, 1 running)
+        total = sum(float(line.rsplit(" ", 1)[1]) for line in rows)
+        assert total == 5.0
+        # the newest tenants keep their own labels
+        assert any('tenant="t5"' in line for line in rows)
+    finally:
+        release.set()
 
 
 # ------------------------------------------------ real-engine pool proof --

@@ -1,0 +1,225 @@
+"""SLO engine (obs/slo.py): fixed-bucket quantile digests, rolling-window
+age-out, multi-window burn-rate transitions (slo_burn / slo_burn_clear
+events), accounting-only mode, the tenant-cardinality bound, scrape-family
+rendering, and the SPGEMM_TPU_OBS_TRACE inertness contract."""
+
+import pytest
+
+from spgemm_tpu.obs import events, metrics, slo
+
+
+@pytest.fixture
+def engine():
+    return slo.SloEngine()
+
+
+@pytest.fixture(autouse=True)
+def clean_event_log():
+    events.LOG.clear()
+    yield
+    events.LOG.clear()
+
+
+def _arm(monkeypatch, target="1", error_pct="10", window="120"):
+    monkeypatch.setenv("SPGEMM_TPU_SLO_TARGET_S", target)
+    monkeypatch.setenv("SPGEMM_TPU_SLO_ERROR_PCT", error_pct)
+    monkeypatch.setenv("SPGEMM_TPU_SLO_WINDOW_S", window)
+
+
+def _burn_kinds():
+    return [r["kind"] for r in events.LOG.tail(100)
+            if r["kind"].startswith("slo_burn")]
+
+
+# ------------------------------------------------------------ quantiles --
+def test_quantiles_from_fixed_bucket_digest(engine):
+    """p50/p95/p99 come from the digest's bucket bounds, never a sample
+    list: a bimodal 50/50 mix reports the low mode's bound at p50 and
+    the high mode's at p95/p99."""
+    for i in range(50):
+        engine.observe("t", "s0", 0.02, 0.0, False, now=1000.0 + i)
+    for i in range(50):
+        engine.observe("t", "s0", 3.0, 0.0, False, now=1050.0 + i)
+    row = engine.report(now=1100.0)["tenants"]["t"]
+    assert row["jobs"] == 100 and row["errors"] == 0
+    lat = row["latency_s"]
+    assert lat["p50"] == 0.025   # first bound covering the low mode
+    assert lat["p95"] == 5.0     # first bound covering the high mode
+    assert lat["p99"] == 5.0
+    assert row["error_ratio"] == 0.0
+
+
+def test_queue_wait_share_and_slice_merge(engine):
+    """Per-tenant accounts merge the tenant's slices (digests add);
+    queue-wait share is queued / (queued + execute) seconds."""
+    engine.observe("t", "s0", 0.9, 0.1, False, now=10.0)
+    engine.observe("t", "s1", 0.9, 0.1, False, now=11.0)
+    rep = engine.report(now=12.0)
+    row = rep["tenants"]["t"]
+    assert row["jobs"] == 2
+    assert row["queue_wait_share"] == pytest.approx(0.1)
+    # both (tenant, slice) windows exist for burn accounting
+    assert {(b["tenant"], b["slice"]) for b in rep["burn"]} == \
+        {("t", "s0"), ("t", "s1")}
+
+
+def test_window_ages_out_records(engine, monkeypatch):
+    _arm(monkeypatch, window="100")
+    engine.observe("t", "s0", 0.1, 0.0, False, now=0.0)
+    engine.observe("t", "s0", 0.1, 0.0, False, now=99.0)
+    assert engine.report(now=99.5)["tenants"]["t"]["jobs"] == 2
+    # past the window the old record ages out; past both, the tenant
+    # row disappears (no in-window records)
+    assert engine.report(now=150.0)["tenants"]["t"]["jobs"] == 1
+    assert "t" not in engine.report(now=500.0)["tenants"]
+
+
+# ------------------------------------------------------------ burn rate --
+def test_burn_activates_and_emits_event_with_trace(engine, monkeypatch):
+    """The acceptance shape: bad fraction over budget in BOTH windows
+    flips the burn state once and emits one slo_burn event carrying the
+    newest bad record's trace context."""
+    _arm(monkeypatch, error_pct="10", window="120")
+    for i in range(8):
+        engine.observe("t", "s0", 0.1, 0.0, False, now=1000.0 + i)
+    engine.observe("t", "s0", 0.1, 0.0, True, trace_id="aa" * 16,
+                   now=1008.0)
+    engine.observe("t", "s0", 0.1, 0.0, True, trace_id="bb" * 16,
+                   now=1009.0)
+    rep = engine.report(now=1010.0)
+    (burn,) = rep["burn"]
+    assert burn["active"] is True
+    assert burn["trace_id"] == "bb" * 16   # the NEWEST bad record
+    assert burn["bad"] == 2 and burn["jobs"] == 10
+    # bad_frac 0.2 over a 0.1 budget = burn 2.0 in both windows
+    assert burn["slow_burn"] == pytest.approx(2.0)
+    assert burn["fast_burn"] == pytest.approx(2.0)
+    recs = [r for r in events.LOG.tail(100) if r["kind"] == "slo_burn"]
+    assert len(recs) == 1   # a transition, not one event per record
+    assert recs[0]["tenant"] == "t" and recs[0]["slice"] == "s0"
+    # the event fired at the record that CROSSED the budget (the first
+    # bad job: 1/9 > 10%), carrying that record's trace; the live burn
+    # detail above tracks the newest bad record as the window rolls
+    assert recs[0]["trace_id"] == "aa" * 16
+    assert rep["burn_active"] == 1
+
+
+def test_burn_clears_when_bad_records_age_out(engine, monkeypatch):
+    _arm(monkeypatch, error_pct="10", window="100")
+    engine.observe("t", "s0", 0.1, 0.0, True, trace_id="aa" * 16,
+                   now=1000.0)
+    assert engine.report(now=1001.0)["burn"][0]["active"] is True
+    # the bad record ages out of the window: the burn clears and the
+    # clear is an event (alert lifecycle, not a sticky flag)
+    assert engine.report(now=1200.0)["burn"][0]["active"] is False
+    assert _burn_kinds() == ["slo_burn", "slo_burn_clear"]
+
+
+def test_fast_window_gates_stale_burns(engine, monkeypatch):
+    """The multi-window AND: old bad events alone (outside the fast
+    window) must not page -- the budget is burning only if it is
+    burning NOW too."""
+    _arm(monkeypatch, error_pct="10", window="120")  # fast window: 10 s
+    engine.observe("t", "s0", 0.1, 0.0, True, now=1000.0)
+    engine.observe("t", "s0", 0.1, 0.0, True, now=1001.0)
+    for i in range(3):
+        # recent good records: the fast window sees only these
+        engine.observe("t", "s0", 0.1, 0.0, False, now=1100.0 + i)
+    (burn,) = engine.report(now=1103.0)["burn"]
+    assert burn["active"] is False
+    assert burn["slow_burn"] >= 1.0 and burn["fast_burn"] == 0.0
+    # the bad-only spike at t=1000 burned (both windows agreed then);
+    # once the fast window runs clean the burn must CLEAR even though
+    # the slow window is still over budget
+    assert _burn_kinds()[-1] == "slo_burn_clear"
+
+
+def test_latency_target_makes_slow_jobs_bad(engine, monkeypatch):
+    """A job slower than SPGEMM_TPU_SLO_TARGET_S burns budget without
+    any error flag -- the latency objective IS an objective."""
+    _arm(monkeypatch, target="1", error_pct="10", window="120")
+    engine.observe("t", "s0", 5.0, 0.0, False, trace_id="cc" * 16,
+                   now=1000.0)
+    (burn,) = engine.report(now=1001.0)["burn"]
+    assert burn["active"] is True and burn["trace_id"] == "cc" * 16
+
+
+def test_unset_objectives_mean_accounting_only(engine, monkeypatch):
+    monkeypatch.delenv("SPGEMM_TPU_SLO_TARGET_S", raising=False)
+    for i in range(5):
+        engine.observe("t", "s0", 30.0, 0.0, True, now=1000.0 + i)
+    rep = engine.report(now=1005.0)
+    assert rep["objectives"]["enabled"] is False
+    # the accounting still renders...
+    assert rep["tenants"]["t"]["error_ratio"] == 1.0
+    # ...but nothing ever burns and no alert event fires
+    assert all(not b["active"] for b in rep["burn"])
+    assert _burn_kinds() == []
+
+
+# ------------------------------------------------------ cardinality bound --
+def test_tenant_eviction_is_topk_by_recency_and_counted(engine,
+                                                        monkeypatch):
+    monkeypatch.setattr(slo, "TENANT_RETAIN", 3)
+    for i in range(6):
+        engine.observe(f"t{i}", "s0", 0.1, 0.0, False, now=1000.0 + i)
+    rep = engine.report(now=1010.0)
+    assert set(rep["tenants"]) == {"t3", "t4", "t5"}  # newest keep
+    assert rep["tenants_evicted"] == 3
+    # a re-seen tenant is recency-bumped, not re-evicted
+    engine.observe("t3", "s0", 0.1, 0.0, False, now=1011.0)
+    engine.observe("t9", "s0", 0.1, 0.0, False, now=1012.0)
+    rep = engine.report(now=1013.0)
+    assert "t3" in rep["tenants"] and "t4" not in rep["tenants"]
+    # the scrape stays bounded with it
+    labels = {lbl["tenant"] for fam, lbl, _v in engine.samples(now=1013.0)
+              if fam == "spgemm_slo_error_ratio"}
+    assert len(labels) <= 3
+
+
+def test_evicting_a_burning_tenant_clears_its_alert(engine, monkeypatch):
+    """An alert consumer pairs slo_burn with slo_burn_clear: eviction of
+    a tenant whose window is actively burning must close the lifecycle,
+    never leave a phantom open alert."""
+    monkeypatch.setattr(slo, "TENANT_RETAIN", 2)
+    _arm(monkeypatch, error_pct="10", window="120")
+    engine.observe("a", "s0", 0.1, 0.0, True, trace_id="aa" * 16,
+                   now=1000.0)  # tenant a burns
+    assert _burn_kinds() == ["slo_burn"]
+    engine.observe("b", "s0", 0.1, 0.0, False, now=1001.0)
+    engine.observe("c", "s0", 0.1, 0.0, False, now=1002.0)  # evicts a
+    assert _burn_kinds() == ["slo_burn", "slo_burn_clear"]
+    recs = [r for r in events.LOG.tail(100)
+            if r["kind"] == "slo_burn_clear"]
+    assert recs[0]["tenant"] == "a"
+    assert recs[0]["reason"] == "tenant-evicted"
+    assert engine.report(now=1003.0)["tenants_evicted"] == 1
+
+
+def test_record_ring_is_bounded(engine, monkeypatch):
+    monkeypatch.setattr(slo, "RECORD_RETAIN", 16)
+    for i in range(100):
+        engine.observe("t", "s0", 0.1, 0.0, False, now=1000.0 + i * 1e-3)
+    assert engine.report(now=1001.0)["tenants"]["t"]["jobs"] == 16
+
+
+# ------------------------------------------------------------- rendering --
+def test_samples_render_through_the_registry(engine, monkeypatch):
+    _arm(monkeypatch)
+    engine.observe("t", "s0", 0.1, 0.05, True, now=1000.0)
+    text = metrics.render(engine.samples(now=1001.0))
+    assert 'spgemm_slo_latency_seconds{quantile="0.5",tenant="t"}' in text
+    assert 'spgemm_slo_error_ratio{tenant="t"} 1' in text
+    assert 'spgemm_slo_queue_wait_share{tenant="t"}' in text
+    assert 'spgemm_slo_burn_active{slice="s0",tenant="t"} 1' in text
+    assert "spgemm_slo_tenants_evicted_total 0" in text
+
+
+# ------------------------------------------------------------- inertness --
+def test_master_knob_zero_makes_engine_inert(engine, monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_OBS_TRACE", "0")
+    _arm(monkeypatch)
+    engine.observe("t", "s0", 99.0, 0.0, True, now=1000.0)
+    rep = engine.report(now=1001.0)
+    assert rep["records"] == 0 and rep["tenants"] == {}
+    assert rep["burn"] == [] and _burn_kinds() == []
