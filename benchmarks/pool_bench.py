@@ -23,6 +23,18 @@ convention) -- unless --check, which exits nonzero when parity fails or
 the speedup misses --target (default 3x; meaningful only on hosts with
 enough cores to actually overlap the slices -- `detail.core_limited`
 flags captures where the host, not the scheduler, is the ceiling).
+
+--queue-depth-sweep is the cross-job batching acceptance mode instead:
+same-structure submits at queue depths 1/4/16 to a SINGLE-slice daemon,
+a batched leg (SPGEMM_TPU_SERVE_BATCH_WINDOW_S armed, the executor
+fuses the queue into mega-launches) against the window=0 A/B leg
+(pre-batch behavior), both with the structure book primed (steady
+state: the structure has been served before, so admission stamps the
+group key).  Reported per depth: makespan, jobs/min, serve_batches /
+serve_batched_jobs counters, speedup; every output in BOTH legs is
+byte-compared against the host oracle (co-batching must never change
+bits).  --check gates parity everywhere plus the deepest depth's
+speedup at --batch-target (default 2x).
 """
 
 from __future__ import annotations
@@ -81,20 +93,45 @@ def run_leg(cfg: dict) -> int:
         mats = io_text.read_chain(folder, 0, n - 1, k)
         placement.note_mass(
             folder, estimate.chain_mass([m.coords for m in mats]))
+        if cfg.get("prime_structure"):
+            # batching steady state: the structure has been SERVED before
+            # (a first contact always runs solo to record it), so admission
+            # stamps the group key and the executor may co-batch
+            from spgemm_tpu.ops import plancache  # noqa: PLC0415
+            plancache.note_chain_structure(
+                placement.signature(folder),
+                plancache.chain_fingerprint([m.coords for m in mats]))
+    jobs_spec = cfg.get("jobs") or [
+        {"folder": f, "output": f + cfg["suffix"]} for f in cfg["folders"]]
     sock = os.path.join(tempfile.mkdtemp(prefix="poolbench-"), "d.sock")
     daemon = Daemon(sock, journal=False, slices=cfg["slices"],
                     n_devices=len(jax.devices()))
     daemon.start()
     try:
+        # untimed warmup submits (sweep legs): the serving steady state
+        # the sweep measures is a WARM daemon -- jit executables compiled,
+        # plan cache hot -- so the timed window compares per-job dispatch
+        # cost, not one leg's cold compile.  The batched leg warms with a
+        # full-depth batch so the fused shape is compiled too.
+        warm_dir = tempfile.mkdtemp(prefix="poolbench-warm-")
+        warm_ids = [client.submit(
+            jobs_spec[0]["folder"], sock,
+            {"output": os.path.join(warm_dir, f"w{i}")})["id"]
+            for i in range(cfg.get("warmup", 0))]
+        for jid in warm_ids:
+            client.wait(jid, sock, timeout=cfg["job_timeout"])
         t0 = time.time()
-        ids = [client.submit(f, sock, {"output": f + cfg["suffix"]})["id"]
-               for f in cfg["folders"]]
+        ids = [client.submit(js["folder"], sock,
+                             {"output": js["output"]})["id"]
+               for js in jobs_spec]
         jobs = []
         for jid in ids:
             resp = client.wait(jid, sock, timeout=cfg["job_timeout"])
             jobs.append(resp["job"])
     finally:
         daemon.stop()
+    from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+    counters = ENGINE.counter_snapshot()
     bad = [j["id"] for j in jobs if j["state"] != "done"]
     if bad:
         print(json.dumps({"error": f"jobs failed: {bad}",
@@ -108,16 +145,119 @@ def run_leg(cfg: dict) -> int:
         "jobs": len(jobs),
         "jobs_per_min": round(len(jobs) / makespan * 60.0, 3)
         if makespan > 0 else None,
+        "serve_batches": counters.get("serve_batches", 0),
+        "serve_batched_jobs": counters.get("serve_batched_jobs", 0),
         "per_job": [{
             "id": j["id"],
             "slice": j["detail"].get("slice"),
             "stolen": j["detail"].get("stolen"),
+            "batch": j.get("batch"),
             "placement": j.get("placement"),
             "queue_wait_s": j["detail"]["phases_s"].get(
                 "serve_queue_wait"),
             "execute_s": j["detail"]["phases_s"].get("serve_execute"),
         } for j in jobs],
     }))
+    return 0
+
+
+def _spawn_leg(cfg: dict, env_overrides: dict) -> dict | None:
+    """Run one daemon leg in a cold child (no inherited jit caches) and
+    return its parsed JSON, or None on failure."""
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--leg", json.dumps(cfg)],
+        capture_output=True, text=True,
+        env={**os.environ, **env_overrides})
+    last = next((ln for ln in reversed(child.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if child.returncode != 0 or last is None:
+        sys.stderr.write(child.stderr[-2000:])
+        return None
+    leg = json.loads(last)
+    return None if "error" in leg else leg
+
+
+def run_sweep(args) -> int:
+    """--queue-depth-sweep: same-structure submits at depths 1/4/16 to a
+    1-slice daemon, batched vs window=0 leg, bit-exact parity both legs."""
+    import numpy as np  # noqa: PLC0415 -- parent stays jax-free
+
+    from spgemm_tpu.utils import io_text  # noqa: PLC0415
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix  # noqa: PLC0415
+    from spgemm_tpu.utils.gen import random_chain  # noqa: PLC0415
+    from spgemm_tpu.utils.semantics import chain_oracle  # noqa: PLC0415
+
+    tmp = tempfile.mkdtemp(prefix="batchsweep-")
+    folder = os.path.join(tmp, "chain")
+    mats = random_chain(args.chain, args.small_dim, args.k, args.density,
+                        np.random.default_rng(11), "full")
+    io_text.write_chain_dir(folder, mats, args.k)
+    want = chain_oracle([m.to_dict() for m in mats], args.k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, args.k, want).prune_zeros())
+
+    depths = [int(d) for d in args.depths.split(",")]
+    per_depth, parity = {}, True
+    for depth in depths:
+        entry = {}
+        for label, env in (
+                ("batched",
+                 {"SPGEMM_TPU_SERVE_BATCH_WINDOW_S": str(args.batch_window),
+                  "SPGEMM_TPU_SERVE_BATCH_K": str(max(depth, 2))}),
+                ("window0", {"SPGEMM_TPU_SERVE_BATCH_WINDOW_S": "0"})):
+            outs = [os.path.join(tmp, f"out.d{depth}.{label}.{i}")
+                    for i in range(depth)]
+            cfg = {"folders": [folder], "slices": "1", "vdev": args.vdev,
+                   "job_timeout": args.job_timeout, "prime_structure": True,
+                   # steady-state warmup: the batched leg needs the FUSED
+                   # shape compiled (a full-depth warm batch), the window=0
+                   # leg the solo shape
+                   "warmup": depth if label == "batched" else 1,
+                   "jobs": [{"folder": folder, "output": o} for o in outs]}
+            leg = _spawn_leg(cfg, env)
+            if leg is None:
+                print(json.dumps({
+                    "metric": "serve_batch_throughput", "value": None,
+                    "unit": "jobs/min", "vs_baseline": None,
+                    "error": f"depth {depth} leg {label} failed"}))
+                return 1 if args.check else 0
+            leg["parity"] = all(
+                open(o, "rb").read() == want_bytes for o in outs)
+            parity = parity and leg["parity"]
+            entry[label] = {k: leg[k] for k in (
+                "makespan_s", "jobs_per_min", "serve_batches",
+                "serve_batched_jobs", "parity")}
+        m0 = entry["window0"]["makespan_s"]
+        mb = entry["batched"]["makespan_s"]
+        entry["speedup"] = round(m0 / mb, 3) if mb else None
+        per_depth[str(depth)] = entry
+
+    deepest = per_depth[str(depths[-1])]
+    speedup = deepest["speedup"]
+    row = {
+        "metric": "serve_batch_throughput",
+        "value": deepest["batched"]["jobs_per_min"],
+        "unit": "jobs/min",
+        "vs_baseline": None,
+        "detail": {
+            "depths": per_depth,
+            "speedup_deepest": speedup,
+            "jobs_per_min_batched": deepest["batched"]["jobs_per_min"],
+            "jobs_per_min_window0": deepest["window0"]["jobs_per_min"],
+            "serve_batches": deepest["batched"]["serve_batches"],
+            "serve_batched_jobs": deepest["batched"]["serve_batched_jobs"],
+            "batch_window_s": args.batch_window,
+            "parity": parity,
+        },
+    }
+    print(json.dumps(row))
+    if args.check and (not parity or speedup is None
+                       or speedup < args.batch_target):
+        print(f"pool_bench: BATCH CHECK FAILED (parity={parity} "
+              f"speedup={speedup} target={args.batch_target})",
+              file=sys.stderr)
+        return 1
     return 0
 
 
@@ -143,10 +283,25 @@ def main() -> int:
                         "speedup reaches --target")
     p.add_argument("--target", type=float, default=3.0,
                    help="--check speedup floor (default 3.0x)")
+    p.add_argument("--queue-depth-sweep", action="store_true",
+                   help="cross-job batching acceptance sweep: "
+                        "same-structure submits at --depths to a 1-slice "
+                        "daemon, batched vs window=0 leg")
+    p.add_argument("--depths", default="1,4,16",
+                   help="comma-joined queue depths for the sweep "
+                        "(default 1,4,16)")
+    p.add_argument("--batch-window", type=float, default=0.25,
+                   help="batched-leg SPGEMM_TPU_SERVE_BATCH_WINDOW_S "
+                        "(default 0.25)")
+    p.add_argument("--batch-target", type=float, default=2.0,
+                   help="--check speedup floor at the deepest sweep depth "
+                        "(default 2.0x)")
     p.add_argument("--leg", default=None, help=argparse.SUPPRESS)
     args = p.parse_args()
     if args.leg:
         return run_leg(json.loads(args.leg))
+    if args.queue_depth_sweep:
+        return run_sweep(args)
 
     import numpy as np  # noqa: PLC0415 -- parent stays jax-free
 

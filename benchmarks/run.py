@@ -509,6 +509,42 @@ def config_pool_scaling():
             "value_parity": det["parity"]}
 
 
+def config_serve_batching():
+    """Cross-job batched dispatch throughput (benchmarks/pool_bench.py
+    --queue-depth-sweep): same-structure submits at queue depths 1/4/16
+    through a single-slice spgemmd, the batched leg (admission window
+    armed, the executor fuses the queue into mega-launches along the
+    round axis) against the window=0 A/B leg, every output bit-exact vs
+    the oracle in both legs.  The row carries the deepest depth's
+    batched jobs/minute plus the speedup over the unbatched daemon --
+    the RESULTS.md view of cross-job batching next to pool scaling."""
+    child = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "pool_bench.py"),
+         "--queue-depth-sweep", "--depths", "1,4,16",
+         "--chain", "3", "--small-dim", "6", "--k", "8"],
+        capture_output=True, text=True, timeout=1800)
+    last = next((ln for ln in reversed(child.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if child.returncode != 0 or last is None:
+        raise RuntimeError(f"pool_bench sweep failed (rc {child.returncode}):"
+                           f" {child.stderr[-500:]}")
+    row = json.loads(last)
+    if "error" in row:
+        raise RuntimeError(f"pool_bench sweep error: {row['error']}")
+    det = row["detail"]
+    deepest = det["depths"][max(det["depths"], key=int)]
+    return {"config": "serve-batching", "backend": "spgemmd-batch",
+            "platform": "cpu",
+            "wall_s": deepest["batched"]["makespan_s"],
+            "jobs": det["serve_batched_jobs"],
+            "jobs_per_min": det["jobs_per_min_batched"],
+            "jobs_per_min_window0": det["jobs_per_min_window0"],
+            "speedup_vs_window0": det["speedup_deepest"],
+            "serve_batches": det["serve_batches"],
+            "batch_window_s": det["batch_window_s"],
+            "value_parity": det["parity"]}
+
+
 CONFIGS = {
     "random-1pct": config_random_1pct,
     "cage12": config_cage12,
@@ -522,6 +558,7 @@ CONFIGS = {
     "ffn": config_ffn,
     "loader-scaling": config_loader_scaling,
     "pool-scaling": config_pool_scaling,
+    "serve-batching": config_serve_batching,
 }
 
 
@@ -639,6 +676,11 @@ def write_table(rows, path=None):
                 if r.get("core_limited"):
                     jobs_col += f", {r.get('host_cores')}-core host"
                 jobs_col += ")"
+            # serve-batching row (pool_bench --queue-depth-sweep): fused
+            # mega-launch throughput vs the window=0 unbatched A/B
+            if r.get("speedup_vs_window0") is not None:
+                jobs_col += (f" ({r['speedup_vs_window0']:g}x vs "
+                             "window=0)")
         lines.append(f"| {r['config']} | {r['backend']} | {r['platform']} | "
                      f"{r['wall_s']} | {gf or ''} | {plan_col} | {jobs_col} "
                      f"| {ratio} | {par} |")

@@ -91,6 +91,13 @@ ENGINE_COUNTERS: dict[str, str] = {
                         "degraded slice whose recovery re-probe "
                         "(SPGEMM_TPU_SERVE_RECOVER_S) came back live "
                         "rejoined placement behind the canary gate",
+    "serve_batches": "spgemmd cross-job fused batches executed: a slice "
+                     "executor drained >= 2 same-structure queued jobs "
+                     "(SPGEMM_TPU_SERVE_BATCH_K / _BATCH_WINDOW_S) and "
+                     "ran them as one fused dispatch per multiply",
+    "serve_batched_jobs": "jobs that rode a cross-job fused batch "
+                          "(the serve_batches counter's member total; "
+                          "solo pickups never count)",
     "warm_hits": "warm-start store hits: a plan or delta entry a "
                  "previous process persisted was deserialized and "
                  "served (ops/warmstore)",
@@ -260,6 +267,13 @@ _METRICS = (
     Metric("spgemmd_job_wall_seconds", "histogram",
            "Per-job wall time start-to-terminal (reaped jobs included).",
            "serve/daemon.py"),
+    Metric("spgemm_serve_batch_size", "histogram",
+           "Jobs per executor pickup while the cross-job batching window "
+           "was armed (SPGEMM_TPU_SERVE_BATCH_WINDOW_S > 0): size 1 = a "
+           "batchable head found no mates inside the window, >= 2 = one "
+           "fused dispatch served the whole batch.  No samples while the "
+           "window is 0 (the pre-batch scrape, byte-identical).",
+           "serve/daemon.py"),
     # ---- deep profiling layer (obs/profile.py, obs/events.py) ----
     Metric("spgemm_compiles_total", "counter",
            "Engine jit compiles recorded per site (obs/profile.ProfiledJit "
@@ -377,6 +391,11 @@ REGISTRY: dict[str, Metric] = {m.name: m for m in _METRICS}
 
 # spgemmd_job_wall_seconds bucket upper bounds (seconds); +Inf implicit
 JOB_WALL_BUCKETS = (0.1, 1.0, 10.0, 60.0, 600.0, 3600.0)
+
+# spgemm_serve_batch_size bucket upper bounds (jobs per armed-window
+# pickup); +Inf implicit -- covers every legal SPGEMM_TPU_SERVE_BATCH_K
+# at power-of-two resolution
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16)
 
 
 # ---------------------------------------------------------- text format --

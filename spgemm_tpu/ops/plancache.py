@@ -52,6 +52,17 @@ _LOCK = threading.Lock()
 _CACHE: "OrderedDict[str, object]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
 _STATS = {"hits": 0, "misses": 0, "evictions": 0}  # spgemm-lint: guarded-by(_LOCK)
 
+# admission-time structure book (the serve batching group key): input
+# stat-signature -> chain structure fingerprint, recorded by the executor
+# the first time a chain is actually read, looked up by the daemon's
+# admission path so the queue can GROUP same-structure jobs without
+# planning (or even reading) anything.  Bounded LRU like the placement
+# price book -- an evicted entry just means the next submit of that
+# folder admits ungrouped (first-contact behavior) until an executor
+# re-records it.
+STRUCT_CAP = 4096
+_STRUCTS: "OrderedDict[str, str]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
+
 
 def enabled() -> bool:
     """SPGEMM_TPU_PLAN_CACHE=0|1 (default 1)."""
@@ -91,6 +102,48 @@ def fingerprint(a_coords: np.ndarray, b_coords: np.ndarray,
         hash_update(h, arr)
     h.update(repr(meta).encode())
     return h.hexdigest()
+
+
+def chain_fingerprint(coords_list) -> str:
+    """Content fingerprint of a whole chain's operand structures (the
+    coords of every matrix, in chain order) -- the serve batching group
+    key's value: two jobs whose chains share this fingerprint walk
+    identical plan sequences (planning is deterministic in structure), so
+    their multiplies can share plans and co-batch dispatches.  Pure
+    structure: values never feed the hash, matching what the plan cache
+    itself keys on."""
+    h = hashlib.sha256()
+    h.update(b"chain|")
+    for coords in coords_list:
+        hash_update(h, np.asarray(coords))
+    return h.hexdigest()
+
+
+def note_chain_structure(sig: str | None, fp: str) -> None:
+    """Record folder stat-signature -> chain structure fingerprint
+    (executor side, right after the chain is read; the signature is
+    serve/placement.signature's, None when the folder was unreadable)."""
+    if sig is None:
+        return
+    with _LOCK:
+        _STRUCTS[sig] = fp
+        _STRUCTS.move_to_end(sig)
+        while len(_STRUCTS) > STRUCT_CAP:
+            _STRUCTS.popitem(last=False)
+
+
+def chain_structure(sig: str | None) -> str | None:
+    """The recorded chain structure fingerprint for a folder signature,
+    or None on first contact / content change / eviction (an ungroupable
+    job simply runs solo -- grouping is an optimization, never a
+    correctness input)."""
+    if sig is None:
+        return None
+    with _LOCK:
+        fp = _STRUCTS.get(sig)
+        if fp is not None:
+            _STRUCTS.move_to_end(sig)
+        return fp
 
 
 def lookup(key: str):
@@ -166,4 +219,5 @@ def clear() -> None:
     """Drop every entry and zero the stats (tests, A/B harnesses)."""
     with _LOCK:
         _CACHE.clear()
+        _STRUCTS.clear()
         _STATS["hits"] = _STATS["misses"] = _STATS["evictions"] = 0

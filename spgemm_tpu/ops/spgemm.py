@@ -713,6 +713,167 @@ def execute(plan: SpgemmPlan, a, b):
                              val_bound=min(out_bound, (1 << 64) - 2))
 
 
+def _stack_width(rnd, plan: SpgemmPlan, jobs: int) -> int:
+    """How many jobs' copies of one round may ride a single fused launch
+    without busting the budgets the plan was built under: the SMEM
+    index-array budget (Pallas backends -- the stacked key axis ships in
+    the same arrays the solo round did) and the gather-materialization
+    entry budget (every backend).  Small rounds -- the cross-job batching
+    workload -- fit the whole batch; a round already near budget degrades
+    to narrower chunks (worst case per-job launches), never a silently
+    over-budget dispatch."""
+    from spgemm_tpu.ops.symbolic import _smem_key_cap  # noqa: PLC0415
+
+    K, P = rnd.pa.shape
+    width = jobs
+    max_entries, _ = _plan_budgets(plan.backend, plan.platform)
+    if max_entries is not None:
+        width = min(width, max(1, _smem_key_cap(P, max_entries) // max(K, 1)))
+    width = min(width, max(1, _batch_entries(plan.k) // max(K * P, 1)))
+    return max(1, width)
+
+
+def execute_batched(plan: SpgemmPlan, pairs: list) -> list:
+    """One fused dispatch for J same-structure multiplies (cross-job
+    batching, serve/daemon batch pickup): every (a, b) in `pairs` must
+    match `plan` (check_operands guards each), the J operand slabs
+    concatenate tiles-only with ONE shared sentinel zero tile, each round
+    dispatches once with the jobs stacked along the round axis every
+    numeric kernel already accepts (symbolic.accept_round_stack), and
+    per-job results de-interleave at assembly through the SAME take
+    permutation the solo path uses.  Each output row's pair list and fold
+    order are untouched, so every job's result is byte-identical to its
+    solo execute(plan, a, b) -- bit-exact by construction.
+
+    Kernel routing: the hybrid backend's per-round speed gate is skipped
+    -- every round runs the exact kernel (proof-gated routes are
+    bit-identical by contract, so only wall clock differs); the proven
+    val_bound still propagates per job when the proof holds.  Returns the
+    J results in submission order."""
+    from spgemm_tpu.ops.device import DeviceBlockMatrix, ensure_device  # noqa: PLC0415
+    from spgemm_tpu.ops.symbolic import stack_round_indices  # noqa: PLC0415
+    from spgemm_tpu.utils.timers import ENGINE as timers  # noqa: PLC0415
+
+    if len(pairs) == 1:
+        return [execute(plan, *pairs[0])]
+    pairs = [(ensure_device(a), ensure_device(b)) for a, b in pairs]
+    for a, b in pairs:
+        plan.check_operands(a, b)
+    plan.ensure_exact()
+    k, J = plan.k, len(pairs)
+    join, rounds = plan.join, plan.rounds
+    if join.num_keys == 0:
+        return [DeviceBlockMatrix.empty(a.rows, b.cols, k)
+                for a, b in pairs]
+    nnzb_a, nnzb_b = plan.a_nnzb, plan.b_nnzb
+    if max(nnzb_a, nnzb_b) * J + 1 >= 1 << 31 \
+            or any(rnd.pa.ndim != 2 for rnd in rounds):
+        # the stacked slab indices must stay int32 (kernel contract), and
+        # only the planner's 2-D rounds stack along the job axis; either
+        # way the fused path cannot exist -- run solo, same bits
+        return [execute(plan, a, b) for a, b in pairs]
+
+    backend = plan.backend
+    cap = (1 << 64) - 2
+    if backend == "hybrid":
+        # exact kernel for every round (see docstring); parameterize the
+        # selection off the widest bounds so an mxu-limb choice -- were
+        # the exact backend ever bound-sensitive -- covers every job
+        exact_name = resolve_backend(None, plan.platform)
+        numeric, _, _ = _select_numeric(exact_name, *pairs[0])
+    else:
+        from types import SimpleNamespace  # noqa: PLC0415
+
+        def _widest(bounds):
+            vals = [vb for vb in bounds]
+            return None if any(v is None for v in vals) else max(vals)
+        a_w = SimpleNamespace(val_bound=_widest([a.val_bound
+                                                 for a, _ in pairs]))
+        b_w = SimpleNamespace(val_bound=_widest([b.val_bound
+                                                 for _, b in pairs]))
+        numeric, _, _ = _select_numeric(backend, a_w, b_w)
+
+    # ONE shared sentinel zero tile: every job's slab carries its own as
+    # the last row -- reuse job 0's instead of appending a fresh device
+    # zero (stack_round_indices remaps every job's sentinel onto it)
+    with timers.phase("numeric_dispatch"):
+        failpoints.check("kernel.dispatch")
+        a_hi = jnp.concatenate([a.hi[:nnzb_a] for a, _ in pairs]
+                               + [pairs[0][0].hi[nnzb_a:nnzb_a + 1]], axis=0)
+        a_lo = jnp.concatenate([a.lo[:nnzb_a] for a, _ in pairs]
+                               + [pairs[0][0].lo[nnzb_a:nnzb_a + 1]], axis=0)
+        b_hi = jnp.concatenate([b.hi[:nnzb_b] for _, b in pairs]
+                               + [pairs[0][1].hi[nnzb_b:nnzb_b + 1]], axis=0)
+        b_lo = jnp.concatenate([b.lo[:nnzb_b] for _, b in pairs]
+                               + [pairs[0][1].lo[nnzb_b:nnzb_b + 1]], axis=0)
+        # per round, per job: the (chunk, K, k, k) output stack sliced
+        # back out -- de-interleaving is row arithmetic, never a re-fold
+        outs_h: list[list] = [[] for _ in range(J)]
+        outs_l: list[list] = [[] for _ in range(J)]
+        fused = 0
+        for rnd in rounds:
+            width = _stack_width(rnd, plan, J)
+            spa_all = stack_round_indices(rnd.pa, nnzb_a, J)  # (J, K, P)
+            spb_all = stack_round_indices(rnd.pb, nnzb_b, J)
+            for lo in range(0, J, width):
+                chunk = min(width, J - lo)
+                oh, ol = numeric(a_hi, a_lo, b_hi, b_lo,
+                                 jnp.asarray(spa_all[lo:lo + chunk]),
+                                 jnp.asarray(spb_all[lo:lo + chunk]))
+                timers.incr("dispatches")
+                fused += chunk > 1
+                for idx in range(chunk):
+                    outs_h[lo + idx].append(oh[idx])
+                    outs_l[lo + idx].append(ol[idx])
+
+    with timers.phase("assembly"):
+        results = []
+        if plan.take is not None:
+            take = jnp.asarray(plan.take)
+            planes = [_assemble(outs_h[j], outs_l[j], take)
+                      for j in range(J)]
+        else:
+            # legacy (non-round-batched) plan: the solo path's inverse
+            # permutation over valid round rows, built once, gathered per
+            # job -- still one fused epilogue call per job
+            order = [rnd.key_index for rnd in rounds]
+            cat_idx = np.concatenate(order)
+            inv = np.empty(join.num_keys + 1, np.int64)
+            inv[cat_idx] = np.arange(len(cat_idx))
+            inv[-1] = len(cat_idx)
+            take = jnp.asarray(inv)
+            zero = jnp.zeros((1, k, k), jnp.uint32)
+            planes = []
+            for j in range(J):
+                valid_h = [oh[:len(rnd.key_index)]
+                           for oh, rnd in zip(outs_h[j], rounds)]
+                valid_l = [ol[:len(rnd.key_index)]
+                           for ol, rnd in zip(outs_l[j], rounds)]
+                planes.append(
+                    (jnp.concatenate(valid_h + [zero], axis=0)[take],
+                     jnp.concatenate(valid_l + [zero], axis=0)[take]))
+        for (a, b), (out_hi, out_lo) in zip(pairs, planes):
+            out_bound = cap
+            if backend == "hybrid" and a.val_bound is not None \
+                    and b.val_bound is not None:
+                from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
+
+                proven = safe_exact_bound(a.val_bound, b.val_bound,
+                                          int(join.fanouts.max()), k)
+                if proven is not None:
+                    out_bound = proven
+            results.append(DeviceBlockMatrix(
+                rows=a.rows, cols=b.cols, k=k, coords=join.keys,
+                hi=out_hi, lo=out_lo, val_bound=min(out_bound, cap)))
+    _observe_memory()
+    total_pairs = int(join.pair_ptr[-1])
+    log.info("spgemm[%s,x%d-job-batch]: nnzb %d x %d -> keys=%d pairs=%d "
+             "rounds=%d fused_launches=%d work=%.3f GFLOP/job",
+             backend, J, nnzb_a, nnzb_b, join.num_keys, total_pairs,
+             len(rounds), fused, 2.0 * total_pairs * k ** 3 / 1e9)
+    return results
+
+
 def subplan(parent: SpgemmPlan,
             keep: np.ndarray) -> tuple[SpgemmPlan, np.ndarray]:
     """Row-sliced sub-plan: the delta path's restriction of a cached plan

@@ -49,6 +49,27 @@ def accept_round_stack(numeric_fn):
     return wrapped
 
 
+def stack_round_indices(idx: np.ndarray, sentinel: int,
+                        jobs: int) -> np.ndarray:
+    """Stack one round's index array for a JOBS-wide cross-job fused
+    dispatch (ops/spgemm.execute_batched): the J operand slabs
+    concatenate tiles-only -- job j's tile t lands at j*sentinel + t --
+    with ONE shared zero tile appended at jobs*sentinel, so job j's copy
+    shifts every real index by j*sentinel and remaps the per-job
+    sentinel to the shared one.  A naive uniform offset would alias job
+    j's sentinel onto job j+1's tile 0 (wrong bits); the remap is the
+    whole subtlety.  (K, P) stacks to (jobs, K, P) and an
+    already-stacked (R, K, P) to (jobs*R, K, P) -- both the 3-D form
+    accept_round_stack flattens into the key axis, which keeps every
+    key's pair list and fold order untouched: bit-exact by construction,
+    the same argument as round batching."""
+    base = idx[None] if idx.ndim == 2 else idx
+    copies = [np.where(base == sentinel, jobs * sentinel,
+                       base + j * sentinel)
+              for j in range(jobs)]
+    return np.concatenate(copies, axis=0).astype(idx.dtype)
+
+
 @dataclass
 class JoinResult:
     """Output structure of A x B, in CSR-over-sorted-keys form.

@@ -175,9 +175,15 @@ def run_chain_job(job: Job, degraded: bool = False) -> None:
     # NEXT submit of this folder routes on a real estimate instead of
     # the default slice.  Best-effort -- pricing must never fail a job.
     try:
-        from spgemm_tpu.ops import estimate  # noqa: PLC0415
-        placement.note_mass(job.folder,
-                            estimate.chain_mass([m.coords for m in mats]))
+        from spgemm_tpu.ops import estimate, plancache  # noqa: PLC0415
+        coords = [m.coords for m in mats]
+        placement.note_mass(job.folder, estimate.chain_mass(coords))
+        # record the chain's structure fingerprint under the folder's
+        # stat signature (ops/plancache structure book): the NEXT submit
+        # of this folder carries a group key at admission, so the queue
+        # can co-batch same-structure jobs without reading anything
+        plancache.note_chain_structure(placement.signature(job.folder),
+                                       plancache.chain_fingerprint(coords))
     except Exception as e:  # noqa: BLE001 -- pricing is routing-only, never correctness
         log.warning("placement pricing failed for %s: %r", job.folder, e)
     kwargs: dict = {}
@@ -244,6 +250,123 @@ def run_chain_job(job: Job, degraded: bool = False) -> None:
         # job.output by now, and a stale result must not clobber it
         return
     io_text.write_matrix(job.output, result.prune_zeros())
+
+
+def run_chain_jobs(jobs: list[Job], degraded: bool = False) -> None:
+    """Cross-job batched runner (SPGEMM_TPU_SERVE_BATCH_K/_WINDOW_S):
+    run J same-structure chain jobs as ONE lockstep pairwise reduction --
+    each step plans once (shared plan, plancache-keyed) and executes all
+    J operand pairs as one fused dispatch (ops.spgemm.execute_batched),
+    so J jobs pay one launch sequence instead of J.  Bit-exact by
+    construction: the stacking rides the round axis the numeric kernels
+    already accept, each output row's fold order is untouched, and the
+    reduction tree is chain_product's helper2 pairing unchanged.
+
+    The executor only forms batches it already vetted (same recorded
+    structure fingerprint and backend/round_size options; no checkpoint,
+    failover, delta, or degraded pickup reaches here) -- but the
+    admission-time structure book can be stale, so the chains are
+    re-verified from the actual coords and a mismatch falls back to
+    running every job solo (run_chain_job): never a wrong answer, at
+    worst a wasted window."""
+    if degraded or len(jobs) == 1:
+        for job in jobs:
+            run_chain_job(job, degraded=degraded)
+        return
+    import numpy as np  # noqa: PLC0415
+
+    from spgemm_tpu.ops import spgemm as spgemm_mod  # noqa: PLC0415
+    from spgemm_tpu.utils import io_text  # noqa: PLC0415
+
+    chains = []
+    for job in jobs:
+        n, k = io_text.read_size(job.folder)
+        mats = io_text.read_chain(job.folder, 0, n - 1, k)
+        # per-job pricing + structure-book refresh, exactly the solo
+        # runner's best-effort block (the batch must not starve the
+        # estimator or let the book go stale)
+        try:
+            from spgemm_tpu.ops import estimate, plancache  # noqa: PLC0415
+            coords = [m.coords for m in mats]
+            placement.note_mass(job.folder, estimate.chain_mass(coords))
+            plancache.note_chain_structure(
+                placement.signature(job.folder),
+                plancache.chain_fingerprint(coords))
+        except Exception as e:  # noqa: BLE001 -- pricing is routing-only, never correctness
+            log.warning("placement pricing failed for %s: %r",
+                        job.folder, e)
+        chains.append(mats)
+    head_chain = chains[0]
+    same = all(
+        len(mats) == len(head_chain)
+        and all(m.k == h.k and m.rows == h.rows and m.cols == h.cols
+                and np.array_equal(m.coords, h.coords)
+                for m, h in zip(mats, head_chain))
+        for mats in chains[1:])
+    if not same:
+        log.warning("batch of %d jobs not structure-identical after "
+                    "read (stale structure book); running solo",
+                    len(jobs))
+        for job in jobs:
+            run_chain_job(job, degraded=False)
+        return
+
+    def beat() -> None:
+        # heartbeat for every member after each fused multiply; the HEAD
+        # is the watchdog's reap/wedge slot (sl.current), so a reaped
+        # head aborts the WHOLE batch at the next multiply boundary --
+        # the executor fails the surviving mates with a structured error
+        # (they shared the head's deadline class)
+        failpoints.check("serve.heartbeat")
+        for job in jobs:
+            job.touch()
+        if jobs[0].state in TERMINAL:
+            raise JobAbandoned(jobs[0].id)
+
+    device_ids = jobs[0].device_ids
+    if device_ids:
+        # single-device slice in a pool (the batch gate excludes wide
+        # slices): commit every chain to the slice's device, like the
+        # solo runner
+        from spgemm_tpu.ops.device import DeviceBlockMatrix  # noqa: PLC0415
+
+        dev = mesh_mod.slice_devices(
+            mesh_mod.DeviceSlice(jobs[0].slice or "slice", 0,
+                                 tuple(device_ids)))[0]
+        chains = [[DeviceBlockMatrix.from_host(m, device=dev)
+                   for m in mats] for mats in chains]
+    import jax  # noqa: PLC0415
+
+    platform = jax.devices()[0].platform
+    backend = spgemm_mod.resolve_backend(jobs[0].options.get("backend"))
+    rs = jobs[0].options.get("round_size")
+    round_size = int(rs) if rs is not None else None
+    arrs = chains  # one partial list per job, reduced in lockstep
+    while len(arrs[0]) > 1:
+        nxt: list[list] = [[] for _ in jobs]
+        width = len(arrs[0])
+        for i in range(0, width - 1, 2):
+            # the reference's :301 progress line, once per FUSED step
+            print(f"multiplying {i} {i + 1}", flush=True)
+            pln = spgemm_mod.plan(arrs[0][i], arrs[0][i + 1],
+                                  round_size=round_size, backend=backend,
+                                  platform=platform)
+            outs = spgemm_mod.execute_batched(
+                pln, [(arr[i], arr[i + 1]) for arr in arrs])
+            for j, out in enumerate(outs):
+                nxt[j].append(out)
+            beat()
+            for arr in arrs:
+                arr[i] = arr[i + 1] = None  # free consumed partials
+        if width % 2 == 1:
+            for j, arr in enumerate(arrs):
+                nxt[j].append(arr[-1])  # odd element carried (:315-321)
+        arrs = nxt
+    for job, arr in zip(jobs, arrs):
+        if job.state in TERMINAL:
+            continue  # reaped mid-batch: never clobber a resubmit's output
+        result = arr[0].to_host() if hasattr(arr[0], "to_host") else arr[0]
+        io_text.write_matrix(job.output, result.prune_zeros())
 
 
 class _Slice:
@@ -353,7 +476,7 @@ class Daemon:
     RECOVER_BACKOFF_MAX_S = 900.0
 
     def __init__(self, socket_path: str | None = None, *, runner=None,
-                 probe=None, queue_cap: int | None = None,
+                 batch_runner=None, probe=None, queue_cap: int | None = None,
                  job_timeout_s: float | None = None,
                  wedge_grace_s: float | None = None, journal: bool = True,
                  persist_compile_cache: bool = False,
@@ -374,6 +497,11 @@ class Daemon:
         # default; SPGEMM_TPU_WARM=0 disables persistence entirely)
         self.warm_dir = self.socket_path + ".warm"
         self._runner = runner or run_chain_job
+        # the cross-job batched runner (SPGEMM_TPU_SERVE_BATCH_*):
+        # batch_runner(jobs, degraded=...) runs >= 2 vetted same-structure
+        # jobs as one lockstep fused-dispatch reduction; injectable like
+        # runner so tests can observe batch formation without jax
+        self._batch_runner = batch_runner or run_chain_jobs
         self._probe = probe
         self._cap = queue_cap if queue_cap is not None \
             else knobs.get("SPGEMM_TPU_SERVE_QUEUE_CAP")
@@ -408,6 +536,14 @@ class Daemon:
                                  "drained": 0}  # spgemm-lint: guarded-by(_lock)
         self._job_wall = {
             "buckets": {le: 0 for le in obs_metrics.JOB_WALL_BUCKETS},
+            "sum": 0.0, "count": 0}        # spgemm-lint: guarded-by(_lock)
+        # jobs per armed-window executor pickup (the
+        # spgemm_serve_batch_size histogram): size 1 = a batchable head
+        # found no mates inside SPGEMM_TPU_SERVE_BATCH_WINDOW_S, >= 2 =
+        # one fused dispatch served the whole batch.  Never sampled while
+        # the window is 0, so the pre-batch scrape is byte-identical.
+        self._batch_size = {
+            "buckets": {le: 0 for le in obs_metrics.BATCH_SIZE_BUCKETS},
             "sum": 0.0, "count": 0}        # spgemm-lint: guarded-by(_lock)
         # flight dumps in THIS daemon's write order: retention must prune
         # oldest-first even on filesystems whose mtime granularity ties a
@@ -530,8 +666,14 @@ class Daemon:
                             ev, e)
                 continue
             # re-price at replay: the folder may have changed (or gone)
-            # since the original admission routed it
+            # since the original admission routed it -- the batching
+            # group key re-resolves the same way (the structure book is
+            # in-process state a restart emptied, so replayed jobs
+            # usually run solo until an executor re-records the folder)
             job.placement = placement.route(job.folder)
+            from spgemm_tpu.ops import plancache  # noqa: PLC0415
+            job.group_key = plancache.chain_structure(
+                placement.signature(job.folder))
             try:
                 self.queue.submit(job)
                 log.info("journal: re-queued unfinished job %s (%s)",
@@ -849,6 +991,16 @@ class Daemon:
                     job.timeout_s = tight
                 obs_events.emit("slice_canary", slice=sl.name,
                                 job_id=job.id, timeout_s=job.timeout_s)
+            # cross-job batching (SPGEMM_TPU_SERVE_BATCH_K/_WINDOW_S):
+            # a batchable head drains same-structure mates and the whole
+            # group runs as one fused pickup.  Degraded and canary
+            # pickups never batch (the failover path has no fused
+            # runner; an audition must risk exactly one job).
+            mates = [] if degraded or canary \
+                else self._drain_batch_mates(sl, job)
+            if mates:
+                self._run_batch_members(sl, job, mates)
+                continue
             job.start()
             # the backend-wedge signature, injected: the executor hangs
             # right where a dead device would hang it -- after pickup,
@@ -935,6 +1087,185 @@ class Daemon:
                 # still ours, never the successor's current job
                 if sl.current is job:
                     sl.current = None
+
+    # ------------------------------------------------------------ batching --
+    def _drain_batch_mates(self, sl: _Slice, head: Job) -> list[Job]:
+        """Batch-formation half of cross-job batching: with the window
+        armed (SPGEMM_TPU_SERVE_BATCH_WINDOW_S > 0) and a batchable head
+        in hand, drain up to SPGEMM_TPU_SERVE_BATCH_K - 1 queued mates
+        sharing the head's structure group key and option class.  The
+        drain rides the queue's own DRR pass, so tenant fairness and
+        per-tenant caps are decided BEFORE batch formation; the window
+        only opens after a head was already popped, so an idle pool
+        never waits.  Window 0 returns [] without touching anything --
+        exactly the pre-batch executor (the whole-feature A/B)."""
+        window_s = knobs.get("SPGEMM_TPU_SERVE_BATCH_WINDOW_S")
+        if window_s <= 0:
+            return []
+        batch_k = knobs.get("SPGEMM_TPU_SERVE_BATCH_K")
+        # jobs that cannot co-batch run solo: no recorded structure
+        # (first contact), wide slice (the rowshard multiply has no
+        # fused path), delta-eligible submits (retention would splice
+        # across jobs), checkpoint/failover (per-job chain state)
+        if batch_k <= 1 or sl.width > 1 or head.group_key is None \
+                or knobs.get("SPGEMM_TPU_DELTA") \
+                or head.options.get("checkpoint_dir") \
+                or head.options.get("failover"):
+            return []
+
+        def match(j: Job) -> bool:
+            # runs under the QUEUE lock via drain_batch's DRR pass:
+            # cheap attribute reads only.  Same structure, same deadline
+            # class, same kernel-affecting options -- mates must walk
+            # the head's exact plan sequence.
+            return (j.group_key == head.group_key
+                    and j.timeout_s == head.timeout_s
+                    and not j.options.get("checkpoint_dir")
+                    and not j.options.get("failover")
+                    and j.options.get("backend")
+                    == head.options.get("backend")
+                    and j.options.get("round_size")
+                    == head.options.get("round_size"))
+
+        mates = self.queue.drain_batch(batch_k - 1, window_s, match)
+        # the batch-size histogram samples every ARMED batchable pickup
+        # (size 1 = no mates arrived inside the window): the denominator
+        # an operator needs to judge the window length
+        with self._lock:
+            hist = self._batch_size
+            size = 1 + len(mates)
+            hist["sum"] += size
+            hist["count"] += 1
+            for le in hist["buckets"]:
+                if size <= le:
+                    hist["buckets"][le] += 1
+        return mates
+
+    def _run_batch_members(self, sl: _Slice, head: Job,
+                           mates: list[Job]) -> None:
+        """Execution half of cross-job batching: the head + its drained
+        mates run as ONE fused pickup (the batch runner's lockstep
+        reduction).  Every member keeps its OWN PhaseScope (all opened on
+        this executor thread, so the fused phases land in each member's
+        scope -- the truth: they all rode the launches), its own
+        journal/SLO/event records and its own end-to-end trace context;
+        spans carry the shared batch_id (= the head's job id) next to the
+        head's tags.  Only the head is sl.current -- the watchdog's
+        reap/wedge slot -- so a head reap aborts the whole batch at the
+        next multiply boundary and the surviving mates get a structured
+        error."""
+        from spgemm_tpu.ops import plancache  # noqa: PLC0415
+        from spgemm_tpu.utils.timers import ENGINE  # noqa: PLC0415
+
+        # a mate reaped while still in the FIFO was already finished and
+        # observed by the watchdog: dropping it here is the batch-shaped
+        # terminal-in-FIFO skip
+        jobs = [head] + [m for m in mates if m.state == "queued"]
+        batch_id = head.id
+        fused = len(jobs) > 1
+        for m in jobs[1:]:
+            m.slice = sl.name
+            m.device_ids = head.device_ids
+        with self._lock:
+            sl.jobs_total += len(jobs) - 1  # head counted at pickup
+        if fused:
+            ENGINE.incr("serve_batches")
+            ENGINE.incr("serve_batched_jobs", len(jobs))
+            for j in jobs:
+                j.batch_id = batch_id
+        for job in jobs:
+            job.start()
+        failpoints.check("serve.executor")
+        if head.stolen:
+            ENGINE.incr("serve_steals")
+        scopes = [ENGINE.scope() for _ in jobs]
+        cache_base = plancache.baseline()
+        for job, scope in zip(jobs, scopes):
+            job.scope, job.scope_degraded = scope, False
+            job.cache_base = cache_base
+        sl.current = head
+        tags = {"job_id": head.id, "trace_id": head.trace_id,
+                "slice": sl.name}
+        if fused:
+            tags["batch_id"] = batch_id
+        try:
+            with obs_trace.RECORDER.tagged(**tags):
+                for job, scope in zip(jobs, scopes):
+                    obs_events.emit(
+                        "job_start", degraded=False, folder=job.folder,
+                        slice=sl.name, tenant=job.tenant,
+                        stolen=job.stolen, job_id=job.id,
+                        trace_id=job.trace_id,
+                        **({"batch_id": batch_id} if fused else {}))
+                    # per-member queue wait into exactly that member's
+                    # scope (PhaseScope.record -- the ambient
+                    # ENGINE.record would fan out to every open scope)
+                    scope.record("serve_queue_wait",
+                                 max(0.0, (job.started_at
+                                           or job.submitted_at)
+                                     - job.submitted_at))
+                # the HBM watermark window keys by the span job tag, and
+                # the ambient tag is the head's id: one shared window
+                obs_profile.memory_job_begin(head.id)
+                with ENGINE.phase("serve_execute"):
+                    if fused:
+                        self._batch_runner(jobs, degraded=False)
+                    else:
+                        self._runner(head, degraded=False)
+        except JobAbandoned:
+            # the watchdog reaped the HEAD (the batch's sl.current slot)
+            # and the runner aborted at a multiply boundary: the head's
+            # terminal record is already committed; surviving mates get
+            # a structured error naming the shared fate
+            log.info("job %s abandoned mid-chain (batch of %d)",
+                     head.id, len(jobs))
+            for job, scope in zip(jobs[1:], scopes[1:]):
+                if job.finish("failed", error={
+                        "code": protocol.E_JOB_ERROR,
+                        "message": f"co-batched with job {head.id}, "
+                                   "which was reaped mid-chain; "
+                                   "resubmit"},
+                        detail=self._job_detail(scope, False, job),
+                        on_commit=lambda j=job: self._journal_append(
+                            {"event": "failed", "id": j.id})):
+                    self._observe_terminal(job, "error")
+                    obs_events.emit("job_failed", job_id=job.id,
+                                    trace_id=job.trace_id,
+                                    batch_id=batch_id,
+                                    error="co-batched head reaped")
+            warmstore.flush()
+        except Exception as e:  # noqa: BLE001 -- a job must not kill the loop
+            log.warning("batch %s failed: %r", batch_id, e)
+            for job, scope in zip(jobs, scopes):
+                if job.finish("failed", error={
+                        "code": protocol.E_JOB_ERROR,
+                        "message": repr(e)},
+                        detail=self._job_detail(scope, False, job),
+                        on_commit=lambda j=job: self._journal_append(
+                            {"event": "failed", "id": j.id})):
+                    self._observe_terminal(job, "error")
+                    obs_events.emit("job_failed", job_id=job.id,
+                                    trace_id=job.trace_id, error=repr(e))
+            self._canary_settle(sl)
+            warmstore.flush()
+        else:
+            for job, scope in zip(jobs, scopes):
+                if job.finish("done",
+                              detail=self._job_detail(scope, False, job),
+                              on_commit=lambda j=job: self._journal_append(
+                                  {"event": "done", "id": j.id})):
+                    self._observe_terminal(job, "done")
+                    obs_events.emit("job_done", job_id=job.id,
+                                    trace_id=job.trace_id,
+                                    **({"batch_id": batch_id}
+                                       if fused else {}))
+            self._canary_settle(sl)
+            warmstore.flush()
+        finally:
+            for scope in scopes:
+                scope.close()
+            if sl.current is head:
+                sl.current = None
 
     @staticmethod
     def _job_detail(scope, degraded: bool, job: Job | None = None) -> dict:
@@ -1514,6 +1845,14 @@ class Daemon:
         # price-book stat lookup, never a file parse) and carried on the
         # job for the slice executors' accept predicates
         job.placement = placement.route(folder)
+        # cross-job batching group key, decided at admission like the
+        # placement class (cheap: a stat signature + structure-book
+        # lookup, never a file parse): jobs sharing it walk identical
+        # plan sequences and may co-batch into one fused dispatch.  None
+        # (first contact / changed folder) runs solo, the pre-batch path.
+        from spgemm_tpu.ops import plancache  # noqa: PLC0415
+        job.group_key = plancache.chain_structure(
+            placement.signature(folder))
         # journal BEFORE enqueueing: the executor can pop and terminally
         # finish a job the instant it is queued, and its done/failed
         # journal event (committed inside Job.finish) must never precede
@@ -1686,6 +2025,9 @@ class Daemon:
             wall = {"buckets": dict(self._job_wall["buckets"]),
                     "sum": self._job_wall["sum"],
                     "count": self._job_wall["count"]}
+            batch_hist = {"buckets": dict(self._batch_size["buckets"]),
+                          "sum": self._batch_size["sum"],
+                          "count": self._batch_size["count"]}
         counts = self.queue.counts()
         depth = counts.pop("depth")
         journal = self._journal_stats()
@@ -1701,6 +2043,11 @@ class Daemon:
             ("spgemmd_journal_torn_total", {}, journal["torn"]),
             ("spgemmd_job_wall_seconds", {}, wall),
         ]
+        # the batch-size family only renders once the armed window has
+        # sampled (count > 0): a window-0 daemon's scrape stays
+        # byte-identical to the pre-batch surface
+        if batch_hist["count"] > 0:
+            samples.append(("spgemm_serve_batch_size", {}, batch_hist))
         samples += [("spgemmd_jobs", {"state": state}, n)
                     for state, n in sorted(counts.items())]
         samples += [("spgemmd_jobs_terminal_total", {"outcome": outcome}, n)

@@ -119,6 +119,15 @@ class Job:
         self.slice: str | None = None
         self.device_ids: tuple | None = None
         self.stolen = False
+        # cross-job batching group key (ops/plancache.chain_structure,
+        # set at admission alongside placement): jobs sharing it walk
+        # identical plan sequences and may co-batch into one fused
+        # dispatch.  None (first contact / unreadable folder) never
+        # groups -- the job runs solo, exactly the pre-batch path.
+        self.group_key: str | None = None
+        # set by the winning executor when this job rode a fused batch:
+        # the shared batch id (= the head job's id), for spans/status
+        self.batch_id: str | None = None
         # set by the daemon's executor when it picks the job up: the live
         # PhaseScope (opaque here -- the queue stays jax-free) and the
         # path the job ran on, read by the watchdog so a reaped job's
@@ -202,6 +211,7 @@ class Job:
                 "heartbeat_at": self.heartbeat_at,
                 "slice": self.slice,
                 "stolen": self.stolen,
+                "batch": self.batch_id,
                 "placement": dict(self.placement) if self.placement
                 else None,
             }
@@ -313,6 +323,31 @@ class JobQueue:
             return job
         return None
 
+    def _pop_scan_locked(self, accept) -> Job | None:
+        """Batch-mate DRR pass (caller holds _lock): like _pop_locked,
+        but scans PAST non-matching jobs inside each tenant's queue --
+        a mate deeper in the FIFO may join the batch while the skipped
+        jobs keep their positions (the reorder is bounded: at most one
+        batch's worth of mates overtakes, and the skipped head is the
+        very next solo pop).  Solo dispatch (next()) stays strictly
+        head-of-tenant FIFO; only batch formation scans."""
+        order = self._rr
+        for idx, tenant in enumerate(order):
+            q = self._queues.get(tenant)
+            if not q:
+                continue
+            for pos, job in enumerate(q):
+                if not accept(job):
+                    continue
+                del q[pos]
+                self._queued -= 1
+                if not q:
+                    del self._queues[tenant]
+                self._served[tenant] = self._served.get(tenant, 0) + 1
+                self._rr = order[idx + 1:] + order[:idx + 1]
+                return job
+        return None
+
     def next(self, timeout: float | None = None, accept=None) -> Job | None:
         """Pop the next job in fair order that `accept` takes (None
         predicate takes anything); None on timeout (executor idle tick).
@@ -325,6 +360,33 @@ class JobQueue:
                 self._avail.wait(timeout)
                 job = self._pop_locked(accept)
             return job
+
+    def drain_batch(self, limit: int, window_s: float, accept) -> list[Job]:
+        """Pop up to `limit` additional jobs the `accept` predicate takes
+        (the executor's batch-mate filter: same group key / deadline class
+        as the already-popped head), waiting up to `window_s` for more to
+        arrive.  Pops go through the same DRR pass as next() -- tenant
+        fairness and FIFO-within-tenant are decided BEFORE batch
+        formation, so a chatty tenant cannot monopolize a batch past its
+        rotation turns -- and scan past non-matching jobs within a
+        tenant (a different-structure job at the head must not block the
+        mates queued behind it; it stays first for the next solo pop).
+        Returns the drained mates (possibly empty); the window only
+        bounds WAITING -- jobs already queued drain immediately, so an
+        armed window never delays a full batch."""
+        mates: list[Job] = []
+        deadline = time.time() + window_s
+        with self._avail:
+            while len(mates) < limit:
+                job = self._pop_scan_locked(accept)
+                if job is not None:
+                    mates.append(job)
+                    continue
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._avail.wait(remaining)
+        return mates
 
     def release(self, job: Job) -> None:
         """Retire a terminal job from the per-tenant in-flight accounting

@@ -28,7 +28,16 @@ tiles mutated in one operand, and asserts the serving contract:
     `serve_queue_wait` stays well under the first job's `serve_execute`
     wall (a single-executor daemon would serialize them), the two jobs
     land on two different slices, and both results stay bit-exact vs
-    the oracle -- clean shutdown once more.
+    the oracle -- clean shutdown once more;
+  * BATCHING LEG (the cross-job fused-dispatch proof,
+    SPGEMM_TPU_SERVE_BATCH_WINDOW_S): a FOURTH daemon, single slice,
+    admission window armed, takes one warmup submit (first contact runs
+    solo and records the structure) then THREE same-structure submits
+    back-to-back -- all three must co-batch into ONE mega-launch (a
+    shared `batch` id on every snapshot, `serve_batches >= 1` on the
+    scrape, the `spgemm_serve_batch_size` histogram populated), and
+    every output stays bit-exact vs the oracle (stacking along the
+    round axis never changes any row's fold order) -- clean shutdown.
 
 Any step failing exits nonzero.  This process itself stays jax-free (the
 oracle and the generator are pure numpy) -- only the daemon touches a
@@ -284,6 +293,78 @@ def main() -> int:
             return _fail(proc, "pool daemon did not exit after shutdown")
         if rc != 0:
             return _fail(proc, f"pool daemon exited {rc} after shutdown")
+
+        # ---- batching leg: cross-job fused dispatch (1 slice) ----
+        # window armed, delta off (delta-eligible submits run solo by
+        # design): one warmup submit records the structure, then three
+        # back-to-back same-structure submits must fuse into ONE
+        # mega-launch, every output bit-exact vs the oracle
+        sock3 = os.path.join(tmp, "batch.sock")
+        env3 = dict(env)
+        env3["SPGEMM_TPU_SERVE_BATCH_WINDOW_S"] = "0.5"
+        env3["SPGEMM_TPU_SERVE_BATCH_K"] = "8"
+        env3["SPGEMM_TPU_DELTA"] = "0"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+             "--socket", sock3, "--device", "cpu", "-v"],
+            env=env3, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        deadline = time.time() + 120
+        while not os.path.exists(sock3):
+            if proc.poll() is not None:
+                return _fail(proc, "batch daemon exited before binding "
+                                   "its socket")
+            if time.time() > deadline:
+                return _fail(proc, "batch daemon never bound its socket")
+            time.sleep(0.1)
+        warm_out = os.path.join(tmp, "matrix.warmup")
+        resp = client.submit(folder, sock3, {"output": warm_out})
+        resp = client.wait(resp["id"], sock3, timeout=300)
+        if resp["job"]["state"] != "done":
+            return _fail(proc, "batch-leg warmup job ended "
+                               f"{resp['job']['state']}: "
+                               f"{resp['job']['error']}")
+        bids = [client.submit(
+            folder, sock3,
+            {"output": os.path.join(tmp, f"matrix.b{i}")})["id"]
+            for i in range(3)]  # back-to-back inside the window
+        bjobs = []
+        for jid in bids:
+            r = client.wait(jid, sock3, timeout=300)
+            if r["job"]["state"] != "done":
+                return _fail(proc, f"batch job {jid} ended "
+                                   f"{r['job']['state']}: "
+                                   f"{r['job']['error']}")
+            bjobs.append(r["job"])
+        for i in range(3):
+            got = open(os.path.join(tmp, f"matrix.b{i}"), "rb").read()
+            if got != want3_bytes:
+                return _fail(proc, f"batch job {i + 1} output does not "
+                                   "match the oracle bytes")
+        batch_ids = {j.get("batch") for j in bjobs}
+        if None in batch_ids or len(batch_ids) != 1:
+            return _fail(proc, "the three same-structure submits did not "
+                               f"co-batch (batch ids {batch_ids}; want "
+                               "one shared non-null id)")
+        scrape = client.metrics(sock3)
+        batches = 0
+        for ln in scrape.splitlines():
+            if (ln.startswith("spgemm_engine_events_total")
+                    and 'event="serve_batches"' in ln):
+                batches = int(float(ln.rsplit(" ", 1)[-1]))
+        if batches < 1:
+            return _fail(proc, f"scrape reports serve_batches={batches} "
+                               "(want >= 1)")
+        if "spgemm_serve_batch_size" not in scrape:
+            return _fail(proc, "spgemm_serve_batch_size histogram missing "
+                               "from the scrape after a fused batch")
+        client.shutdown(sock3)
+        try:
+            rc = proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            return _fail(proc, "batch daemon did not exit after shutdown")
+        if rc != 0:
+            return _fail(proc, f"batch daemon exited {rc} after shutdown")
     finally:
         if proc.poll() is None:
             proc.kill()
@@ -292,7 +373,8 @@ def main() -> int:
           f"warm_hits={warm_hits}, clean delta {d4_rows}/{t4_rows}; "
           f"pool leg: 2 jobs overlapped on {sorted(slices_used)} "
           f"(queue_wait {b_wait:.3f}s vs execute {a_exec:.3f}s), "
-          "bit-exact both; clean shutdown x3)")
+          f"bit-exact both; batching leg: 3 jobs fused "
+          f"(serve_batches={batches}), bit-exact all; clean shutdown x4)")
     return 0
 
 

@@ -236,6 +236,30 @@ _KNOBS = (
          "already queued is rejected with a structured queue-full error "
          "(serve/queue.py) instead of hanging the caller.",
          "serve/daemon.py", default="64", minimum=1),
+    Knob("SPGEMM_TPU_SERVE_BATCH_K", "int",
+         "spgemmd cross-job batch width: when the batching window is "
+         "armed (SPGEMM_TPU_SERVE_BATCH_WINDOW_S > 0) a slice executor "
+         "picking up a job drains up to this many queued jobs sharing "
+         "the head job's recorded structure fingerprint (same folder "
+         "structure = same plan) and executes them as ONE fused "
+         "dispatch per multiply -- operands stacked along the round "
+         "axis the numeric kernels already accept, per-job results "
+         "de-interleaved at assembly, every job's fold order untouched "
+         "(bit-exact by construction).  Jobs that cannot co-batch "
+         "(structure mismatch, different deadline class, checkpointed "
+         "or delta-eligible submits) run solo.",
+         "serve/daemon.py", default="8", minimum=1),
+    Knob("SPGEMM_TPU_SERVE_BATCH_WINDOW_S", "float",
+         "spgemmd cross-job batching window, seconds: after popping a "
+         "batchable head job the executor waits up to this long for "
+         "same-structure mates to arrive (DRR tenant fairness and "
+         "per-tenant caps apply BEFORE batch formation, so one tenant "
+         "cannot monopolize a batch).  Bounds the admission-latency "
+         "cost of batching: the window only opens when a batchable head "
+         "was already popped, so an idle pool never waits.  0 = no "
+         "cross-job batching at all -- exactly the pre-batch executor "
+         "(the whole-feature A/B).",
+         "serve/daemon.py", default="0", minimum=0),
     Knob("SPGEMM_TPU_SERVE_JOB_TIMEOUT", "float",
          "spgemmd per-job deadline, seconds: a job running past it is "
          "reaped with a structured job-timeout error, and an executor "

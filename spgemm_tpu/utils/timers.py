@@ -220,6 +220,20 @@ class PhaseScope:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    def record(self, name: str, seconds: float) -> None:
+        """Accumulate an externally measured duration into THIS scope (and
+        the global totals) without touching any other open scope -- the
+        batched-executor case: J co-batched jobs each own a PhaseScope on
+        the same executor thread, and a per-job figure (each mate's own
+        serve_queue_wait) must land in exactly one of them.  The ambient
+        ENGINE.record would fan out to every sink on the thread."""
+        trace.RECORDER.point(name, seconds)
+        with self._lock:
+            t = self._timers
+            t.totals[name] = t.totals.get(name, 0.0) + seconds
+            t.counts[name] = t.counts.get(name, 0) + 1
+            self._add_phase_locked(name, seconds)
+
     def snapshot(self) -> dict[str, float]:
         """Per-phase seconds attributed to this scope (rounded)."""
         with self._lock:

@@ -77,6 +77,15 @@ def bad_obs_knob_reads():
     return ev, cap
 
 
+def bad_batch_knob_reads():
+    # the cross-job batching knobs are registry knobs like any other:
+    # raw reads are KNB findings (registered in utils/knobs.py, read
+    # via knobs.get in serve/daemon.py)
+    k = os.environ.get("SPGEMM_TPU_SERVE_BATCH_K", "8")  # seeded KNB
+    win = os.getenv("SPGEMM_TPU_SERVE_BATCH_WINDOW_S")  # seeded KNB
+    return k, win
+
+
 def bad_warm_knob_reads():
     # the warm-start persistence knobs are registry knobs like any
     # other: raw reads are KNB findings (registered in utils/knobs.py,

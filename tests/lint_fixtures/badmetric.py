@@ -58,3 +58,15 @@ def legal_warm_names(x):
     with timers.phase("warm_load"):  # legal: declared warm phase
         timers.incr("warm_hits")  # legal: declared warm counter
         return x
+
+
+def bad_batch_layer_names():
+    # the cross-job batching layer's series ride the same registries: a
+    # singular near-miss of the declared serve_batches counter is a
+    # finding
+    timers.incr("serve_batch")  # MET: undeclared batch counter
+
+
+def legal_batch_names():
+    timers.incr("serve_batches")  # legal: declared batch counter
+    timers.incr("serve_batched_jobs")  # legal: declared batch counter

@@ -118,7 +118,7 @@ def test_knb_fixture_each_violation_caught():
     the same fixture (how harnesses and tests drive knob values) must NOT
     be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 19
+    assert [f.rule for f in findings] == ["KNB"] * 21
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
                    "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
@@ -133,7 +133,9 @@ def test_knb_fixture_each_violation_caught():
                    "SPGEMM_TPU_OBS_EVENTS",
                    "SPGEMM_TPU_OBS_EVENTS_MAX_KB",
                    "SPGEMM_TPU_WARM", "SPGEMM_TPU_WARM_DIR",
-                   "SPGEMM_TPU_WARM_MAX_MB"):
+                   "SPGEMM_TPU_WARM_MAX_MB",
+                   "SPGEMM_TPU_SERVE_BATCH_K",
+                   "SPGEMM_TPU_SERVE_BATCH_WINDOW_S"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -216,7 +218,7 @@ def test_met_fixture_each_violation_caught():
     declared names and ad-hoc PhaseTimers instances stay legal."""
     findings = lint_file(os.path.join(FIXTURES, "badmetric.py"))
     met = [f for f in findings if f.rule == "MET"]
-    assert len(met) == 7 and findings == met
+    assert len(met) == 8 and findings == met
     flagged = [f.line for f in met]
     for needle in ("MET: undeclared phase name",
                    "MET: undeclared counter name",
@@ -224,7 +226,8 @@ def test_met_fixture_each_violation_caught():
                    "MET: undeclared profile counter",
                    "MET: undeclared profile phase",
                    "MET: undeclared warm counter",
-                   "MET: undeclared warm phase"):
+                   "MET: undeclared warm phase",
+                   "MET: undeclared batch counter"):
         assert _fixture_lines("badmetric.py", needle)[0] in flagged
     msgs = " ".join(f.message for f in met)
     assert "made_up_phase" in msgs and "made_up_counter" in msgs
@@ -238,7 +241,8 @@ def test_met_fixture_each_violation_caught():
     for needle in ("legal: declared phase", "legal: declared counter",
                    "legal: not the ENGINE registry",
                    "legal: declared warm phase",
-                   "legal: declared warm counter"):
+                   "legal: declared warm counter",
+                   "legal: declared batch counter"):
         assert _fixture_lines("badmetric.py", needle)[0] not in flagged
 
 
@@ -1407,6 +1411,7 @@ def test_json_report_fixture_run():
     assert report["clean"] is False
     # badknob: 3 classic + 2 planner-knob + 4 serve-knob + 3
     # estimator-knob + 2 delta-knob + 2 obs-events-knob + 3 warm-knob
+    # + 2 batch-knob
     # reads; badbackend: 3 import-time touches; badplanner: 2
     # @host_only-body touches; FLD: 5 per-module + 2 interprocedural
     # (callchain) + 1 ops/estimate + 1 ops/delta numeric-scope;
@@ -1415,12 +1420,13 @@ def test_json_report_fixture_run():
     # two-root write + nested-def two-site root + loop-spawned
     # multi-instance root; stalesup: one stale escape per family (6);
     # badmetric: undeclared phase + undeclared counter + computed name
-    # + 2 deep-profiling + 2 warm-layer near-misses; badfailpoint: 2
+    # + 2 deep-profiling + 2 warm-layer + 1 batch-layer near-misses;
+    # badfailpoint: 2
     # undeclared + 1 computed (the stale-registry direction stays quiet
     # -- the registry module is not in the fixture unit set)
-    assert report["counts"] == {"FLD": 9, "KNB": 19, "BKD": 5, "THR": 3,
+    assert report["counts"] == {"FLD": 9, "KNB": 21, "BKD": 5, "THR": 3,
                                 "LCK": 2, "BLK": 3, "TSI": 3,
-                                "EXC": 3, "MET": 7, "FPT": 3, "DOC": 1,
+                                "EXC": 3, "MET": 8, "FPT": 3, "DOC": 1,
                                 "SUP": 6, "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:

@@ -1,0 +1,279 @@
+"""Cross-job batched dispatch (serve/daemon._drain_batch_mates +
+ops/spgemm.execute_batched): same-structure queued jobs fused into one
+mega-launch per slice, bit-exact by construction -- tier-1 on the 8-vdev
+CPU backend.
+
+Covers the ISSUE-16 contract: batched results byte-identical to solo
+runs, mixed-fingerprint queues never co-batch, the admission window
+bounds added latency, per-job journal/SLO/trace records stay individual,
+and DRR tenant fairness decides batch membership BEFORE formation (a
+chatty tenant cannot fill a batch while another tenant waits).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.ops import plancache
+from spgemm_tpu.serve import client, placement
+from spgemm_tpu.serve.daemon import Daemon, journal_parse_line
+from spgemm_tpu.utils import io_text
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_chain
+from spgemm_tpu.utils.semantics import chain_oracle
+from spgemm_tpu.utils.timers import ENGINE
+
+
+def _chain_folder(tmp_path, n=3, k=2, seed=7, name="chain_in"):
+    """A reference-format input dir + the oracle's output bytes."""
+    mats = random_chain(n, 4, k, 0.5, np.random.default_rng(seed), "full")
+    folder = str(tmp_path / name)
+    io_text.write_chain_dir(folder, mats, k)
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, k, want).prune_zeros())
+    return folder, want_bytes
+
+
+def _prime(folder, fingerprint="fp-test"):
+    """Record the folder's structure in the plan-cache structure book --
+    the served-before steady state where admission stamps the group key
+    (a first contact always runs solo to record it)."""
+    sig = placement.signature(folder)
+    assert sig is not None
+    plancache.note_chain_structure(sig, fingerprint)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_structure_book():
+    """The structure book is process-global (ops/plancache): without a
+    per-test clear, one test's recorded fingerprints would hand a later
+    test's admission a group key it never primed."""
+    plancache.clear()
+    yield
+    plancache.clear()
+
+
+@pytest.fixture
+def batch_env(monkeypatch):
+    """Arm batching: window open, K roomy, delta OFF (delta-eligible
+    submits run solo by design, so the retention engine must be off for
+    co-batching to form at all)."""
+    monkeypatch.setenv("SPGEMM_TPU_SERVE_BATCH_WINDOW_S", "0.5")
+    monkeypatch.setenv("SPGEMM_TPU_SERVE_BATCH_K", "8")
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "0")
+    yield monkeypatch
+
+
+@pytest.fixture
+def make_daemon(tmp_path):
+    """Daemon factory bound to a per-test socket; stops them on teardown."""
+    daemons = []
+
+    def _make(idx=0, **kw):
+        d = Daemon(str(tmp_path / f"d{idx}.sock"), **kw)
+        d.start()
+        daemons.append(d)
+        return d
+
+    yield _make
+    for d in daemons:
+        d.stop()
+
+
+def _submit_wait(d, folder, out_paths, tenant=None, timeout=120.0):
+    """Submit one job per output path back-to-back, wait for all."""
+    ids = [client.submit(folder, d.socket_path,
+                         {"output": o}, tenant=tenant)["id"]
+           for o in out_paths]
+    return [client.wait(j, d.socket_path, timeout=timeout)["job"]
+            for j in ids]
+
+
+# ----------------------------------------------------- bit-exactness --
+def test_batched_results_byte_identical_to_solo(tmp_path, batch_env,
+                                                make_daemon):
+    """The tentpole parity proof: co-batched jobs produce outputs
+    byte-identical to the same submits through an unbatched daemon AND
+    to the host oracle -- stacking along the round axis never changes
+    any output row's fold order."""
+    folder, want = _chain_folder(tmp_path)
+
+    # solo leg: window 0 = the pre-batch daemon, the whole-feature A/B
+    batch_env.setenv("SPGEMM_TPU_SERVE_BATCH_WINDOW_S", "0")
+    d0 = make_daemon(0, journal=False)
+    solo_outs = [str(tmp_path / f"solo{i}") for i in range(3)]
+    for j in _submit_wait(d0, folder, solo_outs):
+        assert j["state"] == "done", j["error"]
+        assert j["batch"] is None
+    d0.stop()
+
+    # batched leg: window armed, structure primed (served-before state)
+    batch_env.setenv("SPGEMM_TPU_SERVE_BATCH_WINDOW_S", "0.5")
+    _prime(folder)
+    before = ENGINE.counter_snapshot().get("serve_batches", 0)
+    d1 = make_daemon(1, journal=False)
+    batch_outs = [str(tmp_path / f"batch{i}") for i in range(3)]
+    jobs = _submit_wait(d1, folder, batch_outs)
+    for j in jobs:
+        assert j["state"] == "done", j["error"]
+    after = ENGINE.counter_snapshot().get("serve_batches", 0)
+    assert after > before, "no fused batch formed"
+    # at least one pair co-batched (back-to-back submits inside the
+    # window); every co-batched job carries the shared batch id
+    batched = [j for j in jobs if j["batch"] is not None]
+    assert len(batched) >= 2
+    assert len({j["batch"] for j in batched}) == 1
+
+    for o in solo_outs + batch_outs:
+        with open(o, "rb") as f:
+            assert f.read() == want
+
+
+# ------------------------------------------------- batch formation --
+def test_mixed_fingerprints_never_cobatch(tmp_path, batch_env, make_daemon):
+    """Only same-structure jobs fuse: a queue interleaving two
+    fingerprints batches each group with its own kind, never across."""
+    folder_a, _ = _chain_folder(tmp_path, seed=7, name="a")
+    folder_b, _ = _chain_folder(tmp_path, seed=8, name="b")
+    blocker, _ = _chain_folder(tmp_path, seed=9, name="blocker")
+    _prime(folder_a, "fp-a")
+    _prime(folder_b, "fp-b")
+    # blocker stays UNprimed: no group key, runs solo immediately
+
+    gate = threading.Event()
+    solo_calls, batch_calls = [], []
+
+    def runner(job, degraded=False):
+        if job.folder == blocker:
+            gate.wait(30)
+        solo_calls.append(job.id)
+
+    def batch_runner(jobs, degraded=False):
+        batch_calls.append([j.id for j in jobs])
+
+    d = make_daemon(runner=runner, batch_runner=batch_runner, journal=False)
+    blk = client.submit(blocker, d.socket_path, {"output": "x"})["id"]
+    # queue while the executor is busy: A1, B1, A2 -- FIFO order
+    a1 = client.submit(folder_a, d.socket_path, {"output": "x"})["id"]
+    b1 = client.submit(folder_b, d.socket_path, {"output": "x"})["id"]
+    a2 = client.submit(folder_a, d.socket_path, {"output": "x"})["id"]
+    gate.set()
+    jobs = {j: client.wait(j, d.socket_path, timeout=60.0)["job"]
+            for j in (blk, a1, b1, a2)}
+    assert all(j["state"] == "done" for j in jobs.values())
+    # A1+A2 fused past the interleaved B1; B1 ran solo
+    assert [a1, a2] in batch_calls
+    assert b1 in solo_calls
+    assert not any(b1 in call for call in batch_calls)
+    assert jobs[a1]["batch"] == jobs[a2]["batch"] is not None
+    assert jobs[b1]["batch"] is None
+
+
+def test_window_bounds_added_latency(tmp_path, batch_env, make_daemon):
+    """The admission window is the only latency batching may add: a
+    mate joining a batch waits at most window + the head's execute; a
+    lone head waits exactly the window then runs solo."""
+    folder, _ = _chain_folder(tmp_path)
+    _prime(folder)
+    window = 0.4
+    batch_env.setenv("SPGEMM_TPU_SERVE_BATCH_WINDOW_S", str(window))
+
+    d = make_daemon(runner=lambda job, degraded=False: None,
+                    batch_runner=lambda jobs, degraded=False: None,
+                    journal=False)
+    # lone batchable head: waits the full window for mates, then solo
+    t0 = time.time()
+    [lone] = _submit_wait(d, folder, [str(tmp_path / "lone")])
+    wall = time.time() - t0
+    assert lone["state"] == "done"
+    assert lone["batch"] is None
+    assert wall < window + 10.0  # never unbounded
+    # two back-to-back: the second co-batches, its queue wait bounded
+    # by the window plus the head's execute wall
+    jobs = _submit_wait(d, folder,
+                        [str(tmp_path / "j0"), str(tmp_path / "j1")])
+    assert all(j["state"] == "done" for j in jobs)
+    assert jobs[0]["batch"] == jobs[1]["batch"] is not None
+    head_exec = jobs[0]["detail"]["phases_s"].get("serve_execute", 0.0)
+    mate_wait = jobs[1]["detail"]["phases_s"].get("serve_queue_wait")
+    assert mate_wait is not None
+    assert mate_wait <= window + head_exec + 5.0
+
+
+# ---------------------------------------------- per-job observability --
+def test_per_job_records_stay_individual(tmp_path, batch_env, make_daemon):
+    """Fusing the dispatch must not fuse the records: every co-batched
+    job keeps its own trace id, its own journal lifecycle, its own
+    phase attribution, and its own SLO window entry."""
+    folder, _ = _chain_folder(tmp_path)
+    _prime(folder)
+    d = make_daemon(runner=lambda job, degraded=False: None,
+                    batch_runner=lambda jobs, degraded=False: None)
+    outs = [str(tmp_path / f"o{i}") for i in range(3)]
+    jobs = _submit_wait(d, folder, outs, tenant="acme")
+    assert all(j["state"] == "done" for j in jobs)
+    batched = [j for j in jobs if j["batch"] is not None]
+    assert len(batched) >= 2
+
+    # distinct client-minted trace ids survive the fused dispatch
+    traces = {j["trace"] for j in jobs}
+    assert len(traces) == len(jobs)
+    # per-job phase attribution: each member's own scope saw the phases
+    for j in batched:
+        assert "serve_queue_wait" in j["detail"]["phases_s"]
+        assert "serve_execute" in j["detail"]["phases_s"]
+    # the journal carries each member's own lifecycle records
+    with open(d.journal_path) as f:
+        recs = [journal_parse_line(ln.strip()) for ln in f if ln.strip()]
+    by_job = {}
+    for rec in recs:
+        if rec and rec.get("id"):
+            by_job.setdefault(rec["id"], set()).add(rec.get("event"))
+    for j in jobs:
+        assert "submit" in by_job[j["id"]]
+        assert "done" in by_job[j["id"]]
+    # the SLO engine saw every member as its own terminal job
+    slo = client.slo(d.socket_path)
+    assert slo["tenants"]["acme"]["jobs"] == len(jobs)
+
+
+def test_drr_fairness_decides_membership_before_formation(tmp_path,
+                                                          batch_env,
+                                                          make_daemon):
+    """Tenant fairness is applied at drain time: with a chatty tenant's
+    jobs queued ahead, the quiet tenant's same-structure job still lands
+    in the FIRST batch (deficit-round-robin picks across tenants), not
+    behind the chatty backlog."""
+    folder, _ = _chain_folder(tmp_path)
+    blocker, _ = _chain_folder(tmp_path, seed=9, name="blocker")
+    _prime(folder)
+    batch_env.setenv("SPGEMM_TPU_SERVE_BATCH_K", "4")
+
+    gate = threading.Event()
+    batch_calls = []
+
+    def runner(job, degraded=False):
+        if job.folder == blocker:
+            gate.wait(30)
+
+    def batch_runner(jobs, degraded=False):
+        batch_calls.append([j.id for j in jobs])
+
+    d = make_daemon(runner=runner, batch_runner=batch_runner, journal=False)
+    blk = client.submit(blocker, d.socket_path, {"output": "x"})["id"]
+    chatty = [client.submit(folder, d.socket_path, {"output": "x"},
+                            tenant="chatty")["id"] for _ in range(5)]
+    quiet = client.submit(folder, d.socket_path, {"output": "x"},
+                          tenant="quiet")["id"]
+    gate.set()
+    for j in [blk] + chatty + [quiet]:
+        assert client.wait(j, d.socket_path,
+                           timeout=60.0)["job"]["state"] == "done"
+    assert batch_calls, "no batch formed"
+    # the first fused batch (K=4) includes the quiet tenant's job --
+    # DRR ran before batch formation, so chatty couldn't fill it
+    assert quiet in batch_calls[0]
+    assert len(batch_calls[0]) <= 4
