@@ -415,6 +415,17 @@ def _run(args) -> int:
     single_s = time.perf_counter() - t0
     single_gflops = pair_flops / single_s / 1e9
 
+    # padded-MAC accountability: shipped vs real MACs of the single-multiply
+    # plan under the live SPGEMM_TPU_ACCUM_ROUTE -- the regression guard the
+    # accumulator route is judged against (auto/dense streams pull it to ~1.0)
+    try:
+        from spgemm_tpu.ops.spgemm import plan as build_plan
+        padded_mac_ratio = round(build_plan(
+            a, b, backend=backend, round_size=args.round_size,
+            platform=platform).padded_mac_ratio(), 4)
+    except Exception as e:  # noqa: BLE001 -- accountability row must not kill the bench
+        padded_mac_ratio = f"error: {e!r}"
+
     # hardware parity smoke (round-2 VERDICT #5): pallas vs xla vs oracle on
     # a small SpGEMM, executed on whatever platform is live -- the committed
     # record that the real-chip kernel agrees with the oracle (unit tests
@@ -490,6 +501,8 @@ def _run(args) -> int:
             "result_nnzb": c.nnzb, "iters_s": [round(t, 3) for t in times],
             "single_spgemm_gflops": round(single_gflops, 2),
             "single_spgemm_pairs": int(join.pair_ptr[-1]),
+            "padded_mac_ratio": padded_mac_ratio,
+            "accum_route": knobs.get("SPGEMM_TPU_ACCUM_ROUTE"),
             "values_dist": args.dist, "multiply": args.multiply,
             "tpu_parity": tpu_parity,
             "phases_s": phases,

@@ -53,12 +53,94 @@ def _time_round(fn, args, flops, repeats=2):
     return best, flops / best / 1e9
 
 
+def _fanout_sweep(args) -> int:
+    """Dense vs ladder accumulator-route A/B over skewed synthetic
+    structures (SPGEMM_TPU_ACCUM_ROUTE, ISSUE 17): per swept fanout, a
+    hub-key structure one past a pow2 class boundary (the ladder's
+    worst-case ~1.5x pair padding, plus the key-axis pad on a non-ladder
+    key count) is planned BOTH ways through the real plan_rounds, both
+    kernels are timed on the planned arrays, and bit parity of every
+    real output row is asserted -- a parity miss exits nonzero."""
+    import jax
+    import jax.numpy as jnp
+
+    from spgemm_tpu.ops import u64
+    from spgemm_tpu.ops.spgemm import (numeric_round_dense_impl,
+                                       numeric_round_impl)
+    from spgemm_tpu.ops.symbolic import plan_rounds, symbolic_join
+
+    jit_ladder = jax.jit(numeric_round_impl)
+    jit_dense = jax.jit(numeric_round_dense_impl)
+    platform = jax.devices()[0].platform
+    k, K = args.k, 5  # 5 hub keys: pads to 6 on the batch key ladder
+    rng = np.random.default_rng(0)
+    fanouts = [5, 9, 33, 129, 513, 2049, 4097]
+    if args.quick:
+        fanouts = [9, 129, 2049]
+    bad = 0
+    for f in fanouts:
+        # K hub rows in A, each reaching f B-rows that all land in B col 0:
+        # K output keys of fanout exactly f, one fanout class per point
+        a_coords = np.array([(i, i * f + j) for i in range(K)
+                             for j in range(f)], np.int64)
+        b_coords = np.array([(m, 0) for m in range(K * f)], np.int64)
+        join = symbolic_join(a_coords, b_coords)
+        nnzb = K * f
+        common = dict(a_sentinel=nnzb, b_sentinel=nnzb, round_size=8192,
+                      batch=True, batch_entries=1 << 62)
+        (ladder,) = plan_rounds(join, route="ladder", **common)
+        (dense,) = plan_rounds(join, route="dense", **common)
+        tiles = rng.integers(0, 1 << 64, size=(nnzb + 1, k, k),
+                             dtype=np.uint64)
+        tiles[-1] = 0
+        hi, lo = map(jnp.asarray, u64.u64_to_hilo(tiles))
+        real_flops = 2.0 * dense.real_pairs * k ** 3
+        lt, lgf = _time_round(
+            jit_ladder, (hi, lo, hi, lo, jnp.asarray(ladder.pa),
+                         jnp.asarray(ladder.pb)), real_flops)
+        zeros = jnp.zeros((dense.out_rows + 1, k, k), jnp.uint32)
+        dt, dgf = _time_round(
+            jit_dense, (hi, lo, hi, lo, jnp.asarray(dense.pa),
+                        jnp.asarray(dense.pb), jnp.asarray(dense.seg),
+                        zeros, zeros), real_flops)
+        lh, ll = jit_ladder(hi, lo, hi, lo, jnp.asarray(ladder.pa),
+                            jnp.asarray(ladder.pb))
+        dh, dl = jit_dense(hi, lo, hi, lo, jnp.asarray(dense.pa),
+                           jnp.asarray(dense.pb), jnp.asarray(dense.seg),
+                           zeros, zeros)
+        n = len(ladder.key_index)
+        parity = bool(
+            np.array_equal(np.asarray(lh)[:n], np.asarray(dh)[:n])
+            and np.array_equal(np.asarray(ll)[:n], np.asarray(dl)[:n]))
+        bad += not parity
+        print(json.dumps({
+            "mode": "fanout-sweep", "fanout": f, "keys": K, "k": k,
+            "fanout_class": int(ladder.pa.shape[1]),
+            "platform": platform,
+            "padded_mac_ratio_ladder": round(ladder.padded_mac_ratio(), 3),
+            "padded_mac_ratio_dense": round(dense.padded_mac_ratio(), 3),
+            "ladder_ms": round(lt * 1e3, 2), "dense_ms": round(dt * 1e3, 2),
+            "ladder_gflops": round(lgf, 2), "dense_gflops": round(dgf, 2),
+            "dense_speedup": round(lt / dt, 2), "bit_parity": parity,
+        }), flush=True)
+    if bad:
+        print(f"fanout-sweep: {bad} point(s) FAILED bit parity",
+              file=sys.stderr)
+    return 1 if bad else 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true",
                    help="single shape instead of the full sweep")
     p.add_argument("--k", type=int, default=32)
+    p.add_argument("--fanout-sweep", action="store_true",
+                   help="dense vs ladder accumulator-route A/B over "
+                        "skewed hub structures (bit parity asserted)")
     args = p.parse_args()
+
+    if args.fanout_sweep:
+        return _fanout_sweep(args)
 
     import jax
     import jax.numpy as jnp

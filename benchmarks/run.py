@@ -545,6 +545,70 @@ def config_serve_batching():
             "value_parity": det["parity"]}
 
 
+def config_accum_route():
+    """Dense vs ladder accumulator-route A/B (SPGEMM_TPU_ACCUM_ROUTE):
+    a hub-skew structure whose single deep fanout class pays the ladder's
+    worst-case padded-MAC tax (fanout one past a pow2 boundary), multiplied
+    once per forced route leg in-process -- plan cache cleared between legs
+    (the knob is jit-static, each leg compiles its own executable).  Both
+    legs must be byte-identical to each other and to the oracle; the row
+    feeds the RESULTS.md padded-MAC column with both legs' ratios and the
+    dense leg's wall speedup."""
+    import jax
+    from spgemm_tpu.ops import plancache
+    from spgemm_tpu.ops.spgemm import plan as build_plan
+    from spgemm_tpu.ops.spgemm import resolve_backend, spgemm
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+    from spgemm_tpu.utils.semantics import spgemm_oracle
+
+    rng = np.random.default_rng(17)
+    k, K, f = 16, 5, 513  # fanout 513 -> class 768: ~1.5x pair padding
+    a_coords = np.array([(i, i * f + j) for i in range(K)
+                         for j in range(f)], np.int64)
+    b_coords = np.array([(m, 0) for m in range(K * f)], np.int64)
+    a = BlockSparseMatrix(
+        rows=K, cols=K * f, k=k, coords=a_coords,
+        tiles=rng.integers(0, 1 << 64, size=(len(a_coords), k, k),
+                           dtype=np.uint64))
+    b = BlockSparseMatrix(
+        rows=K * f, cols=1, k=k, coords=b_coords,
+        tiles=rng.integers(0, 1 << 64, size=(len(b_coords), k, k),
+                           dtype=np.uint64))
+    want = BlockSparseMatrix.from_dict(
+        a.rows, b.cols, k, spgemm_oracle(a.to_dict(), b.to_dict(), k))
+    backend = resolve_backend(None)
+    platform = jax.devices()[0].platform
+    legs = {}
+    # restore target read through the registry (KNB): the default is
+    # "auto", so re-exporting the resolved value is equivalent to unset
+    prev = knobs.get("SPGEMM_TPU_ACCUM_ROUTE")
+    try:
+        for route in ("ladder", "dense"):
+            os.environ["SPGEMM_TPU_ACCUM_ROUTE"] = route
+            plancache.clear()
+            plan = build_plan(a, b, backend=backend, platform=platform)
+            spgemm(a, b, backend=backend)  # warm/compile
+            t0 = time.perf_counter()
+            got = spgemm(a, b, backend=backend)
+            legs[route] = {"wall": time.perf_counter() - t0,
+                           "ratio": plan.padded_mac_ratio(), "got": got}
+    finally:
+        os.environ["SPGEMM_TPU_ACCUM_ROUTE"] = prev
+        plancache.clear()  # forced-route plans must not leak to later configs
+    lad, den = legs["ladder"], legs["dense"]
+    parity = bool(lad["got"] == want and den["got"] == want
+                  and np.array_equal(lad["got"].tiles, den["got"].tiles))
+    return {"config": "accum-route", "backend": backend,
+            "platform": platform,
+            "nnzb_a": a.nnzb, "nnzb_b": b.nnzb,
+            "wall_s": round(den["wall"], 4),
+            "wall_s_ladder": round(lad["wall"], 4),
+            "padded_mac_ratio": round(lad["ratio"], 3),
+            "padded_mac_ratio_dense": round(den["ratio"], 3),
+            "speedup_vs_ladder": round(lad["wall"] / den["wall"], 2),
+            "value_parity": parity}
+
+
 CONFIGS = {
     "random-1pct": config_random_1pct,
     "cage12": config_cage12,
@@ -559,6 +623,7 @@ CONFIGS = {
     "loader-scaling": config_loader_scaling,
     "pool-scaling": config_pool_scaling,
     "serve-batching": config_serve_batching,
+    "accum-route": config_accum_route,
 }
 
 
@@ -622,16 +687,16 @@ def write_table(rows, path=None):
              "round's `benchmarks/ROUND*_NOTES.md` records the capture "
              "context.",
              "",
-             "| config | backend | platform | wall s | eff. GFLOP/s | plan s (wait) | jobs/min | vs rowshard | parity |",
-             "|---|---|---|---|---|---|---|---|---|"]
+             "| config | backend | platform | wall s | eff. GFLOP/s | plan s (wait) | jobs/min | padded-MAC | vs rowshard | parity |",
+             "|---|---|---|---|---|---|---|---|---|---|"]
     for r in rows:
         if "error" in r:
             err = r["error"][:60].replace("|", "\\|")
-            lines.append(f"| {r['config']} | — | — | — | — | — | — | — | ERROR: {err} |")
+            lines.append(f"| {r['config']} | — | — | — | — | — | — | — | — | ERROR: {err} |")
             continue
         if "skipped" in r:
             note = r["skipped"][:60].replace("|", "\\|")
-            lines.append(f"| {r['config']} | — | — | — | — | — | — | — | skipped: {note} |")
+            lines.append(f"| {r['config']} | — | — | — | — | — | — | — | — | skipped: {note} |")
             continue
         par = ""
         if "value_parity" in r:
@@ -681,9 +746,19 @@ def write_table(rows, path=None):
             if r.get("speedup_vs_window0") is not None:
                 jobs_col += (f" ({r['speedup_vs_window0']:g}x vs "
                              "window=0)")
+        # padded-MAC column (accum-route A/B + any row that reports the
+        # ratio): shipped/real MAC tax under ladder, the dense route's
+        # residual stream-tail ratio, and the dense leg's wall speedup
+        mac_col = ""
+        if r.get("padded_mac_ratio") is not None:
+            mac_col = f"{r['padded_mac_ratio']:g}x"
+            if r.get("padded_mac_ratio_dense") is not None:
+                mac_col += f" → {r['padded_mac_ratio_dense']:g}x dense"
+            if r.get("speedup_vs_ladder") is not None:
+                mac_col += f" ({r['speedup_vs_ladder']:g}x faster)"
         lines.append(f"| {r['config']} | {r['backend']} | {r['platform']} | "
                      f"{r['wall_s']} | {gf or ''} | {plan_col} | {jobs_col} "
-                     f"| {ratio} | {par} |")
+                     f"| {mac_col} | {ratio} | {par} |")
     sweep = _sweep_section()
     if not sweep:
         # no sweep capture on disk (the evidence dir's sweep.txt is

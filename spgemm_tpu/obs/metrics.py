@@ -35,6 +35,8 @@ ENGINE_PHASES: dict[str, str] = {
     "symbolic_join": "host symbolic join over operand structures",
     "plan_rounds": "round bucketing + assembly permutation",
     "numeric_dispatch": "numeric kernel launches (host dispatch span)",
+    "dense_fold": "dense accumulator route: index-ordered segmented "
+                  "stream fold (SPGEMM_TPU_ACCUM_ROUTE)",
     "assembly": "on-device result assembly / OOC host landing",
     "stage_prep": "OOC staging worker: host gather/pack of one round",
     "ring_plan": "ring schedule planning",
@@ -59,6 +61,9 @@ ENGINE_PHASES: dict[str, str] = {
 # value.
 ENGINE_COUNTERS: dict[str, str] = {
     "dispatches": "numeric kernel launches",
+    "route_dense": "rounds dispatched on the dense accumulator route "
+                   "(forced by SPGEMM_TPU_ACCUM_ROUTE=dense or won by "
+                   "the auto gate, ops/crossover.dense_wins)",
     "ring_steps": "ring rotation steps executed",
     "dcn_chunks": "bounded DCN exchange chunks shipped",
     "plan_cache_hits": "structure-keyed plan cache hits",

@@ -154,3 +154,69 @@ def mxu_wins(numeric_exact, numeric_mxu, *, key: str, k: int, K: int,
                  hit["exact_s"], hit["mxu_s"],
                  "mxu" if hit["mxu_s"] < hit["exact_s"] else "exact")
     return hit["mxu_s"] < hit["exact_s"]
+
+
+# Structural dense-route threshold for the "proof" gate policy: with no
+# measured crossover available (off-TPU default), the auto accumulator
+# route takes the dense stream only where the ladder's padded-MAC ratio
+# clears this -- the padding tax is the one cost the structure alone can
+# prove, and below ~1.25x the stream fold's per-pair overhead is not
+# reliably amortized.
+DENSE_RATIO_GATE = 1.25
+
+
+def dense_wins(numeric_ladder, numeric_dense, *, key: str, k: int, K: int,
+               P: int, stream_len: int, nnzb: int = 2048,
+               policy: str = "auto", padded_ratio: float = 1.0) -> bool:
+    """True iff the dense segmented-fold kernel should replace the ladder
+    kernel for a round of this shape (the auto accumulator route's speed
+    gate, SPGEMM_TPU_ACCUM_ROUTE) -- the exact analog of mxu_wins: both
+    routes produce identical bits, so this is ONLY a wall-clock ranking.
+
+    Under the "auto" policy the first call per key measures both kernels
+    at the round's (K, P) / stream shape and persists {"ladder_s",
+    "dense_s"} into the shared crossover cache; later calls are a dict
+    lookup.  Under "proof" (the off-TPU default, where tests pin
+    deterministic routing and a CPU measurement says nothing about the
+    chip) the gate is structural: dense wins iff the ladder layout's
+    padded-MAC ratio clears DENSE_RATIO_GATE."""
+    if policy != "auto":
+        return padded_ratio >= DENSE_RATIO_GATE
+    cache = _load()
+    hit = cache.get(key)
+    if hit is None:
+        import jax.numpy as jnp  # noqa: PLC0415
+        import numpy as np  # noqa: PLC0415
+
+        K = min(K, 4096)
+        # multiple of 8, like every real stream (symbolic._stream_pad)
+        stream_len = -(-min(stream_len, 4096 * P) // 8) * 8
+        rng = np.random.default_rng(0)
+        plane = rng.integers(0, 1 << 32, size=(nnzb + 1, k, k),
+                             dtype=np.int64).astype(np.uint32)
+        plane[-1] = 0  # sentinel zero tile, as the engine guarantees
+        hi = jnp.asarray(plane)
+        lo = jnp.asarray(plane)
+        pa = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+        pb = jnp.asarray(rng.integers(0, nnzb, size=(K, P), dtype=np.int32))
+        # the dense leg times the STREAM the ladder round would flatten to
+        # (same real-MAC count lives in stream_len; rows cycle the K keys
+        # so the accumulator traffic pattern matches a real chunk)
+        spa = jnp.asarray(rng.integers(0, nnzb, size=stream_len,
+                                       dtype=np.int32))
+        spb = jnp.asarray(rng.integers(0, nnzb, size=stream_len,
+                                       dtype=np.int32))
+        seg = jnp.asarray(np.arange(stream_len, dtype=np.int32) % K)
+        zeros = jnp.zeros((K + 1, k, k), jnp.uint32)
+        hit = {
+            "ladder_s": _time_call(numeric_ladder, (hi, lo, hi, lo, pa, pb)),
+            "dense_s": _time_call(numeric_dense,
+                                  (hi, lo, hi, lo, spa, spb, seg,
+                                   zeros, zeros)),
+        }
+        cache[key] = hit
+        _save()
+        log.info("crossover %s: ladder=%.4fs dense=%.4fs -> %s", key,
+                 hit["ladder_s"], hit["dense_s"],
+                 "dense" if hit["dense_s"] < hit["ladder_s"] else "ladder")
+    return hit["dense_s"] < hit["ladder_s"]

@@ -140,6 +140,24 @@ def chain_mass(coords_list: list[np.ndarray]) -> float:
     return total
 
 
+def predicted_route(est: "StructureEstimate | None") -> str | None:
+    """Accumulator route the fanout histogram predicts for an estimated
+    plan ("dense" when any sampled shape class reaches the dense-eligible
+    floor, else "ladder"), or None when there is no estimate to read.
+
+    ADVISORY ONLY: plan_rounds re-proves the decision against the real
+    per-class fanouts once the exact join lands, so a misprediction can
+    never change routing semantics -- it only shows up as drift telemetry
+    (the `accum_route_mismatch` event in ops/spgemm._plan_host)."""
+    if est is None:
+        return None
+    from spgemm_tpu.ops.symbolic import DENSE_MIN_CLASS  # noqa: PLC0415
+
+    if any(cls >= DENSE_MIN_CLASS for cls in est.class_hist):
+        return "dense"
+    return "ladder"
+
+
 @dataclass
 class StructureEstimate:
     """Scaled prediction of one A x B output structure from a row sample.

@@ -147,6 +147,82 @@ _numeric_round = obs_profile.ProfiledJit("numeric_round",
                                          jax.jit(numeric_round_impl))
 
 
+def numeric_round_dense_impl(a_hi, a_lo, b_hi, b_lo, pa, pb, seg,
+                             acc_h, acc_l):
+    """Dense-route numeric round: index-ordered segmented fold over one
+    contiguous pair stream (SPGEMM_TPU_ACCUM_ROUTE, ops/symbolic dense
+    Round layout).
+
+    a_*/b_*    : (nnzb + 1, k, k) uint32 tile slabs (sentinel zero last).
+    pa, pb     : (L,) int32 slab indices -- the class chunk's per-key pair
+                 lists concatenated in key order (each list j-ascending),
+                 sentinel-padded to the fine stream ladder (L % 8 == 0).
+    seg        : (L,) int32 output row per stream slot; pad slots point at
+                 the scratch row n_rows.
+    acc_h/acc_l: (n_rows + 1, k, k) uint32 zeros -- the dense accumulator
+                 planes; the last row is the pad-slot scratch, dropped on
+                 return.
+    Returns (out_hi, out_lo): (n_rows, k, k) uint32.
+
+    The walk is strictly left-to-right along the stream, and within each
+    pair strictly j-ascending -- every output row's segment is contiguous,
+    so its MAC sequence is EXACTLY the ladder kernel's (pair, j) order for
+    that key: no reduction is ever reordered (FLD-clean, no escape), and
+    ladder/dense are bit-identical by construction.  Pad slots MAC the
+    sentinel zero tiles into the scratch row (mulmod(0, x) == 0,
+    addmod(acc, 0) == acc), so they cannot touch a real row.  Unlike the
+    ladder kernel there is no per-key padding: the padded-MAC tax is the
+    stream tail only (< 1/8).
+    """
+    k = a_hi.shape[-1]
+    L = pa.shape[0]
+
+    def _mac_j(ch, cl, th, tl, uh, ul, j):
+        return u64.mac(
+            ch, cl,
+            jax.lax.dynamic_slice_in_dim(th, j, 1, axis=1),
+            jax.lax.dynamic_slice_in_dim(tl, j, 1, axis=1),
+            jax.lax.dynamic_slice_in_dim(uh, j, 1, axis=0),
+            jax.lax.dynamic_slice_in_dim(ul, j, 1, axis=0),
+        )
+
+    def one_pair(i, acc_h, acc_l):
+        ia, ib, row = pa[i], pb[i], seg[i]
+        th, tl = a_hi[ia], a_lo[ia]  # (k, k)
+        uh, ul = b_hi[ib], b_lo[ib]
+        ch = jax.lax.dynamic_index_in_dim(acc_h, row, 0, keepdims=False)
+        cl = jax.lax.dynamic_index_in_dim(acc_l, row, 0, keepdims=False)
+        # same j-walk as the ladder kernel: unrolled at reference scales,
+        # a fori_loop beyond them (identical rationale -- compile size)
+        if k <= 32:
+            for j in range(k):
+                ch, cl = u64.mac(ch, cl,
+                                 th[:, j : j + 1], tl[:, j : j + 1],
+                                 uh[j : j + 1, :], ul[j : j + 1, :])
+        else:
+            ch, cl = jax.lax.fori_loop(
+                0, k, lambda j, c: _mac_j(*c, th, tl, uh, ul, j), (ch, cl))
+        return (jax.lax.dynamic_update_index_in_dim(acc_h, ch, row, 0),
+                jax.lax.dynamic_update_index_in_dim(acc_l, cl, row, 0))
+
+    # 4-pair blocks amortize the loop step overhead; the stream ladder
+    # guarantees L % 8 == 0 (symbolic._stream_pad), so no remainder exists.
+    # Pairs run sequentially inside the block -- the unroll changes loop
+    # bookkeeping only, never the fold order.
+    def body(s, acc):
+        acc_h, acc_l = acc
+        for u in range(4):
+            acc_h, acc_l = one_pair(s * 4 + u, acc_h, acc_l)
+        return acc_h, acc_l
+
+    acc_h, acc_l = jax.lax.fori_loop(0, L // 4, body, (acc_h, acc_l))
+    return acc_h[:-1], acc_l[:-1]
+
+
+_numeric_dense = obs_profile.ProfiledJit("numeric_round_dense",
+                                         jax.jit(numeric_round_dense_impl))
+
+
 def _assemble_impl(outs_h, outs_l, take):
     """Round-batched assembly: pad-concat the (whole, padded) round outputs,
     append one zero row, and gather both planes through the precomputed
@@ -496,6 +572,16 @@ def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
                 and est.est_max_fanout <= split):
             est_split = None
 
+        # the pure MXU backend is field-mode semantics end to end: never
+        # mix the (reference-mode) dense stream kernel into its rounds --
+        # every other backend lets plan_rounds read SPGEMM_TPU_ACCUM_ROUTE
+        route = "ladder" if backend == "mxu" else None
+        # pre-dispatch route prediction from the sampled fanout histogram
+        # (advisory only -- plan_rounds re-decides from the REAL per-class
+        # fanouts once the exact join lands, so a misprediction is drift
+        # telemetry, never a semantics change)
+        route_pred = estimate.predicted_route(est) if route is None else None
+
         def build_exact(p: SpgemmPlan, build_split,
                         score_est: bool = False) -> None:
             """Fill join/rounds/take in place from the exact symbolic
@@ -528,16 +614,29 @@ def _plan_host(a, b, *, round_size, backend, platform) -> SpgemmPlan:
                                          max_entries=max_entries,
                                          batch=True,
                                          batch_entries=_batch_entries(k),
-                                         split_fanout=build_split)
+                                         split_fanout=build_split,
+                                         route=route)
                 else:
                     rs = default_rs if round_size is None else round_size
                     rounds = plan_rounds(join, a_sentinel=a_nnzb,
                                          b_sentinel=b_nnzb, round_size=rs,
-                                         max_entries=max_entries)
+                                         max_entries=max_entries,
+                                         route=route)
                 # the assembly gather's inverse permutation is precomputed
                 # on host here, off the dispatch/assembly spans
                 take = assembly_permutation(rounds, join.num_keys) \
                     if batch else None
+            if route_pred is not None:
+                # re-proof accountability: compare the estimator's
+                # pre-dispatch route prediction against what the REAL
+                # fanouts planned -- a mismatch is an event, never a
+                # routing input (the rounds above already hold the truth)
+                real = ("dense" if any(r.route == "dense"
+                                       or r.dense_alt is not None
+                                       for r in rounds) else "ladder")
+                if real != route_pred:
+                    obs_events.emit("accum_route_mismatch",
+                                    predicted=route_pred, real=real)
             p.join, p.rounds, p.take = join, rounds, take
 
         p = SpgemmPlan(backend=backend, platform=platform, k=k,
@@ -603,6 +702,60 @@ def _observe_memory() -> None:
     obs_profile.observe_memory(stats)
 
 
+def _dense_dispatch(rnd, a, b, k, timers):
+    """One dense-route launch: zero accumulator planes + the segmented
+    stream fold (numeric_round_dense_impl).  The dense_fold sub-span and
+    route_dense counter make the route observable per dispatch."""
+    with timers.phase("dense_fold"):
+        zeros = jnp.zeros((rnd.out_rows + 1, k, k), jnp.uint32)
+        oh, ol = _numeric_dense(a.hi, a.lo, b.hi, b.lo,
+                                jnp.asarray(rnd.pa), jnp.asarray(rnd.pb),
+                                jnp.asarray(rnd.seg), zeros, zeros)
+    timers.incr("route_dense")
+    return oh, ol
+
+
+def _dense_gate(plan: SpgemmPlan, rnd, numeric_ladder) -> bool:
+    """Auto accumulator route, dispatch side: should this round run its
+    dense-stream twin?  The exact analog of the hybrid MXU gate --
+    measured per (key class, fanout class, k) under the 'auto' crossover
+    policy, structural (the round's padded-MAC ratio) under 'proof'.
+
+    This is the re-proof at dispatch: the decision keys off the round's
+    REAL ladder layout and REAL stream (both built from the exact join),
+    never off the estimate that steered planning -- an estimator miss can
+    shrink dense coverage (a deep class the sample missed planned without
+    a twin) but can never change semantics, because every route is
+    bit-exact and the gate only ranks wall clock."""
+    from spgemm_tpu.ops import crossover  # noqa: PLC0415
+    from spgemm_tpu.ops.symbolic import _shape_class  # noqa: PLC0415
+
+    policy = crossover.gate_policy(plan.platform)
+    Kc = min(_shape_class(rnd.pa.shape[0]), 4096)
+    P = rnd.pa.shape[1]
+    key = ""
+    if policy == "auto":
+        dev = jax.devices()[0]
+        key = (f"dense-v1:{dev.platform}:{dev.device_kind}:"
+               f"k{plan.k}:K{Kc}:P{P}")
+    return crossover.dense_wins(
+        numeric_ladder, _numeric_dense, key=key, k=plan.k, K=Kc, P=P,
+        stream_len=len(rnd.dense_alt.pa), policy=policy,
+        padded_ratio=rnd.padded_mac_ratio())
+
+
+def _dense_proof_ok(a, b, rnd, k: int) -> bool:
+    """Exactness-proof check for a forced-dense round under the hybrid
+    backend: the proof is a property of the fanout and operand bounds,
+    not of the kernel (all routes produce identical bits), so bound
+    propagation must keep counting rounds the stream fold ran."""
+    from spgemm_tpu.ops.mxu_spgemm import safe_exact_bound  # noqa: PLC0415
+
+    return (a.val_bound is not None and b.val_bound is not None
+            and safe_exact_bound(a.val_bound, b.val_bound,
+                                 rnd.max_fanout, k) is not None)
+
+
 def execute(plan: SpgemmPlan, a, b):
     """Device-only execution half of spgemm_device: kernel selection,
     numeric dispatch, on-device assembly.  Everything host-decidable lives
@@ -643,12 +796,28 @@ def execute(plan: SpgemmPlan, a, b):
         outs_h, outs_l, order = [], [], []
         for rnd in rounds:
             fn = numeric
-            if choose_numeric is not None:
+            used_mxu = False
+            dense = rnd if rnd.route == "dense" else None
+            if choose_numeric is not None and dense is not None:
+                # forced dense stream (SPGEMM_TPU_ACCUM_ROUTE=dense): the
+                # MXU speed gate never sees the round, but the exactness
+                # proof is kernel-independent -- keep bound propagation
+                proof_rounds += _dense_proof_ok(a, b, rnd, k)
+            elif choose_numeric is not None:
                 fn, used_mxu, proof_ok = choose_numeric(rnd)
                 mxu_rounds += used_mxu
                 proof_rounds += proof_ok
-            oh, ol = fn(a.hi, a.lo, b.hi, b.lo,
-                        jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
+            if dense is None and rnd.dense_alt is not None and not used_mxu:
+                # auto route: this round carries a dense twin and the
+                # exact (non-MXU) kernel would run -- let the measured
+                # crossover gate pick the layout (bit-exact either way)
+                if _dense_gate(plan, rnd, fn):
+                    dense = rnd.dense_alt
+            if dense is not None:
+                oh, ol = _dense_dispatch(dense, a, b, k, timers)
+            else:
+                oh, ol = fn(a.hi, a.lo, b.hi, b.lo,
+                            jnp.asarray(rnd.pa), jnp.asarray(rnd.pb))
             timers.incr("dispatches")
             if batch:
                 # outputs are consumed whole (padded tails included): the
@@ -891,13 +1060,17 @@ def subplan(parent: SpgemmPlan,
     parent.ensure_exact()
     sub_join, kept = slice_join(parent.join, keep)
     max_entries, default_rs = _plan_budgets(parent.backend, parent.platform)
+    # same accumulator-route rule as _plan_host: the knob is jit-static
+    # (stable per process), so the sub-plan re-derives the parent's route
+    sub_route = "ladder" if parent.backend == "mxu" else None
     if parent.batch:
         rounds = plan_rounds(sub_join, a_sentinel=parent.a_nnzb,
                              b_sentinel=parent.b_nnzb,
                              round_size=parent.round_size,
                              max_entries=max_entries, batch=True,
                              batch_entries=_batch_entries(parent.k),
-                             split_fanout=parent.split_fanout)
+                             split_fanout=parent.split_fanout,
+                             route=sub_route)
         take = assembly_permutation(rounds, sub_join.num_keys)
         # pad the assembly permutation to a 3/4-pow-2 rung: the dirty-key
         # count drifts per submit, and an exact-length take would compile
@@ -911,7 +1084,7 @@ def subplan(parent: SpgemmPlan,
         rs = default_rs if parent.round_size is None else parent.round_size
         rounds = plan_rounds(sub_join, a_sentinel=parent.a_nnzb,
                              b_sentinel=parent.b_nnzb, round_size=rs,
-                             max_entries=max_entries)
+                             max_entries=max_entries, route=sub_route)
         take = None
     sub = SpgemmPlan(backend=parent.backend, platform=parent.platform,
                      k=parent.k, a_nnzb=parent.a_nnzb,
@@ -1199,7 +1372,8 @@ def spgemm_outofcore(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
 
     with timers.phase("plan_rounds"):
         rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                             round_size=round_size, max_entries=max_entries)
+                             round_size=round_size, max_entries=max_entries,
+                             route="ladder")
 
     def host_prep(rnd):
         """Stage 1 (host-only): gather + pad one round's referenced tiles

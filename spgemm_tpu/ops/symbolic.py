@@ -229,14 +229,56 @@ class Round:
     """One fixed-shape numeric launch: <= round_size keys, all padded to the
     same fanout class.  The reference's 500-key round (sparse_matrix_mult.cu:181-185)
     generalized to (pow-4 key count) x (3/4-pow-2 fanout) shape classes so
-    the jit cache stays small."""
+    the jit cache stays small.
+
+    Two array layouts share this container (SPGEMM_TPU_ACCUM_ROUTE):
+
+      ladder (route='ladder'): pa/pb are (K_pad, P) -- each key's pair list
+        sentinel-padded to the fanout class width P.  The pre-route layout.
+      dense (route='dense'): pa/pb are (L,) -- the chunk's pair lists
+        concatenated in key order (each list already j-ascending) into one
+        contiguous stream, padded to the fine stream ladder (_stream_pad),
+        with seg mapping every stream slot to its output row (pad slots to
+        the scratch row n_rows).  No per-key padding: the padded-MAC tax
+        collapses to the stream tail.
+
+    Both layouts fold every output row's pairs in the identical
+    left-to-right j-ascending order, so they are bit-exact by construction.
+    An 'auto'-routed plan keeps the ladder layout here and its dense twin
+    in dense_alt; dispatch picks via the measured crossover gate."""
 
     key_index: np.ndarray  # (n,) int64 -- positions into JoinResult.keys
-    pa: np.ndarray         # (K_pad, P) int32 -- A slab indices (sentinel-padded)
-    pb: np.ndarray         # (K_pad, P) int32
+    pa: np.ndarray         # ladder: (K_pad, P) int32 (sentinel-padded);
+                           # dense: (L,) int32 pair stream
+    pb: np.ndarray         # same shape as pa
     max_fanout: int = 0    # real (unpadded) max fanout among the round's keys
                            # -- the hybrid exactness proof uses this, not the
                            # padded class width (sentinel pairs contribute 0)
+    route: str = "ladder"  # array layout: 'ladder' | 'dense'
+    seg: np.ndarray | None = None  # dense only: (L,) int32 output row per
+                                   # stream slot (pad slots -> n_rows)
+    n_rows: int = 0        # dense only: padded output-row count (the ladder
+                           # twin's K_pad, so assembly sees identical shapes)
+    real_pairs: int = 0    # unpadded pair count (padded_mac_ratio numerator)
+    dense_alt: "Round | None" = None  # auto route: the dense-stream twin
+
+    @property
+    def out_rows(self) -> int:
+        """Output rows this round's kernel produces (padded key count) --
+        the assembly-permutation row span, layout-independent."""
+        return self.pa.shape[0] if self.pa.ndim == 2 else self.n_rows
+
+    @property
+    def shipped_macs(self) -> int:
+        """Pair slots actually shipped to the kernel, padding included
+        (each slot costs k j-MACs, so slot counts compare 1:1)."""
+        return int(self.pa.size)
+
+    def padded_mac_ratio(self) -> float:
+        """Shipped / real pair slots (>= 1.0): the padded-MAC tax this
+        round pays.  An auto round reports its ladder layout; the dense
+        twin reports its own (stream-tail-only) ratio."""
+        return self.shipped_macs / self.real_pairs if self.real_pairs else 1.0
 
 
 def _ceil_pow2(x: int) -> int:
@@ -271,6 +313,49 @@ def _shape_class(x: int) -> int:
     return int(_shape_class_vec(np.array([x]))[0])
 
 
+# Smallest fanout class the auto accumulator route considers dense-eligible:
+# below it the ladder's padded-MAC tax is bounded (<= 1/3) and the per-key
+# vectorized kernel wins on key count; at and above it hub-row classes burn
+# enough sentinel MACs that the stream fold is worth carrying as a twin.
+DENSE_MIN_CLASS = 256
+
+
+def _stream_pad(n: int) -> int:
+    """Smallest fine-ladder value m * 2^e (m in 8..15, e >= 3) >= n: the
+    dense pair-stream pad target.  Eight rungs per octave keep the waste
+    under 1/8 on any stream past 64 pairs (vs up to ~1/2 per key on the
+    3/4-pow-2 ladder) while the compiled-shape count stays logarithmic;
+    every rung is a multiple of 8, so the fold kernel may unroll pair
+    blocks without a remainder loop."""
+    n = max(int(n), 1)
+    if n <= 8:
+        return 8
+    e = max((n - 1).bit_length() - 4, 3)
+    return -(-n // (1 << e)) << e
+
+
+def _dense_round(join: JoinResult, chunk: np.ndarray, lens: np.ndarray,
+                 rows: np.ndarray, src: np.ndarray, n_rows: int,
+                 a_sentinel: int, b_sentinel: int) -> Round:
+    """Build the dense-stream layout for one class chunk: the chunk's pair
+    lists concatenated in key order (rows/src from the caller's
+    _segment_expand -- the exact per-key j-ascending order the ladder
+    scatter uses), sentinel-padded to the fine stream ladder.  Pad slots
+    fold zero tiles into the scratch row n_rows, so they cannot touch any
+    real output row."""
+    real = len(src)
+    L = _stream_pad(real)
+    spa = np.full(L, a_sentinel, np.int32)
+    spb = np.full(L, b_sentinel, np.int32)
+    seg = np.full(L, n_rows, np.int32)
+    spa[:real] = join.pair_a[src]
+    spb[:real] = join.pair_b[src]
+    seg[:real] = rows
+    return Round(key_index=chunk, pa=spa, pb=spb,
+                 max_fanout=int(lens.max()), route="dense", seg=seg,
+                 n_rows=n_rows, real_pairs=real)
+
+
 def assembly_permutation(rounds: list["Round"], num_keys: int) -> np.ndarray:
     """Precomputed inverse permutation for the assembly gather.
 
@@ -279,12 +364,12 @@ def assembly_permutation(rounds: list["Round"], num_keys: int) -> np.ndarray:
     consumed whole, no per-round device slicing); the extra last entry maps
     the sentinel slot to a zero row appended after the concatenation.
     Host-side numpy, so the device assembly phase is exactly one gather."""
-    total = sum(r.pa.shape[0] for r in rounds)
+    total = sum(r.out_rows for r in rounds)
     inv = np.full(num_keys + 1, total, np.int64)
     off = 0
     for r in rounds:
         inv[r.key_index] = off + np.arange(len(r.key_index))
-        off += r.pa.shape[0]
+        off += r.out_rows
     return inv
 
 
@@ -419,13 +504,28 @@ class SpgemmPlan:
 
     def rowshard_rounds(self, round_size: int | None = None):
         """Memoized non-batch round plan for parallel/rowshard (one fixed
-        512-key round plan per explicit round_size)."""
+        512-key round plan per explicit round_size).  Always ladder: the
+        shard_map'ed kernel consumes (K, P) index arrays directly."""
         rs = 512 if round_size is None else round_size
         if rs not in self._rowshard:
             self._rowshard[rs] = plan_rounds(
                 self.ensure_exact().join, a_sentinel=self.a_nnzb,
-                b_sentinel=self.b_nnzb, round_size=rs)
+                b_sentinel=self.b_nnzb, round_size=rs, route="ladder")
         return self._rowshard[rs]
+
+    def padded_mac_ratio(self) -> float:
+        """Shipped / real pair slots across this plan's rounds (>= 1.0):
+        the padded-MAC tax the accumulator route is judged against.
+        Counts each auto round's dense twin where one exists (that is the
+        layout the route layer intends to dispatch); forces the exact
+        plan."""
+        rounds = self.ensure_exact().rounds or []
+        shipped = real = 0
+        for r in rounds:
+            eff = r.dense_alt if r.dense_alt is not None else r
+            shipped += eff.shipped_macs
+            real += eff.real_pairs
+        return shipped / real if real else 1.0
 
 
 def _smem_key_cap(P: int, max_entries: int) -> int:
@@ -462,7 +562,8 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
                 max_entries: int | None = None,
                 batch: bool = False,
                 batch_entries: int | None = None,
-                split_fanout: int | None = None) -> list[Round]:
+                split_fanout: int | None = None,
+                route: str | None = None) -> list[Round]:
     """Bucket output keys by fanout class and chop into fixed-shape rounds.
 
     a_sentinel/b_sentinel: index of the appended all-zero tile in each slab.
@@ -494,7 +595,23 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
     hybrid dispatcher's exactness proof is a fanout threshold, so this
     keeps proof granularity at the key level while still dispatching one
     launch per (class, kernel-choice) partition.
+
+    route: accumulator-route decision per class (SPGEMM_TPU_ACCUM_ROUTE;
+    None reads the knob).  'ladder' plans exactly the pre-route layout --
+    bytes identical, the whole-engine A/B.  'dense' forces the stream
+    layout for every class.  'auto' keeps the ladder layout and attaches
+    a dense-stream twin (Round.dense_alt) to classes >= DENSE_MIN_CLASS;
+    dispatch picks per round via the measured crossover gate.  The
+    decision keys off the REAL per-class fanouts of the exact join built
+    here -- never an estimate -- so an estimator miss can shrink dense
+    coverage but can never change fold semantics (every route is
+    bit-exact by construction).
     """
+    if route is None:
+        from spgemm_tpu.utils import knobs  # noqa: PLC0415
+        route = knobs.get("SPGEMM_TPU_ACCUM_ROUTE")
+    if route not in ("auto", "ladder", "dense"):
+        raise ValueError(f"unknown accumulator route {route!r}")
     if round_size is not None and round_size < 1:
         raise ValueError(f"round_size must be >= 1, got {round_size}")
     if round_size is None and not batch:
@@ -565,16 +682,24 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
                     while K_pad < K:
                         K_pad *= 4
                     K_pad = min(K_pad, chunk_cap)
-                pa = np.full((K_pad, P), a_sentinel, dtype=np.int32)
-                pb = np.full((K_pad, P), b_sentinel, dtype=np.int32)
-                # scatter each key's pair list into its row (vectorized)
                 lens = fan[chunk]
                 rows, cols = _segment_expand(lens)
                 src = np.repeat(join.pair_ptr[chunk], lens) + cols
+                if route == "dense":
+                    rounds.append(_dense_round(join, chunk, lens, rows, src,
+                                               K_pad, a_sentinel, b_sentinel))
+                    continue
+                pa = np.full((K_pad, P), a_sentinel, dtype=np.int32)
+                pb = np.full((K_pad, P), b_sentinel, dtype=np.int32)
+                # scatter each key's pair list into its row (vectorized)
                 pa[rows, cols] = join.pair_a[src]
                 pb[rows, cols] = join.pair_b[src]
-                rounds.append(Round(key_index=chunk, pa=pa, pb=pb,
-                                    max_fanout=int(lens.max())))
+                rnd = Round(key_index=chunk, pa=pa, pb=pb,
+                            max_fanout=int(lens.max()), real_pairs=len(src))
+                if route == "auto" and P >= DENSE_MIN_CLASS:
+                    rnd.dense_alt = _dense_round(join, chunk, lens, rows, src,
+                                                 K_pad, a_sentinel, b_sentinel)
+                rounds.append(rnd)
     return rounds
 
 
@@ -583,7 +708,9 @@ def plan_rounds(join: JoinResult, a_sentinel: int, b_sentinel: int,
 # or layout change: the warm-start store (ops/warmstore) refuses to decode
 # a mismatched version -- a version-skewed on-disk entry must be a counted
 # cold fallback, never a half-parsed plan.
-PLAN_CODEC_VERSION = 1
+# v2: accumulator-route fields (Round.route/seg/n_rows/real_pairs and the
+# auto route's dense_alt twin).
+PLAN_CODEC_VERSION = 2
 
 # SpgemmPlan scalar fields packed into the "scalars" int64 array, in order
 # (None encodes as -1 for the two optional ints; batch as 0/1).
@@ -627,10 +754,25 @@ def plan_to_arrays(plan: SpgemmPlan) -> dict | None:
     }
     if plan.take is not None:
         out["take"] = plan.take
+    # per-round accumulator-route metadata (codec v2): layout flag, padded
+    # row count, real pair count, dense-twin presence -- one int64 vector
+    # per round, plus the stream arrays where a dense layout exists
     for i, r in enumerate(plan.rounds):
         out[f"r{i}_key_index"] = r.key_index
         out[f"r{i}_pa"] = r.pa
         out[f"r{i}_pb"] = r.pb
+        out[f"r{i}_route"] = np.array(
+            [int(r.route == "dense"), r.n_rows, r.real_pairs,
+             int(r.dense_alt is not None)], np.int64)
+        if r.seg is not None:
+            out[f"r{i}_seg"] = r.seg
+        if r.dense_alt is not None:
+            alt = r.dense_alt
+            out[f"r{i}_alt_pa"] = alt.pa
+            out[f"r{i}_alt_pb"] = alt.pb
+            out[f"r{i}_alt_seg"] = alt.seg
+            out[f"r{i}_alt_meta"] = np.array(
+                [alt.n_rows, alt.real_pairs], np.int64)
     return out
 
 
@@ -653,11 +795,31 @@ def plan_from_arrays(d, fingerprint: str | None = None) -> SpgemmPlan:
     max_fan = np.asarray(d["round_max_fanout"], np.int64)
     if len(max_fan) != s["num_rounds"]:
         raise ValueError("round count does not match the scalars header")
-    rounds = [Round(key_index=np.asarray(d[f"r{i}_key_index"], np.int64),
+    rounds = []
+    for i in range(s["num_rounds"]):
+        is_dense, n_rows, real_pairs, has_alt = (
+            int(v) for v in np.asarray(d[f"r{i}_route"]))
+        rnd = Round(key_index=np.asarray(d[f"r{i}_key_index"], np.int64),
                     pa=np.asarray(d[f"r{i}_pa"], np.int32),
                     pb=np.asarray(d[f"r{i}_pb"], np.int32),
-                    max_fanout=int(max_fan[i]))
-              for i in range(s["num_rounds"])]
+                    max_fanout=int(max_fan[i]),
+                    route="dense" if is_dense else "ladder",
+                    n_rows=n_rows, real_pairs=real_pairs)
+        if is_dense:
+            rnd.seg = np.asarray(d[f"r{i}_seg"], np.int32)
+            if rnd.pa.ndim != 1 or len(rnd.seg) != len(rnd.pa):
+                raise ValueError("malformed dense-round stream arrays")
+        if has_alt:
+            alt_rows, alt_real = (int(v)
+                                  for v in np.asarray(d[f"r{i}_alt_meta"]))
+            rnd.dense_alt = Round(
+                key_index=rnd.key_index,
+                pa=np.asarray(d[f"r{i}_alt_pa"], np.int32),
+                pb=np.asarray(d[f"r{i}_alt_pb"], np.int32),
+                max_fanout=int(max_fan[i]), route="dense",
+                seg=np.asarray(d[f"r{i}_alt_seg"], np.int32),
+                n_rows=alt_rows, real_pairs=alt_real)
+        rounds.append(rnd)
     take = np.asarray(d["take"], np.int64) if s["has_take"] else None
     a_coords = np.asarray(d["a_coords"], np.int64)
     b_coords = np.asarray(d["b_coords"], np.int64)

@@ -140,7 +140,8 @@ def spgemm_inner(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     a_hi, a_lo = pack_tiles(a)
     b_hi, b_lo = pack_tiles(b)
     rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                         round_size=512 if round_size is None else round_size)
+                         round_size=512 if round_size is None else round_size,
+                         route="ladder")  # sharded fold needs the pair grid
     # proven bounded operands ride the ~6x cheaper b32 MAC (val_bound gate,
     # same proof discipline as the exact engine's nomod route)
     fold = _make_sharded_fold(mesh, u64.operands_below_2_32(a, b))

@@ -78,7 +78,8 @@ def spgemm_sharded(a: BlockSparseMatrix, b: BlockSparseMatrix, *,
     b_hi, b_lo = pack_tiles(b)
     rounds = plan.rowshard_rounds(round_size) if plan is not None \
         else plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
-                         round_size=512 if round_size is None else round_size)
+                         round_size=512 if round_size is None else round_size,
+                         route="ladder")  # key-axis shard needs the pair grid
 
     out = np.zeros((join.num_keys, k, k), dtype=np.uint64)
     for rnd in rounds:

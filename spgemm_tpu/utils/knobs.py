@@ -71,6 +71,23 @@ _KNOBS = (
          "MXU limb-kernel pair width R (whole-engine A/B, like the VPU "
          "knobs).",
          "ops/spgemm.py", default="8", minimum=1, jit_static=True),
+    Knob("SPGEMM_TPU_ACCUM_ROUTE", "enum",
+         "Accumulator route for the exact fold (the whole-engine A/B): "
+         "ladder = every key pads its pair axis to the 3/4-pow-2 fanout "
+         "class (the pre-route engine -- bytes AND dispatch counts "
+         "identical); dense = every class ships as ONE contiguous pair "
+         "stream plus a segment vector, folded strictly left-to-right into "
+         "a dense per-output-tile-row accumulator (index-ordered segmented "
+         "fold -- the same wrap-then-mod MAC order per output row, no "
+         "padded-key or padded-fanout MACs); auto = deep classes "
+         "(>= DENSE_MIN_CLASS) carry both layouts and dispatch picks per "
+         "(key class, fanout class, k) via the measured crossover gate "
+         "(ops/crossover.dense_wins), exactly like the hybrid MXU gate.  "
+         "Bit-identical on every input by construction.  The pure 'mxu' "
+         "field-mode backend and the sharded strategies (ring/rowshard/"
+         "out-of-core) always plan ladder.",
+         "ops/symbolic.py", default="auto",
+         choices=("auto", "ladder", "dense"), jit_static=True),
     Knob("SPGEMM_TPU_ROUND_BATCH", "bool01",
          "Round-batched dispatch: 1 = one mega-launch per fanout class x "
          "kernel choice + fused single-gather assembly, 0 = legacy "

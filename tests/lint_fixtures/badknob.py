@@ -94,3 +94,10 @@ def bad_warm_knob_reads():
     d = os.getenv("SPGEMM_TPU_WARM_DIR")  # seeded KNB
     mb = environ["SPGEMM_TPU_WARM_MAX_MB"]  # seeded KNB
     return on, d, mb
+
+
+def bad_accum_route_knob_read():
+    # the accumulator-route knob is a registry knob like any other: a
+    # raw read is a KNB finding (registered in utils/knobs.py, read via
+    # knobs.get in ops/symbolic.py)
+    return os.environ.get("SPGEMM_TPU_ACCUM_ROUTE", "auto")  # seeded KNB

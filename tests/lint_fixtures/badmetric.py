@@ -70,3 +70,18 @@ def bad_batch_layer_names():
 def legal_batch_names():
     timers.incr("serve_batches")  # legal: declared batch counter
     timers.incr("serve_batched_jobs")  # legal: declared batch counter
+
+
+def bad_dense_route_names():
+    # the dense accumulator route's series ride the same registries: a
+    # truncated near-miss of the declared counter and an ad-hoc fold
+    # phase are findings
+    timers.incr("route_den")  # MET: undeclared dense counter
+    with timers.phase("dense_folding"):  # MET: undeclared dense phase
+        pass
+
+
+def legal_dense_route_names(x):
+    with timers.phase("dense_fold"):  # legal: declared dense phase
+        timers.incr("route_dense")  # legal: declared dense counter
+        return x

@@ -310,3 +310,110 @@ def test_ring_mass_balance_result_unchanged(monkeypatch):
     off = spgemm_ring(a, b)
     assert on.tiles.tobytes() == off.tiles.tobytes()
     assert on == off == _oracle(a, b)
+
+
+# --------------------------------------- accumulator-route advisory (PR 17)
+
+
+def test_predicted_route_reads_class_hist():
+    """predicted_route: None estimate -> None; any sampled shape class at
+    or past DENSE_MIN_CLASS -> 'dense'; else 'ladder'.  Pure histogram
+    read -- no backend, no join."""
+    from spgemm_tpu.ops.symbolic import DENSE_MIN_CLASS
+
+    assert estimate.predicted_route(None) is None
+
+    def _est(hist):
+        return estimate.StructureEstimate(
+            total_rows=100, sampled_rows=10, scale=10.0, est_keys=50.0,
+            est_pairs=5000.0, est_max_fanout=8, class_hist=hist,
+            confidence=1.0)
+
+    assert estimate.predicted_route(_est({4: 40.0, 8: 6.0})) == "ladder"
+    assert estimate.predicted_route(_est({})) == "ladder"
+    assert estimate.predicted_route(
+        _est({4: 40.0, DENSE_MIN_CLASS: 1.0})) == "dense"
+
+
+def test_estimator_route_misprediction_is_telemetry_only(monkeypatch):
+    """An estimator-routed plan whose evenly-spaced row sample misses the
+    one hub row predicts 'ladder'; the real fanouts attach the dense twin
+    anyway (the re-proof at plan_rounds runs off the exact join, never the
+    prediction), the result stays byte-exact, and the drift lands ONLY as
+    an accum_route_mismatch event."""
+    from spgemm_tpu.obs import events as obs_events
+
+    # 64 A tile-rows; row 5 is a 300-wide hub (output class 384, past
+    # DENSE_MIN_CLASS), everything else fanout 4.  A 4-row evenly spaced
+    # sample lands on rows {0, 21, 42, 63} (np.linspace over the sorted
+    # row set) -- never the hub -- and the sampled rows' equal pair mass
+    # keeps confidence at 1, so the estimate steers the plan.
+    rng = np.random.default_rng(91)
+    coords, base = [], 0
+    for r in range(64):
+        f = 300 if r == 5 else 4
+        coords += [(r, base + j) for j in range(f)]
+        base += f
+    k = 2
+    a_coords = np.array(coords, np.int64)
+    b_coords = np.array([(m, 0) for m in range(base)], np.int64)
+    a = BlockSparseMatrix(
+        rows=64, cols=base, k=k, coords=a_coords,
+        tiles=rng.integers(0, 1 << 64, size=(len(a_coords), k, k),
+                           dtype=np.uint64))
+    b = BlockSparseMatrix(
+        rows=base, cols=1, k=k, coords=b_coords,
+        tiles=rng.integers(0, 1 << 64, size=(len(b_coords), k, k),
+                           dtype=np.uint64))
+    monkeypatch.setenv("SPGEMM_TPU_ACCUM_ROUTE", "auto")
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "1")
+    monkeypatch.setenv("SPGEMM_TPU_EST_SAMPLE_ROWS", "4")
+    obs_events.LOG.clear()
+    p = plan(a, b)
+    assert p.estimate is not None
+    assert estimate.predicted_route(p.estimate) == "ladder"  # the miss
+    rounds = p.ensure_exact().rounds
+    assert any(r.route == "dense" or r.dense_alt is not None
+               for r in rounds)  # the re-proof caught the hub
+    drift = [e for e in obs_events.LOG.tail(200)
+             if e["kind"] == "accum_route_mismatch"]
+    assert drift and drift[-1]["predicted"] == "ladder" \
+        and drift[-1]["real"] == "dense"
+    est_leg = spgemm(a, b)
+    plancache.clear()
+    monkeypatch.setenv("SPGEMM_TPU_PLAN_ESTIMATE", "0")
+    exact_leg = spgemm(a, b)
+    assert est_leg.tiles.tobytes() == exact_leg.tiles.tobytes()
+    assert est_leg == exact_leg == _oracle(a, b)
+
+
+def test_dense_gate_cache_hit_skips_measurement(monkeypatch, tmp_path):
+    """A persisted {ladder_s, dense_s} crossover entry routes the auto
+    dense gate by dict lookup alone -- the kernel callables are never
+    touched -- and the verdict follows the persisted ranking; the proof
+    policy stays structural (DENSE_RATIO_GATE on the padded ratio)."""
+    import json
+
+    from spgemm_tpu.ops import crossover
+
+    monkeypatch.setenv("SPGEMM_TPU_CROSSOVER_CACHE", str(tmp_path))
+    key = "dense-v1:cpu:TestDev:k4:K256:P384"
+    shape = dict(key=key, k=4, K=256, P=384, stream_len=2048)
+
+    def _boom(*_a):
+        raise AssertionError("kernel measurement ran on a cache hit")
+
+    (tmp_path / "hybrid_crossover.json").write_text(
+        json.dumps({key: {"ladder_s": 1.0, "dense_s": 0.1}}))
+    crossover._CACHE.clear()  # drop the path-keyed memo: re-read disk
+    assert crossover.dense_wins(_boom, _boom, policy="auto", **shape) is True
+
+    (tmp_path / "hybrid_crossover.json").write_text(
+        json.dumps({key: {"ladder_s": 0.1, "dense_s": 1.0}}))
+    crossover._CACHE.clear()
+    assert crossover.dense_wins(_boom, _boom, policy="auto", **shape) is False
+
+    assert crossover.dense_wins(_boom, _boom, policy="proof",
+                                padded_ratio=1.28, **shape) is True
+    assert crossover.dense_wins(_boom, _boom, policy="proof",
+                                padded_ratio=1.1, **shape) is False

@@ -118,7 +118,7 @@ def test_knb_fixture_each_violation_caught():
     the same fixture (how harnesses and tests drive knob values) must NOT
     be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 21
+    assert [f.rule for f in findings] == ["KNB"] * 22
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
                    "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
@@ -135,7 +135,8 @@ def test_knb_fixture_each_violation_caught():
                    "SPGEMM_TPU_WARM", "SPGEMM_TPU_WARM_DIR",
                    "SPGEMM_TPU_WARM_MAX_MB",
                    "SPGEMM_TPU_SERVE_BATCH_K",
-                   "SPGEMM_TPU_SERVE_BATCH_WINDOW_S"):
+                   "SPGEMM_TPU_SERVE_BATCH_WINDOW_S",
+                   "SPGEMM_TPU_ACCUM_ROUTE"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -218,7 +219,7 @@ def test_met_fixture_each_violation_caught():
     declared names and ad-hoc PhaseTimers instances stay legal."""
     findings = lint_file(os.path.join(FIXTURES, "badmetric.py"))
     met = [f for f in findings if f.rule == "MET"]
-    assert len(met) == 8 and findings == met
+    assert len(met) == 10 and findings == met
     flagged = [f.line for f in met]
     for needle in ("MET: undeclared phase name",
                    "MET: undeclared counter name",
@@ -227,7 +228,9 @@ def test_met_fixture_each_violation_caught():
                    "MET: undeclared profile phase",
                    "MET: undeclared warm counter",
                    "MET: undeclared warm phase",
-                   "MET: undeclared batch counter"):
+                   "MET: undeclared batch counter",
+                   "MET: undeclared dense counter",
+                   "MET: undeclared dense phase"):
         assert _fixture_lines("badmetric.py", needle)[0] in flagged
     msgs = " ".join(f.message for f in met)
     assert "made_up_phase" in msgs and "made_up_counter" in msgs
@@ -237,12 +240,17 @@ def test_met_fixture_each_violation_caught():
     # the warm-start near-misses: the singular of the declared counter
     # and an ad-hoc load phase
     assert "warm_hit" in msgs and "warm_loading" in msgs
+    # the dense-route near-misses: the truncated counter name and an
+    # ad-hoc fold phase
+    assert "route_den" in msgs and "dense_folding" in msgs
     assert "ENGINE_PHASES" in msgs and "ENGINE_COUNTERS" in msgs
     for needle in ("legal: declared phase", "legal: declared counter",
                    "legal: not the ENGINE registry",
                    "legal: declared warm phase",
                    "legal: declared warm counter",
-                   "legal: declared batch counter"):
+                   "legal: declared batch counter",
+                   "legal: declared dense phase",
+                   "legal: declared dense counter"):
         assert _fixture_lines("badmetric.py", needle)[0] not in flagged
 
 
@@ -1420,13 +1428,13 @@ def test_json_report_fixture_run():
     # two-root write + nested-def two-site root + loop-spawned
     # multi-instance root; stalesup: one stale escape per family (6);
     # badmetric: undeclared phase + undeclared counter + computed name
-    # + 2 deep-profiling + 2 warm-layer + 1 batch-layer near-misses;
-    # badfailpoint: 2
+    # + 2 deep-profiling + 2 warm-layer + 1 batch-layer + 2 dense-route
+    # near-misses; badfailpoint: 2
     # undeclared + 1 computed (the stale-registry direction stays quiet
     # -- the registry module is not in the fixture unit set)
-    assert report["counts"] == {"FLD": 9, "KNB": 21, "BKD": 5, "THR": 3,
+    assert report["counts"] == {"FLD": 9, "KNB": 22, "BKD": 5, "THR": 3,
                                 "LCK": 2, "BLK": 3, "TSI": 3,
-                                "EXC": 3, "MET": 8, "FPT": 3, "DOC": 1,
+                                "EXC": 3, "MET": 10, "FPT": 3, "DOC": 1,
                                 "SUP": 6, "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:

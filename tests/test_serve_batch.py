@@ -277,3 +277,50 @@ def test_drr_fairness_decides_membership_before_formation(tmp_path,
     # DRR ran before batch formation, so chatty couldn't fill it
     assert quiet in batch_calls[0]
     assert len(batch_calls[0]) <= 4
+
+
+# ------------------------------------------- dense rounds run solo --
+def test_dense_rounds_take_solo_fallback(monkeypatch):
+    """ISSUE-17 interplay: the fused batched path only stacks the
+    planner's 2-D ladder rounds along the job axis, so a forced-dense
+    plan's 1-D pair streams trip the existing solo-fallback guard in
+    ops/spgemm.execute_batched -- every job runs per-pair execute with
+    identical bytes (never a crash, never a mis-stacked stream)."""
+    from spgemm_tpu.ops.spgemm import execute, execute_batched, plan
+
+    monkeypatch.setenv("SPGEMM_TPU_ACCUM_ROUTE", "dense")
+    monkeypatch.setenv("SPGEMM_TPU_DELTA", "0")  # count real dispatches
+    plancache.clear()
+    k, K, f = 2, 2, 40
+    a_coords = np.array([(i, i * f + j) for i in range(K)
+                         for j in range(f)], np.int64)
+    b_coords = np.array([(m, 0) for m in range(K * f)], np.int64)
+
+    def _pair(seed):
+        r = np.random.default_rng(seed)
+        a = BlockSparseMatrix(
+            rows=K, cols=K * f, k=k, coords=a_coords,
+            tiles=r.integers(0, 1 << 64, size=(len(a_coords), k, k),
+                             dtype=np.uint64))
+        b = BlockSparseMatrix(
+            rows=K * f, cols=1, k=k, coords=b_coords,
+            tiles=r.integers(0, 1 << 64, size=(len(b_coords), k, k),
+                             dtype=np.uint64))
+        return a, b
+
+    pairs = [_pair(s) for s in (1, 2, 3)]
+    p = plan(*pairs[0])
+    rounds = p.ensure_exact().rounds
+    assert any(rnd.pa.ndim != 2 for rnd in rounds)  # the guard's predicate
+    solo = [execute(p, a, b) for a, b in pairs]
+    ENGINE.reset()
+    batched = execute_batched(p, list(pairs))
+    counters = ENGINE.counter_snapshot()
+    # solo fallback: one dispatch per (job, round), not one per round
+    assert counters["dispatches"] == len(pairs) * len(rounds)
+    assert counters.get("route_dense", 0) >= len(pairs)
+    for s, g in zip(solo, batched):
+        assert np.array_equal(s.coords, g.coords)
+        assert np.asarray(s.hi).tobytes() == np.asarray(g.hi).tobytes()
+        assert np.asarray(s.lo).tobytes() == np.asarray(g.lo).tobytes()
+    plancache.clear()

@@ -195,3 +195,174 @@ def test_symbolic_join_beyond_uint64_lexsort_fallback(monkeypatch):
     j2 = symbolic_join(a2, b2)
     assert j2.num_keys == 1
     assert list(a2[j2.pair_a, 1]) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Accumulator routes (SPGEMM_TPU_ACCUM_ROUTE): the dense segmented-stream
+# fold and the padded ladder must produce byte-identical planes on every
+# structure (same per-key j-ascending fold order, different layout only),
+# and the auto gate must actually take the dense route on a deep class.
+
+
+def _hub_pair(k=4, keys=2, fanout=300, seed=170):
+    """`keys` hub output rows of the given fanout.  fanout 300 lands in
+    shape class 384 -- a 1.28x padded-MAC ratio, past the structural
+    dense gate (crossover.DENSE_RATIO_GATE) and past DENSE_MIN_CLASS."""
+    rng = np.random.default_rng(seed)
+    a_coords = np.array([(i, i * fanout + j) for i in range(keys)
+                         for j in range(fanout)], np.int64)
+    b_coords = np.array([(m, 0) for m in range(keys * fanout)], np.int64)
+    a = BlockSparseMatrix(
+        rows=keys, cols=keys * fanout, k=k, coords=a_coords,
+        tiles=rng.integers(0, 1 << 64, size=(len(a_coords), k, k),
+                           dtype=np.uint64))
+    b = BlockSparseMatrix(
+        rows=keys * fanout, cols=1, k=k, coords=b_coords,
+        tiles=rng.integers(0, 1 << 64, size=(len(b_coords), k, k),
+                           dtype=np.uint64))
+    return a, b
+
+
+def _skew_pair(k=2, seed=7):
+    from spgemm_tpu.utils.gen import powerlaw_block_sparse
+    rng = np.random.default_rng(seed)
+    return (powerlaw_block_sparse(32, k, 3.0, rng, "adversarial"),
+            powerlaw_block_sparse(32, k, 3.0, rng, "adversarial"))
+
+
+def _shallow_pair(k=4, seed=3):
+    """Every fanout class below DENSE_MIN_CLASS: auto attaches no twin."""
+    rng = np.random.default_rng(seed)
+    return (random_block_sparse(6, 6, k, 0.4, rng, "adversarial"),
+            random_block_sparse(6, 6, k, 0.4, rng, "adversarial"))
+
+
+def _empty_pair(k=4, seed=9):
+    """Structurally empty product (A's cols never meet B's rows)."""
+    rng = np.random.default_rng(seed)
+    a = BlockSparseMatrix(
+        rows=2, cols=4, k=k, coords=np.array([(0, 0), (1, 1)], np.int64),
+        tiles=rng.integers(0, 1 << 64, size=(2, k, k), dtype=np.uint64))
+    b = BlockSparseMatrix(
+        rows=4, cols=2, k=k, coords=np.array([(2, 0), (3, 1)], np.int64),
+        tiles=rng.integers(0, 1 << 64, size=(2, k, k), dtype=np.uint64))
+    return a, b
+
+
+@pytest.mark.parametrize("make_pair", [_hub_pair, _skew_pair,
+                                       _shallow_pair, _empty_pair],
+                         ids=["hub", "skew", "shallow", "empty"])
+def test_accum_route_bytes_identical(monkeypatch, make_pair):
+    """auto | dense | ladder: identical bytes on every structure (the PR's
+    bit-exactness contract) and all equal to the oracle.  The knob is
+    jit-static, so each leg plans from a cleared cache."""
+    from spgemm_tpu.ops import plancache
+
+    a, b = make_pair()
+    want = spgemm_oracle(a.to_dict(), b.to_dict(), a.k)
+    want_m = BlockSparseMatrix.from_dict(a.rows, b.cols, a.k, want)
+    legs = {}
+    for route in ("ladder", "dense", "auto"):
+        monkeypatch.setenv("SPGEMM_TPU_ACCUM_ROUTE", route)
+        plancache.clear()
+        legs[route] = spgemm(a, b)
+    plancache.clear()
+    for route, got in legs.items():
+        assert np.array_equal(got.coords, want_m.coords), route
+        assert got.tiles.tobytes() == want_m.tiles.tobytes(), route
+    assert legs["dense"].tiles.tobytes() == legs["ladder"].tiles.tobytes()
+    assert legs["auto"].tiles.tobytes() == legs["ladder"].tiles.tobytes()
+
+
+def test_dense_round_stream_invariants():
+    """route='dense' plan_rounds: one 1-D pair stream per fanout class,
+    padded to a multiple of 8 (_stream_pad), seg mapping real slots to
+    their output row and pad slots to the scratch row n_rows, and the
+    stream walking each key's pairs j-ascending (the fold order)."""
+    a, b = _hub_pair(keys=3, fanout=300)
+    join = symbolic_join(a.coords, b.coords)
+    rounds = plan_rounds(join, a_sentinel=a.nnzb, b_sentinel=b.nnzb,
+                         route="dense")
+    assert rounds, "hub structure must produce at least one round"
+    covered = []
+    for rnd in rounds:
+        assert rnd.route == "dense"
+        assert rnd.pa.ndim == rnd.pb.ndim == rnd.seg.ndim == 1
+        L = rnd.pa.shape[0]
+        assert L == rnd.pb.shape[0] == rnd.seg.shape[0]
+        assert L % 8 == 0
+        # n_rows is the ladder twin's K_pad: >= the real key count, and
+        # out_rows reports it so assembly sees identical shapes per route
+        assert rnd.out_rows == rnd.n_rows >= len(rnd.key_index)
+        real = rnd.real_pairs
+        assert 0 < real <= L
+        assert np.all(rnd.seg[:real] < len(rnd.key_index))
+        assert np.all(rnd.seg[real:] == rnd.n_rows)  # scratch row
+        assert np.all(rnd.pa[real:] == a.nnzb)       # sentinel pad
+        assert np.all(rnd.pb[real:] == b.nnzb)
+        assert rnd.padded_mac_ratio() == L / real
+        # reassemble each key's pair list from the stream: contiguous,
+        # j-ascending, exactly the join's list (fold order untouched)
+        for row, ki in enumerate(rnd.key_index):
+            s, e = join.pair_ptr[ki], join.pair_ptr[ki + 1]
+            mask = rnd.seg[:real] == row
+            assert list(rnd.pa[:real][mask]) == list(join.pair_a[s:e])
+            assert list(rnd.pb[:real][mask]) == list(join.pair_b[s:e])
+        covered.extend(rnd.key_index)
+    assert sorted(covered) == list(range(join.num_keys))
+
+
+def test_ladder_route_is_pre_dense_plan(monkeypatch):
+    """SPGEMM_TPU_ACCUM_ROUTE=ladder restores the exact pre-dense engine:
+    every round keeps the 2-D pair grid, no dense twin is attached, no
+    dense dispatch fires, and dispatch counts match the plan's rounds."""
+    from spgemm_tpu.ops import plancache
+    from spgemm_tpu.ops.spgemm import plan as build_plan
+    from spgemm_tpu.utils.timers import ENGINE
+
+    monkeypatch.setenv("SPGEMM_TPU_ACCUM_ROUTE", "ladder")
+    plancache.clear()
+    a, b = _hub_pair()
+    p = build_plan(a, b)
+    rounds = p.ensure_exact().rounds
+    assert all(r.route == "ladder" and r.pa.ndim == 2 for r in rounds)
+    assert all(r.seg is None and r.dense_alt is None for r in rounds)
+    ENGINE.reset()
+    spgemm(a, b)
+    counters = ENGINE.counter_snapshot()
+    assert counters.get("route_dense", 0) == 0
+    assert counters["dispatches"] == len(rounds)
+    plancache.clear()
+
+
+def test_auto_gate_takes_dense_on_deep_class(monkeypatch):
+    """auto on CPU runs the structural proof gate (crossover policy
+    'proof'): the hub class's 1.28x padded ratio clears DENSE_RATIO_GATE,
+    so the round must dispatch dense (route_dense fires) with bytes equal
+    to the forced-ladder leg -- the gate changes wall clock, never bits."""
+    from spgemm_tpu.ops import plancache
+    from spgemm_tpu.ops.spgemm import plan as build_plan
+    from spgemm_tpu.utils.timers import ENGINE
+
+    a, b = _hub_pair()
+    monkeypatch.setenv("SPGEMM_TPU_ACCUM_ROUTE", "auto")
+    plancache.clear()
+    p = build_plan(a, b)
+    rounds = p.ensure_exact().rounds
+    deep = [r for r in rounds if r.dense_alt is not None]
+    assert deep, "class 384 must carry a dense twin under auto"
+    for r in deep:
+        assert r.route == "ladder" and r.dense_alt.route == "dense"
+        # the twin folds the same real pairs into the same padded row span
+        assert r.dense_alt.real_pairs == round(r.pa.size
+                                               / r.padded_mac_ratio())
+        assert r.dense_alt.n_rows == r.pa.shape[0]
+        assert r.dense_alt.padded_mac_ratio() < r.padded_mac_ratio()
+    ENGINE.reset()
+    auto_out = spgemm(a, b)
+    assert ENGINE.counter_snapshot().get("route_dense", 0) >= 1
+    monkeypatch.setenv("SPGEMM_TPU_ACCUM_ROUTE", "ladder")
+    plancache.clear()
+    ladder_out = spgemm(a, b)
+    assert auto_out.tiles.tobytes() == ladder_out.tiles.tobytes()
+    plancache.clear()
