@@ -14,7 +14,8 @@ machine-readable report object on stdout:
    "cache": {"enabled", "dir"?, "hits"?, "misses"?, "invalidations"?},
    "clean": bool}
 (the suppression inventory lists EVERY escape-hatch comment in the run --
-fld-proof / thr-ok / exc-ok / lck-ok / blk-ok / tsi-ok -- with stale=true
+fld-proof / thr-ok / exc-ok / lck-ok / blk-ok / tsi-ok / drf-ok -- with
+stale=true
 for an escape that no longer suppresses anything; a stale escape is also a
 SUP finding).  --sarif F additionally writes a SARIF 2.1.0 log to F
 (`make lint-sarif`), with suppressed findings carried as results bearing
@@ -53,7 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
                     "LCK lock-order deadlock detection, BLK blocking-under-"
                     "lock, TSI thread-shared inference, EXC exception "
                     "contracts, MET metric registry, FPT failpoint "
-                    "registry, SUP stale suppressions, DOC doc drift)",
+                    "registry, PRO wire-protocol registry, EVT event-kind "
+                    "registry, DRF registry drift, SUP stale "
+                    "suppressions, DOC doc drift)",
         epilog=epilog)
     p.add_argument("paths", nargs="*",
                    help="files/dirs to lint (default: the spgemm_tpu "
@@ -85,6 +88,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="regenerate the ARCHITECTURE.md thread-inventory "
                         "block from the concurrency pass (LCK/BLK/TSI) "
                         "over the default scope and exit")
+    p.add_argument("--write-protocol-table", action="store_true",
+                   help="regenerate the ARCHITECTURE.md wire-protocol "
+                        "table block from the serve/protocol.py registry "
+                        "and exit")
+    p.add_argument("--write-event-table", action="store_true",
+                   help="regenerate the ARCHITECTURE.md event-kind table "
+                        "block from the obs/events.py EVENT_KINDS "
+                        "registry and exit")
     p.add_argument("--no-cache", action="store_true",
                    help="disable the content-hash per-file result cache "
                         "(.lint_cache/; the default run caches)")
@@ -124,7 +135,8 @@ def main(argv: list[str] | None = None) -> int:
     root = core.repo_root()
     default_claude = os.path.join(root, "CLAUDE.md")
     if args.write_knob_table or args.write_metrics_table \
-            or args.write_thread_inventory:
+            or args.write_thread_inventory or args.write_protocol_table \
+            or args.write_event_table:
         # the flags compose: "regenerate everything" must not silently
         # leave a later table stale behind an earlier early return
         rc = 0
@@ -145,6 +157,18 @@ def main(argv: list[str] | None = None) -> int:
                                                      "ARCHITECTURE.md"),
                 docrules.THREAD_TABLE_BEGIN, docrules.THREAD_TABLE_END,
                 docrules.render_thread_block(), "thread inventory"))
+        if args.write_protocol_table:
+            rc = max(rc, _write_block(
+                args.architecture_md or os.path.join(root,
+                                                     "ARCHITECTURE.md"),
+                docrules.PROTOCOL_TABLE_BEGIN, docrules.PROTOCOL_TABLE_END,
+                docrules.render_protocol_block(), "protocol table"))
+        if args.write_event_table:
+            rc = max(rc, _write_block(
+                args.architecture_md or os.path.join(root,
+                                                     "ARCHITECTURE.md"),
+                docrules.EVENT_TABLE_BEGIN, docrules.EVENT_TABLE_END,
+                docrules.render_event_block(), "event table"))
         return rc
 
     if args.paths:

@@ -56,8 +56,10 @@ EXC_ESCAPE = "spgemm-lint: exc-ok("
 LCK_ESCAPE = "spgemm-lint: lck-ok("
 BLK_ESCAPE = "spgemm-lint: blk-ok("
 TSI_ESCAPE = "spgemm-lint: tsi-ok("
+DRF_ESCAPE = "spgemm-lint: drf-ok("
 ESCAPE_MARKERS = {"FLD": FLD_ESCAPE, "THR": THR_ESCAPE, "EXC": EXC_ESCAPE,
-                  "LCK": LCK_ESCAPE, "BLK": BLK_ESCAPE, "TSI": TSI_ESCAPE}
+                  "LCK": LCK_ESCAPE, "BLK": BLK_ESCAPE, "TSI": TSI_ESCAPE,
+                  "DRF": DRF_ESCAPE}
 
 # The rule-id registry: single source for the CLI --help epilog, the JSON
 # counts object, and the SARIF tool.driver.rules metadata (docrules checks
@@ -98,9 +100,25 @@ RULES = {
            "declared in the failpoint registry "
            "spgemm_tpu/utils/failpoints.py, or a registry entry with no "
            "check() site anywhere in the package (stale chaos surface)",
+    "PRO": "wire-contract violation against the serve/protocol.py "
+           "registry: an undeclared request/response field literal for "
+           "the op in play, an unknown op in a message literal, an "
+           "error code that is not a declared ERROR_CODES value, a "
+           "hardcoded protocol version (rolling-upgrade hazard), or an "
+           "incoherent registry (request/response op mismatch, a "
+           "post-v1 field missing its FIELD_MIN_VERSION entry, E_* "
+           "constants out of sync with ERROR_CODES)",
+    "EVT": "emit()/LOG.emit() event kind that is not a string literal "
+           "declared in the event registry spgemm_tpu/obs/events.py "
+           "EVENT_KINDS (no ad-hoc event streams)",
+    "DRF": "registry drift (the reverse audit): a declared knob never "
+           "read through knobs.get(), an ENGINE phase/counter or metric "
+           "family never referenced, an event kind never emitted, or a "
+           "protocol field / error code never referenced anywhere in "
+           "the package; escape: drf-ok(<reason>)",
     "DOC": "generated doc drift (CLAUDE.md knob table, ARCHITECTURE.md "
-           "metrics table, CLI help knob coverage, analysis --help "
-           "rule-id coverage)",
+           "metrics + protocol + event tables, CLI help knob coverage, "
+           "analysis --help rule-id coverage)",
     "SUP": "stale suppression: an escape-hatch comment whose underlying "
            "finding no longer exists (delete the escape)",
     "PARSE": "file does not parse (no other rule ran on it)",
@@ -125,7 +143,7 @@ class Suppression:
 
     file: str
     line: int
-    rule: str    # escape family (FLD | THR | EXC | LCK | BLK | TSI)
+    rule: str    # escape family (FLD | THR | EXC | LCK | BLK | TSI | DRF)
     reason: str
     stale: bool
 
@@ -239,7 +257,7 @@ def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
     needs to tell used escapes from stale ones, and the suppressed
     findings with their justifications (the SARIF suppressions surface)."""
     from spgemm_tpu.analysis import (excrules, fptrules, metrules,  # noqa: PLC0415
-                                     rules, thrrules)
+                                     protorules, rules, thrrules)
 
     if unit.tree is None:
         return [unit.parse_finding], set(), []
@@ -271,6 +289,13 @@ def _lint_unit(unit: LintUnit) -> tuple[list[Finding],
     findings += escaping(excrules.check_exc(unit, set()), "EXC")
     findings += metrules.check_met(unit.tree, unit.file)
     findings += fptrules.check_fpt(unit.tree, unit.file)
+    # the registry modules never self-report: protocol.py speaks no op
+    # (no import of itself, so PRO self-gates) and events.py's own emit
+    # machinery is the registry, not a call site
+    if not p.endswith(protorules.PROTOCOL_SUFFIX):
+        findings += protorules.check_pro(unit.tree, unit.file)
+    if not p.endswith(protorules.EVENTS_SUFFIX):
+        findings += protorules.check_evt(unit.tree, unit.file)
     return findings, raw, suppressed
 
 
@@ -289,11 +314,14 @@ DEFAULT_CACHE_DIR = ".lint_cache"
 
 # registry modules the CACHED per-file rules validate against: MET reads
 # ENGINE_PHASES/ENGINE_COUNTERS from obs/metrics.py, FPT reads REGISTRY
-# from utils/failpoints.py -- a registry edit must invalidate every
-# cached entry even when the call sites' own files are untouched, so
-# both are part of the linter-version signature (paths relative to the
-# spgemm_tpu package root)
-_SIGNATURE_EXTRAS = ("obs/metrics.py", "utils/failpoints.py")
+# from utils/failpoints.py, PRO reads the field/op/error tables from
+# serve/protocol.py, EVT reads EVENT_KINDS from obs/events.py -- a
+# registry edit must invalidate every cached entry even when the call
+# sites' own files are untouched, so all four are part of the
+# linter-version signature (paths relative to the spgemm_tpu package
+# root)
+_SIGNATURE_EXTRAS = ("obs/metrics.py", "utils/failpoints.py",
+                     "serve/protocol.py", "obs/events.py")
 
 
 def _analysis_signature() -> str:
@@ -497,7 +525,7 @@ def lint_run(paths: list[str], *, claude_md: str | None = None,
     optionally the DOC drift checks (claude_md None = skip the table
     checks; the CLI/analysis help checks ride the same flag)."""
     from spgemm_tpu.analysis import (callgraph, docrules,  # noqa: PLC0415
-                                     fptrules, lockrules)
+                                     fptrules, lockrules, protorules)
 
     units = [LintUnit(f) for path in paths for f in _walk_py(path)]
     units_by_file = {u.file: u for u in units}
@@ -528,6 +556,23 @@ def lint_run(paths: list[str], *, claude_md: str | None = None,
     # entry is live if ANY module checks it); it self-gates on the
     # registry module being in scope, so fixture runs stay quiet
     findings += fptrules.check_fpt_registry(units)
+    # the PRO registry-coherence direction (self-gated the same way)
+    findings += protorules.check_pro_registry(units)
+    # DRF: the reverse (drift) audit over every registry, raw findings
+    # filtered through drf-ok escapes at the registry declaration lines
+    # and fed to the suppression audit like any escapable family
+    drf_raw = protorules.check_drf(units)
+    drf_findings = []
+    for f in drf_raw:
+        unit = units_by_file.get(f.file)
+        escapes = unit.escapes.get("DRF", {}) if unit is not None else {}
+        if escape_at(escapes, f.line) is None:
+            drf_findings.append(f)
+    findings += drf_findings
+    report.suppressed += _escaped_split(drf_findings, drf_raw,
+                                        units_by_file, "DRF")
+    for f in drf_raw:
+        raw.add((f.file, "DRF", f.line))
     # package-level passes: interprocedural FLD taint, then the
     # concurrency-soundness pass (lock order / blocking-under-lock /
     # thread-shared inference) over the same call graph.  Their raw
@@ -593,6 +638,8 @@ def lint_run(paths: list[str], *, claude_md: str | None = None,
             if os.path.exists(arch) or doc_dir == _posix(repo_root()) \
                     or doc_dir == repo_root():
                 findings += docrules.check_architecture_md(arch)
+                findings += docrules.check_protocol_table(arch)
+                findings += docrules.check_event_table(arch)
                 findings += docrules.check_thread_inventory(arch,
                                                             inv_rows)
         findings += docrules.check_cli_help()

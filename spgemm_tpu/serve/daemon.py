@@ -1741,9 +1741,9 @@ class Daemon:
         if op == "submit":
             return self._op_submit(msg)
         if op == "status":
-            return self._op_status(msg, wait=False)
+            return self._op_status(msg)
         if op == "wait":
-            return self._op_status(msg, wait=True)
+            return self._op_wait(msg)
         if op == "stats":
             return self._op_stats()
         if op == "metrics":
@@ -1886,14 +1886,22 @@ class Daemon:
         return protocol.ok(id=job.id, state=job.state, queued=depth,
                            trace=job.trace_id)
 
-    def _op_status(self, msg: dict, wait: bool) -> dict:
-        job_id = msg.get("id")
+    def _op_status(self, msg: dict) -> dict:
+        return self._job_answer(msg.get("id"))
+
+    def _op_wait(self, msg: dict) -> dict:
+        # split from _op_status so the PRO wire-contract rule can
+        # attribute the `timeout` field to the `wait` op's table
+        return self._job_answer(msg.get("id"), wait=True,
+                                timeout=msg.get("timeout"))
+
+    def _job_answer(self, job_id, wait: bool = False,
+                    timeout=None) -> dict:
         job = self.queue.get(job_id) if isinstance(job_id, str) else None
         if job is None:
             return protocol.error(protocol.E_UNKNOWN_JOB,
                                   f"no such job: {job_id!r}")
         if wait:
-            timeout = msg.get("timeout")
             try:
                 timeout = self.MAX_WAIT_SLICE_S if timeout is None \
                     else min(float(timeout), self.MAX_WAIT_SLICE_S)

@@ -88,16 +88,68 @@ PROTOCOL_VERSION = 3
 # unchanged, so old clients keep working against a new daemon
 ACCEPTED_VERSIONS = (1, 2, 3)
 
-# THE capability table (one per protocol growth, not one ad-hoc stamp
-# per call site): each optional request field -> the lowest protocol
-# version whose daemons understand it.  Clients consult version_for()
-# to stamp the lowest version carrying their request's fields (a
-# still-v2 daemon's strict version check must keep serving an upgraded
-# client that uses no v3 feature), and strip_for_version() to shed
-# too-new fields when a version-mismatch answer forces a downgrade
+# THE declarative wire registry (one table per direction, not one ad-hoc
+# literal per call site): op -> field -> the lowest protocol version
+# whose daemons understand it.  The PRO lint rule
+# (analysis/protorules.py) holds every literal wire-field key in
+# serve/daemon.py / serve/client.py / cli.py to these tables, the DRF
+# audit flags a declared field no site references, version_for()/
+# strip_for_version() derive from them, and the generated
+# ARCHITECTURE.md protocol table renders them -- a new field lands HERE
+# first or the linter rejects the call site.
+
+# fields any message may carry regardless of op (the envelope)
+ENVELOPE_FIELDS = {"v": 1, "op": 1, "ok": 1, "error": 1}
+
+REQUEST_FIELDS: dict[str, dict[str, int]] = {
+    "submit": {"folder": 1, "options": 1, "tenant": 2, "trace": 3},
+    "status": {"id": 1},
+    "wait": {"id": 1, "timeout": 1},
+    "stats": {},
+    "metrics": {},
+    "trace": {},
+    "profile": {},
+    "events": {"n": 1},
+    "slo": {},
+    "shutdown": {},
+}
+
+# response fields are never stripped (the daemon answers at its own
+# version and old clients ignore unknown keys), so a min version here
+# documents the introduction point rather than driving negotiation
+RESPONSE_FIELDS: dict[str, dict[str, int]] = {
+    "submit": {"id": 1, "state": 1, "queued": 1, "trace": 3},
+    "status": {"job": 1},
+    "wait": {"job": 1},
+    "stats": {"daemon": 1, "uptime_s": 1, "degraded": 1,
+              "degrade_reason": 1, "backend_probe": 1, "queue_cap": 1,
+              "job_timeout_s": 1, "jobs": 1, "jobs_terminal": 1,
+              "slices": 2, "slices_degraded": 2, "tenants": 2,
+              "tenant_inflight_cap": 2, "placement": 2, "journal": 1,
+              "failpoints": 1, "trace": 3, "events": 3, "profile": 3,
+              "slo": 3, "flight_dir": 3, "plan_cache": 1, "delta": 1,
+              "warm": 1, "socket": 1},
+    "metrics": {"content_type": 1, "text": 1},
+    "trace": {"spans": 1, "trace_events": 1},
+    "profile": {"profile": 1},
+    "events": {"events": 1, "log": 1},
+    "slo": {"slo": 1},
+    "shutdown": {"stopping": 1},
+}
+
+# the one negotiation input, DERIVED from the request tables: each
+# post-v1 optional field -> its carrying version.  Clients consult
+# version_for() to stamp the lowest version carrying their request's
+# fields (a still-v2 daemon's strict version check must keep serving an
+# upgraded client that uses no v3 feature), and strip_for_version() to
+# shed too-new fields when a version-mismatch answer forces a downgrade
 # (the daemon then supplies the field's fallback: default tenant,
-# minted trace).
-FIELD_MIN_VERSION = {"tenant": 2, "trace": 3}
+# minted trace).  The PRO registry audit holds a field name spelled in
+# several ops to ONE min version, so this flattening cannot be lossy.
+FIELD_MIN_VERSION: dict[str, int] = {
+    f: v for fields in REQUEST_FIELDS.values()
+    for f, v in fields.items() if v > 1
+}
 
 
 def version_for(msg: dict) -> int:
@@ -139,8 +191,7 @@ DEFAULT_TENANT = "default"
 # keys): bound the charset and length at admission
 TENANT_MAX_LEN = 64
 
-OPS = ("submit", "status", "wait", "stats", "metrics", "trace", "profile",
-       "events", "slo", "shutdown")
+OPS = tuple(REQUEST_FIELDS)
 
 # server-side bound on one request line: a peer streaming newline-free
 # bytes must exhaust THIS, not the daemon's memory (real requests are a
@@ -153,24 +204,75 @@ MAX_LINE_BYTES = 1 << 20
 # degraded mode)
 CHAIN_BACKENDS = ("xla", "pallas", "mxu", "hybrid")
 
+# the structured error-code registry: code -> doc.  The E_* constants
+# below are the call-site spellings; the PRO registry audit holds the
+# constants and this table to set equality, and the DRF audit flags a
+# code no site raises or compares against.
+ERROR_CODES: dict[str, str] = {
+    "bad-request": "unparsable line, unknown op, bad version, or a "
+                   "field that failed admission validation",
+    "queue-full": "admission control rejection "
+                  "(SPGEMM_TPU_SERVE_QUEUE_CAP jobs already queued)",
+    "tenant-cap": "per-tenant in-flight cap rejection "
+                  "(SPGEMM_TPU_SERVE_TENANT_INFLIGHT)",
+    "too-many-connections": "concurrent-connection bound hit",
+    "unknown-job": "status/wait for a job id the daemon does not know",
+    "shutting-down": "submit refused while the daemon drains",
+    "internal-error": "handler crash (the daemon survives it)",
+    "daemon-unavailable": "client-side: no daemon reachable after the "
+                          "bounded connect-retry window "
+                          "(ECONNREFUSED/ENOENT through a restart "
+                          "rollout, retried with capped backoff, then "
+                          "THIS, structured, instead of a raw OSError)",
+    "job-timeout": "job reaped past SPGEMM_TPU_SERVE_JOB_TIMEOUT "
+                   "(in a failed job's error dict)",
+    "executor-died": "executor thread died or wedged mid-job "
+                     "(in a failed job's error dict)",
+    "job-error": "the chain runner raised "
+                 "(in a failed job's error dict)",
+}
+
 # request-level error codes
-E_BAD_REQUEST = "bad-request"      # unparsable line / unknown op / bad version
-E_QUEUE_FULL = "queue-full"        # admission control rejection
-E_TENANT_CAP = "tenant-cap"        # per-tenant in-flight cap rejection
-E_BUSY = "too-many-connections"    # concurrent-connection bound hit
+E_BAD_REQUEST = "bad-request"
+E_QUEUE_FULL = "queue-full"
+E_TENANT_CAP = "tenant-cap"
+E_BUSY = "too-many-connections"
 E_UNKNOWN_JOB = "unknown-job"
 E_SHUTTING_DOWN = "shutting-down"
-E_INTERNAL = "internal-error"      # handler crash (daemon survives)
-# client-side code: no daemon reachable after the bounded connect-retry
-# window (serve/client.py -- ECONNREFUSED/ENOENT during a restart
-# rollout retry with capped exponential backoff, then THIS, structured,
-# instead of a raw OSError mid-rollout)
+E_INTERNAL = "internal-error"
+# client-side code (serve/client.py mints it, never the daemon)
 E_UNAVAILABLE = "daemon-unavailable"
 
 # job-failure codes (in a failed job's error dict)
-E_JOB_TIMEOUT = "job-timeout"      # reaped past SPGEMM_TPU_SERVE_JOB_TIMEOUT
-E_EXECUTOR_DIED = "executor-died"  # executor thread died/wedged mid-job
-E_JOB_ERROR = "job-error"          # the chain runner raised
+E_JOB_TIMEOUT = "job-timeout"
+E_EXECUTOR_DIED = "executor-died"
+E_JOB_ERROR = "job-error"
+
+
+def protocol_table_md() -> str:
+    """The generated wire-contract table for ARCHITECTURE.md (the DOC
+    rule diffs the committed block against this; regenerate with
+    `python -m spgemm_tpu.analysis --write-protocol-table`)."""
+    def cell(fields: dict[str, int]) -> str:
+        if not fields:
+            return "—"
+        return ", ".join(f"`{name}`" + (f" (v{v}+)" if v > 1 else "")
+                         for name, v in fields.items())
+
+    lines = [f"Protocol v{PROTOCOL_VERSION} (accepts "
+             f"{'/'.join(f'v{a}' for a in ACCEPTED_VERSIONS)}); every "
+             f"message also carries the envelope fields "
+             f"{', '.join(f'`{f}`' for f in ENVELOPE_FIELDS)}.",
+             "",
+             "| op | request fields | response fields |",
+             "|---|---|---|"]
+    for op in OPS:
+        lines.append(f"| `{op}` | {cell(REQUEST_FIELDS[op])} "
+                     f"| {cell(RESPONSE_FIELDS[op])} |")
+    lines += ["", "| error code | meaning |", "|---|---|"]
+    for code, doc in ERROR_CODES.items():
+        lines.append(f"| `{code}` | {doc} |")
+    return "\n".join(lines)
 
 
 # tenant charset: safe as a Prometheus label value and a stats dict key

@@ -395,6 +395,7 @@ _KNOBS = (
          "TPU evidence capture directory (unset: benchmarks/evidence); "
          "read by benchmarks/run.py and tpu_evidence.sh.",
          "benchmarks/run.py"),
+    # spgemm-lint: drf-ok(shell-side knob: read by benchmarks/tpu_evidence.sh, never by Python)
     Knob("SPGEMM_TPU_EVIDENCE_STEPS", "str",
          "Comma-separated tpu_evidence.sh step list (shell-side knob; a "
          "full default list does not arm the strict gates).",

@@ -36,3 +36,8 @@ def unblocked():
 def unshared():
     # spgemm-lint: tsi-ok(seeded-stale: no thread-shared write here)
     return 4
+
+
+def undrifted():
+    # spgemm-lint: drf-ok(seeded-stale: no registry declaration here)
+    return 5

@@ -362,6 +362,233 @@ def test_fpt_registry_covers_live_call_sites():
                for fp in REGISTRY.values())
 
 
+# ------------------------------------------------------------- PRO rule --
+def test_pro_fixture_each_violation_caught():
+    """Undeclared request/response fields (with and without op context),
+    an unknown op, a hardcoded version stamp, undeclared error codes at
+    raise and compare sites, and an undeclared E_* constant are
+    findings; declared fields/codes and unconventional receiver names
+    stay legal."""
+    findings = lint_file(os.path.join(FIXTURES, "badproto.py"))
+    pro = [f for f in findings if f.rule == "PRO"]
+    assert len(pro) == 9 and findings == pro
+    flagged = [f.line for f in pro]
+    for needle in ("PRO: undeclared request field for status",
+                   "PRO: undeclared request field `priority`",
+                   "PRO: undeclared error code at a raise site",
+                   "PRO: undeclared response field",
+                   "PRO: undeclared error code on a code-flavored",
+                   "PRO: undeclared error-code constant"):
+        # seed comments sit on the finding's line or the line above
+        # (dict-literal findings anchor on the literal's first line)
+        line = _fixture_lines("badproto.py", needle)[0]
+        assert line in flagged or line + 1 in flagged, needle
+    # the worse-dict line carries BOTH the unknown-op and the
+    # hardcoded-version findings
+    (worse_line,) = _fixture_lines("badproto.py", '{"op": "frobnicate"')
+    assert flagged.count(worse_line) == 2
+    msgs = " ".join(f.message for f in pro)
+    assert "flavor" in msgs and "verbose" in msgs and "priority" in msgs
+    assert "frobnicate" in msgs and "version_for" in msgs
+    assert "went-sideways" in msgs and "transient-blip" in msgs
+    assert "E_NOPE" in msgs and "REQUEST_FIELDS" in msgs
+    for needle in ("legal: declared status request field",
+                   "legal: declared submit fields + envelope",
+                   "legal: envelope field",
+                   "legal: declared response field"):
+        assert _fixture_lines("badproto.py", needle)[0] not in flagged
+    out_of_scope = _fixture_lines("badproto.py", "return record.get")[0]
+    assert out_of_scope not in flagged
+
+
+def test_pro_registry_coherence_audit(tmp_path):
+    """The package-level PRO direction audits the LIVE registry
+    (request/response op symmetry, min versions in range, one version
+    per field name, post-v1 fields in FIELD_MIN_VERSION, E_* constants
+    matching ERROR_CODES both ways), anchored at the registry module's
+    declaration lines -- and gates on protocol.py itself being in the
+    unit set, so partial trees stay quiet."""
+    from spgemm_tpu.analysis.core import lint_report
+
+    src = open(os.path.join(REPO, "spgemm_tpu", "serve",
+                            "protocol.py")).read()
+    # the real registry at the real suffix: coherent, zero PRO findings
+    pkg = tmp_path / "serve"
+    pkg.mkdir()
+    (pkg / "protocol.py").write_text(src)
+    findings, _ = lint_report([str(pkg)], doc=False)
+    assert [f for f in findings if f.rule == "PRO"] == []
+    # a wrong-suffix copy never gates the audit on
+    (tmp_path / "notprotocol.py").write_text(src)
+    findings, _ = lint_report([str(tmp_path / "notprotocol.py")],
+                              doc=False)
+    assert [f for f in findings if f.rule == "PRO"] == []
+
+
+def test_pro_registry_audit_catches_incoherence(tmp_path, monkeypatch):
+    """Seed the live tables with every incoherence class and watch the
+    audit flag each: a request-only op, an out-of-range min version, a
+    field name carrying two versions across ops, and a post-v1 field
+    missing from FIELD_MIN_VERSION (the rolling-upgrade hazard)."""
+    from spgemm_tpu.analysis.core import lint_report
+    from spgemm_tpu.serve import protocol
+
+    bad_requests = dict(protocol.REQUEST_FIELDS)
+    bad_requests["phantom"] = {"thing": 9}       # no response half; v9
+    bad_requests["status"] = {"id": 2}           # 'id' is 1 elsewhere
+    bad_requests["wait"] = {"id": 1, "timeout": 1, "rush": 3}  # no FMV
+    monkeypatch.setattr(protocol, "REQUEST_FIELDS", bad_requests)
+    pkg = tmp_path / "serve"
+    pkg.mkdir()
+    (pkg / "protocol.py").write_text(open(os.path.join(
+        REPO, "spgemm_tpu", "serve", "protocol.py")).read())
+    findings, _ = lint_report([str(pkg)], doc=False)
+    msgs = " ".join(f.message for f in findings if f.rule == "PRO")
+    assert "'phantom'" in msgs and "only one of" in msgs
+    assert "outside 1..PROTOCOL_VERSION" in msgs
+    assert "two min versions" in msgs
+    assert "rolling-upgrade hazard" in msgs and "'rush'" in msgs
+
+
+def test_pro_guard_deletion_on_daemon_copy(tmp_path):
+    """Guard-deletion spot-check: the pristine daemon lints PRO-clean,
+    and a typo'd response kwarg on a copy goes red -- deleting or
+    misspelling a wire field cannot land silently."""
+    src = open(os.path.join(REPO, "spgemm_tpu", "serve",
+                            "daemon.py")).read()
+    p = tmp_path / "daemon.py"
+    p.write_text(src)
+    clean = [f for f in lint_file(str(p)) if f.rule in ("PRO", "EVT")]
+    assert clean == []
+    needle = "state=job.state, queued="
+    assert needle in src  # the _op_submit protocol.ok kwargs
+    p.write_text(src.replace(needle, "state=job.state, qeued=", 1))
+    broken = [f for f in lint_file(str(p)) if f.rule == "PRO"]
+    assert broken and "qeued" in broken[0].message
+
+
+# ------------------------------------------------------------- EVT rule --
+def test_evt_fixture_each_violation_caught():
+    """Undeclared kinds through the module alias and the LOG singleton,
+    and a computed kind through the bare import, are findings; declared
+    kinds and local emit helpers stay legal."""
+    findings = lint_file(os.path.join(FIXTURES, "badevent.py"))
+    evt = [f for f in findings if f.rule == "EVT"]
+    assert len(evt) == 3 and findings == evt
+    flagged = [f.line for f in evt]
+    for needle in ("EVT: undeclared kind via the module alias",
+                   "EVT: undeclared kind via the LOG singleton",
+                   "EVT: computed kind via the bare import"):
+        line = _fixture_lines("badevent.py", needle)[0]
+        assert line in flagged or line + 1 in flagged, needle
+    msgs = " ".join(f.message for f in evt)
+    assert "job_vanished" in msgs and "daemon_hiccup" in msgs
+    assert "EVENT_KINDS" in msgs
+    for needle in ("legal: declared kind",
+                   "legal: not the obs/events log"):
+        for line in _fixture_lines("badevent.py", needle):
+            assert line not in flagged
+
+
+def test_evt_guard_deletion_on_daemon_copy(tmp_path):
+    """Guard-deletion spot-check, event side: renaming an emitted kind
+    on a daemon copy goes red against EVENT_KINDS."""
+    src = open(os.path.join(REPO, "spgemm_tpu", "serve",
+                            "daemon.py")).read()
+    assert '"job_submit"' in src
+    p = tmp_path / "daemon.py"
+    p.write_text(src.replace('"job_submit"', '"job_submitted"', 1))
+    broken = [f for f in lint_file(str(p)) if f.rule == "EVT"]
+    assert broken and "job_submitted" in broken[0].message
+
+
+def test_evt_registry_covers_live_kinds():
+    """Every lifecycle kind the daemon and engine actually emit is
+    declared (the repo self-lint enforces the site direction;
+    spot-check the registry side)."""
+    from spgemm_tpu.obs.events import EVENT_KINDS
+
+    for kind in ("daemon_start", "job_submit", "job_done", "job_failed",
+                 "watchdog_reap", "watchdog_wedge", "est_fallback",
+                 "delta_fallback", "warm_load", "compile", "slo_burn",
+                 "slo_burn_clear", "failpoint_trigger"):
+        assert kind in EVENT_KINDS
+        assert EVENT_KINDS[kind]  # every kind carries its doc
+
+
+# ------------------------------------------------------------- DRF rule --
+def test_drf_quiet_without_registry_modules():
+    """The drift audit self-gates on each registry module being in the
+    linted unit set: the fixture site alone yields nothing."""
+    findings = lint_file(os.path.join(FIXTURES, "staledrift.py"))
+    assert findings == []
+    from spgemm_tpu.analysis.core import lint_report
+
+    findings, _ = lint_report(
+        [os.path.join(FIXTURES, "staledrift.py")], doc=False)
+    assert findings == []
+
+
+def test_drf_stale_registry_entries_flagged_at_declarations(tmp_path):
+    """Registry copies at the real suffixes + the one-reference fixture
+    site: every UNreferenced knob and event kind is a DRF finding at
+    its declaration line; the referenced ones are not; the drf-ok
+    escape on the shell-side knob suppresses (inventoried, not
+    stale)."""
+    import shutil
+
+    from spgemm_tpu.analysis.core import lint_run
+
+    for sub, name in (("utils", "knobs.py"), ("obs", "events.py")):
+        d = tmp_path / sub
+        d.mkdir(exist_ok=True)
+        shutil.copy(os.path.join(REPO, "spgemm_tpu", sub, name),
+                    str(d / name))
+    site = tmp_path / "site.py"
+    site.write_text(
+        open(os.path.join(FIXTURES, "staledrift.py")).read())
+    report = lint_run([str(tmp_path)], doc=False)
+    drf = [f for f in report.findings if f.rule == "DRF"]
+    assert drf, "expected drift findings against the registry copies"
+    assert all(f.file.endswith(("knobs.py", "events.py")) for f in drf)
+    msgs = " ".join(f.message for f in drf)
+    # unreferenced entries flagged...
+    assert "SPGEMM_TPU_MXU_R" in msgs
+    assert "job_done" in msgs
+    # ...referenced ones not, and the escaped shell-side knob rides the
+    # suppression inventory instead of the findings
+    assert "SPGEMM_TPU_PLAN_CACHE " not in msgs
+    assert "'job_start'" not in msgs
+    assert "SPGEMM_TPU_EVIDENCE_STEPS" not in msgs
+    esc = [s for s in report.suppressions
+           if s.rule == "DRF" and "EVIDENCE_STEPS" in s.reason
+           or s.rule == "DRF" and "shell-side" in s.reason]
+    assert esc and not any(s.stale for s in esc)
+    # findings anchor at the declaration lines (the quoted name)
+    knobs_src = open(os.path.join(REPO, "spgemm_tpu", "utils",
+                                  "knobs.py")).read().splitlines()
+    for f in drf:
+        if f.file.endswith("knobs.py"):
+            assert '"SPGEMM_TPU_' in knobs_src[f.line - 1]
+
+
+def test_drf_signature_covers_new_registries():
+    """Editing serve/protocol.py or obs/events.py changes the analysis
+    signature, so every cached per-file PRO/EVT result is invalidated
+    on the next run (the same contract MET/FPT already have)."""
+    before = core._analysis_signature()
+    path = os.path.join(REPO, "spgemm_tpu", "serve", "protocol.py")
+    original = open(path, "rb").read()
+    try:
+        with open(path, "ab") as f:
+            f.write(b"\n# signature-probe\n")
+        assert core._analysis_signature() != before
+    finally:
+        with open(path, "wb") as f:
+            f.write(original)
+    assert core._analysis_signature() == before
+
+
 # ------------------------------------------------------------- DOC rule --
 def test_doc_fixture_drift_caught():
     findings = check_claude_md(FIXTURE_CLAUDE)
@@ -1373,9 +1600,9 @@ def test_stale_suppressions_reported():
     tsi-ok), all in the one inventory."""
     findings, suppressions = core.lint_report(
         [os.path.join(FIXTURES, "stalesup.py")], doc=False)
-    assert [f.rule for f in findings] == ["SUP"] * 6
+    assert [f.rule for f in findings] == ["SUP"] * 7
     assert {s.rule for s in suppressions} == {"FLD", "THR", "EXC",
-                                              "LCK", "BLK", "TSI"}
+                                              "LCK", "BLK", "TSI", "DRF"}
     assert all(s.stale for s in suppressions)
     assert all("seeded-stale" in s.reason for s in suppressions)
     assert [f.line for f in findings] == [s.line for s in sorted(
@@ -1426,32 +1653,39 @@ def test_json_report_fixture_run():
     # badthread/badexcept: 3 each; badlockorder: cycle + self-edge;
     # badblocking: direct + transitive + typed-queue; badshared:
     # two-root write + nested-def two-site root + loop-spawned
-    # multi-instance root; stalesup: one stale escape per family (6);
+    # multi-instance root; stalesup: one stale escape per family (7);
     # badmetric: undeclared phase + undeclared counter + computed name
     # + 2 deep-profiling + 2 warm-layer + 1 batch-layer + 2 dense-route
     # near-misses; badfailpoint: 2
     # undeclared + 1 computed (the stale-registry direction stays quiet
-    # -- the registry module is not in the fixture unit set)
+    # -- the registry module is not in the fixture unit set);
+    # badproto: 2 undeclared-for-op fields + 1 undeclared submit dict
+    # key + unknown op + hardcoded version + 2 undeclared codes +
+    # 1 undeclared union-context response field + 1 undeclared E_*
+    # constant; badevent: 2 undeclared kinds + 1 computed kind;
+    # DRF stays quiet like FPT's registry direction (no registry module
+    # in the fixture unit set -- staledrift.py alone yields nothing)
     assert report["counts"] == {"FLD": 9, "KNB": 22, "BKD": 5, "THR": 3,
                                 "LCK": 2, "BLK": 3, "TSI": 3,
-                                "EXC": 3, "MET": 10, "FPT": 3, "DOC": 1,
-                                "SUP": 6, "PARSE": 0}
+                                "EXC": 3, "MET": 10, "FPT": 3,
+                                "PRO": 9, "EVT": 3, "DRF": 0, "DOC": 1,
+                                "SUP": 7, "PARSE": 0}
     assert set(report["counts"]) == set(core.RULES)
     for f in report["findings"]:
         assert set(f) == {"file", "line", "rule", "message"}
         assert f["rule"] in core.RULES
         assert isinstance(f["line"], int) and f["line"] >= 1
     # the suppression inventory: every escape comment in the run, with
-    # the six stalesup.py seeds marked stale
+    # the seven stalesup.py seeds marked stale
     sup = report["suppressions"]
     assert all(set(s) == {"file", "line", "rule", "reason", "stale"}
                for s in sup)
-    assert sum(s["stale"] for s in sup) == 6
+    assert sum(s["stale"] for s in sup) == 7
     assert all(s["file"].endswith("stalesup.py")
                for s in sup if s["stale"])
-    # 6 stale + thr-ok + exc-ok + 3 fld escapes + blk-ok (badblocking)
+    # 7 stale + thr-ok + exc-ok + 3 fld escapes + blk-ok (badblocking)
     # + 2 tsi-ok (badshared) in use
-    assert len(sup) == 14
+    assert len(sup) == 15
     # --no-cache: the cache block reports disabled, nothing else
     assert report["cache"] == {"enabled": False}
 
@@ -1529,12 +1763,15 @@ def test_cache_invalidates_on_edit(tmp_path):
 
 
 def test_cache_signature_covers_rule_registries():
-    """The cached per-file rules validate against obs/metrics.py (MET)
-    and utils/failpoints.py (FPT): both must feed the linter-version
+    """The cached per-file rules validate against obs/metrics.py (MET),
+    utils/failpoints.py (FPT), serve/protocol.py (PRO), and
+    obs/events.py (EVT): all four must feed the linter-version
     signature, or a registry edit would replay stale cached results
     while the call sites' files are untouched."""
     assert set(core._SIGNATURE_EXTRAS) == {"obs/metrics.py",
-                                           "utils/failpoints.py"}
+                                           "utils/failpoints.py",
+                                           "serve/protocol.py",
+                                           "obs/events.py"}
     for rel in core._SIGNATURE_EXTRAS:
         assert os.path.exists(os.path.join(REPO, "spgemm_tpu", rel))
 

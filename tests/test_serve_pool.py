@@ -223,23 +223,31 @@ def test_protocol_v1_requests_still_served(make_daemon):
 
 
 def test_version_for_is_the_capability_table():
-    """Satellite: ONE negotiation rule (protocol.version_for over the
-    FIELD_MIN_VERSION capability table) replaces per-field stamping --
-    the lowest version carrying the request's optional fields."""
-    assert protocol.version_for({"op": "stats"}) == 1
-    assert protocol.version_for({"op": "submit", "folder": "f"}) == 1
-    assert protocol.version_for({"op": "submit", "tenant": "a"}) == 2
-    assert protocol.version_for({"op": "submit",
-                                 "trace": "ab" * 16}) == 3
-    assert protocol.version_for({"op": "submit", "tenant": "a",
-                                 "trace": "ab" * 16}) == 3
-    # the downgrade half: stripping sheds exactly the too-new fields
-    msg = {"op": "submit", "folder": "f", "tenant": "a",
-           "trace": "ab" * 16}
-    assert protocol.strip_for_version(msg, 2) == {
-        "op": "submit", "folder": "f", "tenant": "a"}
-    assert protocol.strip_for_version(msg, 1) == {
-        "op": "submit", "folder": "f"}
+    """ONE negotiation rule (protocol.version_for over FIELD_MIN_VERSION,
+    itself derived from the per-op REQUEST_FIELDS tables) replaces
+    per-field stamping -- driven from the registry, so every op's full
+    request round-trips at every accepted version with no hand-listed
+    field cases to forget."""
+    for op in protocol.OPS:
+        fields = protocol.REQUEST_FIELDS[op]
+        full = {"op": op, **{name: f"x-{name}" for name in fields}}
+        # the minimum carrying version is the max field min-version
+        want = max([1, *fields.values()])
+        assert protocol.version_for(full) == want, op
+        # stripping at each accepted version keeps exactly the fields
+        # that version carries -- and never touches the envelope
+        for v in protocol.ACCEPTED_VERSIONS:
+            stripped = protocol.strip_for_version(full, v)
+            assert stripped["op"] == op
+            kept = {name for name in fields if name in stripped}
+            assert kept == {name for name, mv in fields.items()
+                            if mv <= v}, (op, v)
+            # a stripped request is carryable at the version it was
+            # stripped for
+            assert protocol.version_for(stripped) <= v
+    # every op's bare request is v1 (first-contact compatibility)
+    for op in protocol.OPS:
+        assert protocol.version_for({"op": op}) == 1
     # the daemon's version-mismatch wording parses back to its versions
     assert protocol.accepted_from_error(
         "protocol version mismatch: daemon speaks v2 (accepts v1/v2), "
@@ -252,6 +260,19 @@ def test_version_for_is_the_capability_table():
     assert protocol.accepted_from_error(
         "trace must be 32 lowercase hex chars (a 128-bit trace "
         "context), got 'accepts v1/v2'") == ()
+
+
+def test_registry_min_versions_span_the_protocol():
+    """The registry declares at least one field at every version up to
+    PROTOCOL_VERSION (otherwise the version constant has drifted past
+    the tables), and FIELD_MIN_VERSION is exactly the post-v1 slice of
+    the request tables."""
+    all_versions = {v for fields in protocol.REQUEST_FIELDS.values()
+                    for v in fields.values()}
+    assert set(range(2, protocol.PROTOCOL_VERSION + 1)) <= all_versions
+    derived = {name: v for fields in protocol.REQUEST_FIELDS.values()
+               for name, v in fields.items() if v > 1}
+    assert protocol.FIELD_MIN_VERSION == derived
 
 
 def test_client_stamps_lowest_version_for_fields(tmp_path, make_daemon,
