@@ -1,0 +1,236 @@
+"""Autotune A/B: cold-default knobs vs tuner-promoted per-class
+overrides (spgemm_tpu/tune), in-process on the pinned backend.
+
+The acceptance proof for the telemetry-driven autotuner: a mixed
+structure suite (one deep-fanout class engineered to pay the ladder
+route's worst padded-MAC tax, one banded control class) is driven
+through the REAL tuner state machine -- note_job seeds the classes,
+run_trial_leg executes every coordinate-search leg (baseline + one-knob
+deviations) with the real engine under each candidate overlay, and the
+promoted override is then applied exactly the way spgemmd's job pickup
+applies it (knobs.set_tuned).  The timed A/B compares the cold-default
+leg against the tuned leg per class, both warm (plan + jit cached), so
+the speedup is the steady-state serving figure the trial lane buys.
+
+The deep-fanout class is fanout 129 at k=16: the ladder route pads
+every key's pair axis to the 192 fanout class (~1.49x dispatched MACs)
+and -- because 129 < DENSE_MIN_CLASS -- the auto route never even
+attaches the dense layout, so the default engine is pure ladder there.
+The tuner's forced-dense trial leg ships the exact 129-pair stream and
+wins big; the control class settles untuned (no candidate beats its
+baseline by the promotion margin).
+
+Every leg is bit-exact: the trial legs' parity digests are checked by
+the tuner itself (a mismatch parks the class), and this bench
+additionally asserts the tuned leg's output digest equals the cold
+leg's per class.
+
+Trial vectors are pre-warmed (one un-timed run per (class, vector))
+before the trial loop so each leg times warm execution, not jit
+compile -- the same amortization a resident spgemmd reaches after its
+first idle window per vector.
+
+Usage: python benchmarks/autotune_bench.py [--iters 5] [--check]
+  --check gates the acceptance criteria: every leg bit-exact AND the
+  tuner promoted an override on >= 1 class whose measured steady-state
+  speedup is >= --min-win (default 1.1x); nonzero exit otherwise.
+Prints one JSON line (last stdout line):
+  {"metric": "autotune_tuned_speedup", "value": <best speedup x>, ...}
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _digest(result) -> str:
+    from spgemm_tpu.ops import plancache
+
+    h = hashlib.sha256()
+    plancache.hash_update(h, result.coords)
+    plancache.hash_update(h, result.tiles)
+    return h.hexdigest()
+
+
+def _deep_fanout_chain(k: int = 16, keys: int = 16, fanout: int = 129):
+    """A 2-chain whose single multiply has `keys` output tile-keys of
+    uniform fanout 129: ladder pads each to the 192 class (~1.49x
+    dispatched MACs), auto never attaches dense below DENSE_MIN_CLASS,
+    so only a forced-dense override removes the tax."""
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+
+    rng = np.random.default_rng(17)
+    a_coords = np.array([(i, i * fanout + j) for i in range(keys)
+                         for j in range(fanout)], np.int64)
+    b_coords = np.array([(m, 0) for m in range(keys * fanout)], np.int64)
+    a = BlockSparseMatrix(
+        rows=keys, cols=keys * fanout, k=k, coords=a_coords,
+        tiles=rng.integers(0, 1 << 64, size=(len(a_coords), k, k),
+                           dtype=np.uint64))
+    b = BlockSparseMatrix(
+        rows=keys * fanout, cols=1, k=k, coords=b_coords,
+        tiles=rng.integers(0, 1 << 64, size=(len(b_coords), k, k),
+                           dtype=np.uint64))
+    return [a, b]
+
+
+def _banded_chain(k: int = 8, block_dim: int = 16):
+    """The control class: a shallow banded 2-chain whose fanout classes
+    are tiny -- no searched knob should beat its baseline by the
+    promotion margin, so the tuner must settle it untuned."""
+    from spgemm_tpu.utils.gen import banded_block_sparse
+
+    rng = np.random.default_rng(7)
+    return [banded_block_sparse(block_dim, k, 2, rng, "full")
+            for _ in range(2)]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=5,
+                   help="timed iterations per leg (min is reported)")
+    p.add_argument("--min-win", type=float, default=1.1,
+                   help="--check gate: tuned must beat cold by this "
+                        "factor on >= 1 class")
+    p.add_argument("--device", default="cpu",
+                   help="backend to pin before anything touches jax")
+    p.add_argument("--check", action="store_true",
+                   help="exit nonzero unless the acceptance criteria "
+                        "hold (parity everywhere + a >= min-win class)")
+    args = p.parse_args()
+
+    from spgemm_tpu.utils.backend_probe import pin
+    pin(args.device)
+    from spgemm_tpu import chain, tune
+    from spgemm_tpu.ops import plancache
+    from spgemm_tpu.utils import knobs
+    from spgemm_tpu.utils.semantics import chain_oracle
+
+    suite = {
+        "deep-fanout": _deep_fanout_chain(),
+        "banded": _banded_chain(),
+    }
+    # the REAL class keys spgemmd would assign these structures
+    class_of = {plancache.tune_class_key(
+        plancache.chain_fingerprint([m.coords for m in mats]),
+        args.device): name for name, mats in suite.items()}
+    name_of_class = dict(class_of)
+    mats_of_class = {ck: suite[name] for ck, name in class_of.items()}
+
+    # measurement-context pin, exactly like the daemon's trial lane: a
+    # repeat multiply answered from the delta store would time a splice
+    extra = {"SPGEMM_TPU_DELTA": "0"}
+
+    def run_leg(token: str) -> str:
+        """run_fn for run_trial_leg: folder_of hands back the class key
+        as the 'folder' token, so the leg multiplies that class's chain
+        under whatever overlay the tuner activated."""
+        return _digest(chain.chain_product(mats_of_class[token]))
+
+    def timed(overlay: dict, mats, iters: int):
+        prev = knobs.tuned_overlay()
+        knobs.set_tuned({**overlay, **extra})
+        try:
+            result = chain.chain_product(mats)  # warm: plan + compile
+            best = float("inf")
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                result = chain.chain_product(mats)
+                best = min(best, time.perf_counter() - t0)
+            return best, _digest(result), result
+        finally:
+            knobs.set_tuned(prev)
+
+    # oracle ground truth once per class (host-only numpy)
+    oracle_digest = {}
+    for ck, mats in mats_of_class.items():
+        from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+        want = BlockSparseMatrix.from_dict(
+            mats[0].rows, mats[-1].cols, mats[0].k,
+            chain_oracle([m.to_dict() for m in mats], mats[0].k))
+        oracle_digest[ck] = _digest(want.prune_zeros())
+
+    tuner = tune.Tuner()
+    for ck in mats_of_class:
+        tuner.note_job(ck, args.device)
+
+    # pre-warm every (class, vector) so trial legs time warm execution
+    for ck, mats in mats_of_class.items():
+        for vec in tune.trial_vectors(args.device):
+            prev = knobs.tuned_overlay()
+            knobs.set_tuned({**vec, **extra})
+            try:
+                chain.chain_product(mats)
+            finally:
+                knobs.set_tuned(prev)
+
+    # the trial loop: every coordinate-search leg, real engine, real
+    # parity digests -- the tuner decides promotion on its own timings
+    t0 = time.perf_counter()
+    legs = 0
+    while tune.run_trial_leg(run_leg, lambda ck: ck, tuner=tuner,
+                             extra=extra):
+        legs += 1
+    trial_wall = time.perf_counter() - t0
+
+    classes = {}
+    best_speedup = None
+    parity_ok = True
+    for ck, name in name_of_class.items():
+        mats = mats_of_class[ck]
+        cold_s, cold_digest, _ = timed({}, mats, args.iters)
+        if cold_digest != oracle_digest[ck]:
+            raise SystemExit(f"{name}: cold leg does not match the "
+                             "oracle bytes")
+        row = next(r for r in tuner.stats()["classes"]
+                   if r["class"] == ck)
+        overlay = tuner.overlay_for(ck)
+        entry = {"class": ck, "state": row["state"],
+                 "knobs": row["knobs"], "trial_win": row["win"],
+                 "cold_s": round(cold_s, 4)}
+        if overlay:
+            tuned_s, tuned_digest, _ = timed(overlay, mats, args.iters)
+            ok = tuned_digest == cold_digest
+            parity_ok = parity_ok and ok
+            speedup = round(cold_s / tuned_s, 3) if tuned_s > 0 else None
+            entry.update(tuned_s=round(tuned_s, 4), speedup=speedup,
+                         parity=ok)
+            if speedup is not None and \
+                    (best_speedup is None or speedup > best_speedup):
+                best_speedup = speedup
+        classes[name] = entry
+
+    won = [n for n, e in classes.items()
+           if e.get("speedup") and e["speedup"] >= args.min_win
+           and e["state"] in ("canary", "live")]
+    check_ok = parity_ok and bool(won)
+    print(json.dumps({
+        "metric": "autotune_tuned_speedup",
+        "value": best_speedup, "unit": "x",
+        "vs_baseline": None,
+        "detail": {"iters": args.iters, "min_win": args.min_win,
+                   "device": args.device, "trial_legs": legs,
+                   "trial_wall_s": round(trial_wall, 3),
+                   "classes": classes, "winning_classes": won,
+                   "parity": parity_ok, "check_ok": check_ok},
+    }))
+    if args.check and not check_ok:
+        raise SystemExit(
+            "autotune --check failed: "
+            + ("a leg broke bit-exact parity" if not parity_ok else
+               f"no class reached the {args.min_win}x tuned win: "
+               f"{classes}"))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -609,6 +609,38 @@ def config_accum_route():
             "value_parity": parity}
 
 
+def config_autotune():
+    """Telemetry-driven autotune A/B (benchmarks/autotune_bench.py): the
+    mixed structure suite through the real tuner state machine -- the
+    deep-fanout class must promote a forced-dense override past the
+    canary margin while the banded control settles untuned, every leg
+    bit-exact.  Runs in a subprocess (the bench pins its own backend and
+    mutates the process-global tuned overlay), --check armed so a
+    regression in the tuner's promotion or parity fails the row."""
+    child = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "autotune_bench.py"), "--check"],
+        capture_output=True, text=True, timeout=1800)
+    last = next((ln for ln in reversed(child.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if child.returncode != 0 or last is None:
+        raise RuntimeError(f"autotune_bench failed (rc {child.returncode}): "
+                           f"{child.stderr[-500:]}")
+    row = json.loads(last)
+    det = row["detail"]
+    deep = det["classes"]["deep-fanout"]
+    return {"config": "autotune", "backend": "tuner",
+            "platform": det["device"],
+            "wall_s": deep.get("tuned_s"),
+            "wall_s_cold": deep["cold_s"],
+            "speedup_tuned": row["value"],
+            "trial_legs": det["trial_legs"],
+            "trial_wall_s": det["trial_wall_s"],
+            "winning_classes": det["winning_classes"],
+            "tuned_knobs": deep["knobs"],
+            "value_parity": det["parity"]}
+
+
 CONFIGS = {
     "random-1pct": config_random_1pct,
     "cage12": config_cage12,
@@ -624,6 +656,7 @@ CONFIGS = {
     "pool-scaling": config_pool_scaling,
     "serve-batching": config_serve_batching,
     "accum-route": config_accum_route,
+    "autotune": config_autotune,
 }
 
 

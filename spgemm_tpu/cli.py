@@ -155,6 +155,28 @@ def run_knobs(argv: list[str]) -> int:
                         "stats, delta: live incremental-recompute stats}")
     args = p.parse_args(argv)
     rows = knobs_registry.snapshot()
+    # tuned-source marking (spgemm_tpu/tune): a knob carried by any
+    # persisted canary/live override -- the autotuner's warm tune tier,
+    # read from disk like `cli tune --status` -- gets its class keys
+    # attached to the row, so the listing shows which values a serving
+    # daemon would overlay per class.  Best-effort: a missing/foreign
+    # warm dir must never break the listing.
+    tuned_by: dict[str, list[str]] = {}
+    try:
+        from spgemm_tpu.ops import warmstore as _ws  # noqa: PLC0415
+        from spgemm_tpu.serve import protocol as _proto  # noqa: PLC0415
+        tune_dir = (knobs_registry.get("SPGEMM_TPU_WARM_DIR")
+                    or _proto.default_socket_path() + ".warm")
+        for ck, rec in sorted(_ws.scan_tunes(tune_dir).items()):
+            if rec.get("state") in ("canary", "live"):
+                for kn in {**(rec.get("knobs") or {}),
+                           **(rec.get("est") or {})}:
+                    tuned_by.setdefault(str(kn), []).append(ck)
+    except Exception:  # noqa: BLE001 -- the listing renders with or without a readable warm dir
+        tuned_by = {}
+    for r in rows:
+        if r["name"] in tuned_by:
+            r["tuned_classes"] = tuned_by[r["name"]]
     # live plan-cache + estimator + delta state next to the knob rows
     # (jax-free imports): the whole-engine A/B pairs
     # (SPGEMM_TPU_PLAN_AHEAD=0|2, SPGEMM_TPU_PLAN_ESTIMATE=0|1,
@@ -220,8 +242,10 @@ def run_knobs(argv: list[str]) -> int:
     try:
         for r in rows:
             static = " [jit-static]" if r["jit_static"] else ""
+            tuned = (f" [tuned: {len(r['tuned_classes'])} class(es)]"
+                     if r.get("tuned_classes") else "")
             print(f"{r['name']:<{name_w}}  {r['value']:>{val_w}}  "
-                  f"({r['source']}, default {r['default']}){static}")
+                  f"({r['source']}, default {r['default']}){static}{tuned}")
             if r.get("error"):
                 print(f"{'':<{name_w}}  !! {r['error']}")
             print(f"{'':<{name_w}}  {r['doc']}  [{r['module']}]")
@@ -329,6 +353,78 @@ def run_warm(argv: list[str]) -> int:
     return 0
 
 
+def run_tune(argv: list[str]) -> int:
+    """`spgemm_tpu tune [--status|--clear] [--dir PATH] [--json]`: the
+    autotuner's persisted override table (ops/warmstore tune tier,
+    spgemm_tpu/tune) -- one row per structure class: rollout state,
+    tuned knob vector, measured win, estimator adaptation -- plus the
+    `dense-v1:` ladder-vs-dense crossover captures trial legs persisted
+    into the shared measurement cache (ops/crossover).  Reads the warm
+    dir from DISK (no daemon round-trip, works against a stopped
+    daemon), resolving like `warm`: --dir, else SPGEMM_TPU_WARM_DIR,
+    else <default socket>.warm."""
+    p = argparse.ArgumentParser(
+        prog="spgemm_tpu tune",
+        description="inspect (--status, default) or empty (--clear) the "
+                    "autotuner's persisted per-class knob overrides")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("--status", action="store_true",
+                   help="override table: class, rollout state, knob "
+                        "vector, measured win, estimator adaptation "
+                        "(the default action)")
+    g.add_argument("--clear", action="store_true",
+                   help="delete the tune tier's entries (warm plans and "
+                        "deltas stay); refuses while a live process "
+                        "holds the dir's lock")
+    p.add_argument("--dir", default=None, metavar="PATH",
+                   help="warm dir (default: SPGEMM_TPU_WARM_DIR, else "
+                        "<default socket>.warm)")
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    from spgemm_tpu.ops import crossover, warmstore  # noqa: PLC0415
+    from spgemm_tpu.serve import protocol  # noqa: PLC0415
+    target = (args.dir or knobs_registry.get("SPGEMM_TPU_WARM_DIR")
+              or protocol.default_socket_path() + ".warm")
+    if args.clear:
+        try:
+            removed = warmstore.clear_tunes(target)
+        except RuntimeError as e:
+            print(f"tune: {e}", file=sys.stderr)
+            return 1
+        print(f"tune: cleared {removed} override record(s) from {target}")
+        return 0
+    records = warmstore.scan_tunes(target)
+    dense = crossover.entries("dense-v1:")
+    if args.as_json:
+        import json  # noqa: PLC0415
+
+        print(json.dumps({"dir": target, "overrides": records,
+                          "crossover_dense": dense}, indent=2))
+        return 0
+    print(f"tune store {target}: {len(records)} class record(s)")
+    for ck, rec in sorted(records.items()):
+        vec = " ".join(f"{k}={v}" for k, v in
+                       sorted((rec.get("knobs") or {}).items())) or "-"
+        est = " ".join(f"{k}={v}" for k, v in
+                       sorted((rec.get("est") or {}).items()))
+        win = rec.get("win")
+        line = (f"  {ck}  [{rec.get('state', '?')}]  "
+                f"win={win if win is not None else '-'}  {vec}")
+        if est:
+            line += f"  est: {est}"
+        print(line)
+    if dense:
+        print(f"crossover dense-v1 captures: {len(dense)}")
+        for key, hit in sorted(dense.items()):
+            ladder_s, dense_s = hit.get("ladder_s"), hit.get("dense_s")
+            verdict = "dense" if (dense_s is not None
+                                  and ladder_s is not None
+                                  and dense_s < ladder_s) else "ladder"
+            print(f"  {key}  ladder={ladder_s}s dense={dense_s}s "
+                  f"-> {verdict}")
+    return 0
+
+
 def _subcommands() -> dict:
     """Name -> handler for the non-folder subcommands.  Each handler
     imports its own machinery only when invoked: `knobs` must never pay
@@ -370,7 +466,7 @@ def _subcommands() -> dict:
             "submit": submit, "status": status,
             "metrics": metrics, "trace-dump": trace_dump,
             "profile": profile, "events": events, "slo": slo,
-            "warm": run_warm}
+            "warm": run_warm, "tune": run_tune}
 
 
 def run(argv: list[str] | None = None) -> int:
@@ -385,7 +481,7 @@ def run(argv: list[str] | None = None) -> int:
     # scratch dir does not swallow the subcommand
     if (argv and argv[0] in ("knobs", "serve", "submit", "status",
                              "metrics", "trace-dump", "profile", "events",
-                             "slo", "warm")
+                             "slo", "warm", "tune")
             and not os.path.exists(os.path.join(argv[0], "size"))):
         return _subcommands()[argv[0]](argv[1:])
     parser = build_parser()

@@ -107,6 +107,20 @@ EVENT_KINDS: dict[str, str] = {
     "slo_burn_clear": "the burn condition cleared for the window",
     "failpoint_trigger": "an armed chaos failpoint fired (point name "
                          "and action ride along)",
+    "tune_trial": "autotuner ran one timed trial leg on an idle slice "
+                  "(class, knob vector, measured wall ride along)",
+    "tune_trial_preempted": "a trial leg aborted because a real job "
+                            "arrived (or another slice swapped the "
+                            "overlay mid-measurement); the measurement "
+                            "was discarded",
+    "tune_apply": "a class's tuned override was promoted (trial winner "
+                  "persisted to the warm tune tier, canary armed) or "
+                  "re-activated at job pickup",
+    "tune_canary_passed": "the first job under a fresh tuned override "
+                          "committed clean; the override is live",
+    "tune_revert": "a tuned override was dropped (canary failure or "
+                   "trial-time parity mismatch) and its class backed "
+                   "off before re-trial",
 }
 
 

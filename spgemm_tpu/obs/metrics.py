@@ -54,6 +54,12 @@ ENGINE_PHASES: dict[str, str] = {
     "warm_flush": "warm-start store: persist in-memory plan/delta "
                   "entries + budget prune (spgemmd terminal events, "
                   "shutdown)",
+    "tune_trial": "autotuner: one timed trial leg (one knob vector of "
+                  "the per-class enumeration) run on an idle slice "
+                  "(spgemm_tpu/tune)",
+    "tune_apply": "autotuner: activating a class's tuned override at "
+                  "job pickup + persisting a fresh winner into the "
+                  "warm store's tune tier",
 }
 
 # Engine event COUNTER names: the only names the package may pass to
@@ -112,6 +118,14 @@ ENGINE_COUNTERS: dict[str, str] = {
     "warm_corrupt": "warm entries skipped as corrupt/version-skewed/"
                     "knob-vector-mismatched -- each a counted cold "
                     "fallback, never a crash or wrong bits",
+    "tune_trials": "autotuner timed trial legs executed on idle slices "
+                   "(one knob vector each; preempted or "
+                   "generation-skewed legs count too -- they spent the "
+                   "idle cycles even when the measurement was "
+                   "discarded)",
+    "tune_reverts": "autotuner override reverts: a canary failure or a "
+                    "trial-time parity mismatch dropped the class's "
+                    "tuned vector and backed off its re-trial",
 }
 
 
@@ -357,6 +371,22 @@ _METRICS = (
            "Current on-disk size of the active event-log file (0 when "
            "no file sink is configured).",
            "obs/events.py"),
+    # ---- autotuner (spgemm_tpu/tune) ----
+    Metric("spgemm_tune_overrides", "gauge",
+           "Structure classes currently holding a tuned knob override, "
+           "by rollout state (canary = first post-promotion job still "
+           "pending under the tightened deadline, live = canary passed, "
+           "reverted = canary failed or parity mismatched -- held in "
+           "backoff before re-trial).  No series while the tuner holds "
+           "no class state (the SPGEMM_TPU_TUNE=0 scrape is "
+           "byte-identical to the pre-tuner daemon).",
+           "serve/daemon.py", labels=("state",)),
+    Metric("spgemm_tune_win_ratio", "gauge",
+           "Measured speedup (incumbent wall / winner wall) of each "
+           "class's tuned override, labeled by the structure class key; "
+           "only promoted overrides render (>= SPGEMM_TPU_TUNE_MIN_WIN "
+           "by construction).",
+           "serve/daemon.py", labels=("class",)),
     # ---- SLO engine (obs/slo.py) ----
     Metric("spgemm_slo_latency_seconds", "gauge",
            "Rolling-window per-tenant job latency quantile (p50/p95/p99 "
@@ -528,6 +558,12 @@ def collect_engine() -> list[tuple]:
             ("spgemm_warm_entries", {"kind": "delta"}, warm["deltas"]),
             ("spgemm_warm_bytes", {}, warm["bytes"]),
         ]
+        # count-0-gated: the tune tier's kind row only renders once a
+        # tuned override persisted, so a TUNE=0 (or never-tuned) scrape
+        # stays byte-identical to the pre-tuner daemon's
+        if warm.get("tunes"):
+            samples.append(("spgemm_warm_entries", {"kind": "tune"},
+                            warm["tunes"]))
     ring = trace.RECORDER.stats()
     samples += [
         ("spgemm_trace_spans", {}, ring["spans"]),

@@ -40,6 +40,20 @@ log = logging.getLogger("spgemm_tpu.crossover")
 _CACHE: dict[str, dict] = {}
 
 
+def entries(prefix: str | None = None) -> dict:
+    """Read-only copy of the measured crossover cache, optionally
+    filtered by key prefix.  `cli tune --status` lists the `dense-v1:`
+    keys here: an autotuner ACCUM_ROUTE trial leg running under the
+    "auto" gate policy on-chip measures ladder-vs-dense at every round
+    shape the class reaches, and those captures persist into this cache
+    exactly like a real job's would -- idle trials pre-pay the
+    first-contact measurement cost for live traffic."""
+    cache = dict(_load())
+    if prefix:
+        cache = {k: v for k, v in cache.items() if k.startswith(prefix)}
+    return cache
+
+
 def gate_policy(platform: str | None = None) -> str:
     """'auto' or 'proof' (see module docstring).
 

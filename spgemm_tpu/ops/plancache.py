@@ -146,6 +146,18 @@ def chain_structure(sig: str | None) -> str | None:
         return fp
 
 
+def tune_class_key(fp: str | None, device_kind: str) -> str | None:
+    """The autotuner's structure-class key: the chain structure
+    fingerprint's class signature (a 12-hex prefix -- classes group
+    structures, they need not distinguish every folder) joined with the
+    device kind the class's jobs run on (a vector tuned on a TPU slice
+    says nothing about a CPU failover path).  None passes through: a
+    first-contact job (no recorded structure) is never tuned."""
+    if not fp:
+        return None
+    return f"{fp[:12]}@{device_kind or 'unknown'}"
+
+
 def lookup(key: str):
     """Cached plan for key, or None; a hit moves the entry to MRU."""
     with _LOCK:

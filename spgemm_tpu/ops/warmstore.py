@@ -73,7 +73,8 @@ _LOCK_FILE = None                # spgemm-lint: guarded-by(_LOCK)
 _SAVED_DELTA: dict = {}          # spgemm-lint: guarded-by(_LOCK)
 _STATS = {"plan_hits": 0, "plan_misses": 0, "delta_hits": 0,
           "delta_misses": 0, "corrupt": 0, "saved_plans": 0,
-          "saved_deltas": 0, "pruned": 0}  # spgemm-lint: guarded-by(_LOCK)
+          "saved_deltas": 0, "saved_tunes": 0,
+          "pruned": 0}  # spgemm-lint: guarded-by(_LOCK)
 
 
 def enabled() -> bool:
@@ -154,12 +155,12 @@ def configure(path: str | None = None) -> bool:
                         reason="lock_contention")
             return False
         _DIR, _DISABLED, _LOCK_FILE = directory, None, fh
-        plans, deltas, size = _scan_locked()
+        plans, deltas, tunes, size = _scan_locked()
     _fence_delta_versions(directory)
-    log.info("warm store at %s: %d plans, %d delta entries, %d bytes",
-             directory, plans, deltas, size)
+    log.info("warm store at %s: %d plans, %d delta entries, %d tuned "
+             "overrides, %d bytes", directory, plans, deltas, tunes, size)
     events.emit("warm_load", dir=directory, plans=plans, deltas=deltas,
-                bytes=size)
+                tunes=tunes, bytes=size)
     return True
 
 
@@ -259,6 +260,13 @@ def _delta_path(d: str, key: str) -> str:
     return os.path.join(d, f"delta-{digest}.npz")
 
 
+def _tune_path(d: str, class_key: str) -> str:
+    # the tune class key embeds the device kind (may carry spaces/slashes)
+    # -- hash it; the full key is stored inside and checked like deltas
+    digest = hashlib.sha256(class_key.encode()).hexdigest()[:40]
+    return os.path.join(d, f"tune-{digest}.npz")
+
+
 def _atomic_savez(path: str, payload: dict) -> None:
     tmp = path + ".tmp.npz"
     with open(tmp, "wb") as f:
@@ -266,15 +274,16 @@ def _atomic_savez(path: str, payload: dict) -> None:
     os.replace(tmp, path)
 
 
-def _scan_locked() -> tuple[int, int, int]:
-    """(plan files, delta files, total npz bytes) of the bound dir."""
-    plans = deltas = size = 0
+def _scan_locked() -> tuple[int, int, int, int]:
+    """(plan files, delta files, tune files, total npz bytes) of the
+    bound dir."""
+    plans = deltas = tunes = size = 0
     if _DIR is None:
-        return 0, 0, 0
+        return 0, 0, 0, 0
     try:
         names = os.listdir(_DIR)
     except OSError:
-        return 0, 0, 0
+        return 0, 0, 0, 0
     for name in names:
         if not name.endswith(".npz"):
             continue
@@ -286,7 +295,9 @@ def _scan_locked() -> tuple[int, int, int]:
             plans += 1
         elif name.startswith("delta-"):
             deltas += 1
-    return plans, deltas, size
+        elif name.startswith("tune-"):
+            tunes += 1
+    return plans, deltas, tunes, size
 
 
 def _note_corrupt(path: str, reason: str) -> None:
@@ -310,10 +321,17 @@ def _note_corrupt(path: str, reason: str) -> None:
                 reason=reason)
 
 
-def _check_envelope(z, path: str, kind: str, ident: str) -> bool:
+def _check_envelope(z, path: str, kind: str, ident: str,
+                    sig: str | None = None) -> bool:
     """Validate one loaded npz's envelope: schema version, entry kind,
     identity (fingerprint/key) and the jit-static knob vector.  False =
-    counted cold fallback."""
+    counted cold fallback.
+
+    `sig` overrides the expected knob signature: the tune tier validates
+    against the BASE vector (knobs.base_jit_static_vector -- env >
+    default only), because loading a tuned override is itself what
+    changes the overlaid vector; the plan/delta tiers use the live
+    vector (their fingerprints bake it in)."""
     from spgemm_tpu.utils import failpoints  # noqa: PLC0415
     if failpoints.check("warm.load"):
         _note_corrupt(path, "failpoint warm.load")
@@ -325,7 +343,7 @@ def _check_envelope(z, path: str, kind: str, ident: str) -> bool:
     if str(z["kind"]) != kind or str(z["ident"]) != ident:
         _note_corrupt(path, "entry identity mismatch")
         return False
-    if str(z["knobs"]) != _knob_sig():
+    if str(z["knobs"]) != (sig if sig is not None else _knob_sig()):
         _note_corrupt(path, "jit-static knob vector mismatch")
         return False
     return True
@@ -522,6 +540,101 @@ def load_delta(key: str) -> dict | None:
 
 
 # ------------------------------------------------------------------ flush --
+# ---------------------------------------------------------------- tunes --
+def save_tune(class_key: str, record: dict) -> bool:
+    """Persist one structure class's tuned-override record (tune/tuner
+    promotion, canary settle, revert, estimator adaptation -- the record
+    is small JSON, so eager per-event persistence is cheap and flush()
+    never needs to walk tuner state).  Atomic replace: unlike plans,
+    tune records MUTATE (canary -> live -> reverted), so the newest
+    write wins.  Validated on load against the BASE jit-static vector
+    (env > default): an env-exported knob that changed across restarts
+    invalidates every tuned decision made on top of the old base."""
+    if not active():
+        return False
+    import json  # noqa: PLC0415
+    with _LOCK:
+        d = _DIR
+    if d is None:
+        return False
+    payload = {
+        "schema": np.int64(SCHEMA_VERSION),
+        "kind": "tune",
+        "ident": class_key,
+        "knobs": repr(knobs.base_jit_static_vector()),
+        "payload": json.dumps(record, sort_keys=True),
+    }
+    try:
+        _atomic_savez(_tune_path(d, class_key), payload)
+    except OSError as e:
+        log.warning("tune record for %s not persisted (%r)", class_key, e)
+        return False
+    with _LOCK:
+        _STATS["saved_tunes"] += 1
+    return True
+
+
+def load_tunes() -> dict[str, dict]:
+    """Every persisted tuned-override record in the bound dir, keyed by
+    class key (daemon start -> tune.TUNER.load).  A corrupt, schema-
+    skewed, or base-knob-vector-mismatched entry is a counted cold
+    fallback (_note_corrupt: the class simply re-trials)."""
+    if not active():
+        return {}
+    import json  # noqa: PLC0415
+    with _LOCK:
+        d = _DIR
+    if d is None:
+        return {}
+    sig = repr(knobs.base_jit_static_vector())
+    out: dict[str, dict] = {}
+    try:
+        names = sorted(n for n in os.listdir(d)
+                       if n.startswith("tune-") and n.endswith(".npz"))
+    except OSError:
+        return {}
+    for name in names:
+        path = os.path.join(d, name)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                ident = str(z["ident"]) if "ident" in z.files else ""
+                if not _check_envelope(z, path, "tune", ident, sig=sig):
+                    continue
+                record = json.loads(str(z["payload"]))
+        except Exception as e:  # noqa: BLE001 -- any unreadable entry is the counted cold fallback, never a daemon-startup crash
+            _note_corrupt(path, f"unreadable: {e!r}")
+            continue
+        if isinstance(record, dict):
+            out[ident] = record
+    return out
+
+
+def scan_tunes(path: str) -> dict[str, dict]:
+    """Read-only view of an ARBITRARY dir's tune tier (cli tune --status
+    inspects a live daemon's dir): no binding, no flock, and -- unlike
+    load_tunes -- no unlinking or corrupt-counting, because the dir may
+    be owned by a running daemon.  Unreadable entries are skipped."""
+    import json  # noqa: PLC0415
+    out: dict[str, dict] = {}
+    if not os.path.isdir(path):
+        return out
+    for name in sorted(os.listdir(path)):
+        if not (name.startswith("tune-") and name.endswith(".npz")):
+            continue
+        try:
+            with np.load(os.path.join(path, name),
+                         allow_pickle=False) as z:
+                if str(z["kind"]) != "tune":
+                    continue
+                record = json.loads(str(z["payload"]))
+                ident = str(z["ident"])
+        except Exception:  # noqa: BLE001 -- read-only probe of a possibly-live dir: skip, never touch
+            continue
+        if isinstance(record, dict):
+            out[ident] = record
+    return out
+
+
 def flush() -> dict:
     """Persist every in-memory entry not yet on disk, then prune to the
     byte budget.  Called by spgemmd after each terminal job event and at
@@ -630,7 +743,7 @@ def stats() -> dict:
     spgemmd stats, and the Prometheus scrape."""
     from spgemm_tpu.ops import delta  # noqa: PLC0415 -- shared bracket parser only
     with _LOCK:
-        plans, deltas, size = _scan_locked()
+        plans, deltas, tunes, size = _scan_locked()
         # DISTINCT delta keys this process persisted, split by the
         # device-placement bracket ops/spgemm._delta_key appends (parsed
         # by the one shared helper, delta.placement_histogram): under
@@ -646,6 +759,7 @@ def stats() -> dict:
             "disabled_reason": _DISABLED,
             "plans": plans,
             "deltas": deltas,
+            "tunes": tunes,
             "bytes": size,
             "budget_bytes": budget_bytes(),
             "delta_placements": placements,
@@ -659,7 +773,7 @@ def scan(path: str) -> dict:
     daemon's dir without stealing it): entry counts, bytes, and whether
     a live process currently holds the dir's lock."""
     out = {"dir": path, "exists": os.path.isdir(path), "plans": 0,
-           "deltas": 0, "bytes": 0, "locked": False,
+           "deltas": 0, "tunes": 0, "bytes": 0, "locked": False,
            "budget_bytes": budget_bytes()}
     if not out["exists"]:
         return out
@@ -674,6 +788,8 @@ def scan(path: str) -> dict:
             out["plans"] += 1
         elif name.startswith("delta-"):
             out["deltas"] += 1
+        elif name.startswith("tune-"):
+            out["tunes"] += 1
     lock_path = os.path.join(path, "lock")
     if os.path.exists(lock_path):
         import fcntl  # noqa: PLC0415
@@ -732,4 +848,40 @@ def clear(path: str | None = None) -> int:
         shutil.rmtree(xla_dir, ignore_errors=True)
     with _LOCK:
         _SAVED_DELTA.clear()
+    return removed
+
+
+def clear_tunes(path: str) -> int:
+    """Delete ONLY the tune tier's entries under `path` (`cli tune
+    --clear`): the plan/delta tiers stay -- dropping a bad override must
+    not also throw away the warm plans a restart depends on.  Same
+    live-process refusal as clear().  Returns entries removed."""
+    if not os.path.isdir(path):
+        return 0
+    with _LOCK:
+        own = _LOCK_FILE is not None and _DIR == path
+    if not own:
+        import fcntl  # noqa: PLC0415
+        try:
+            probe = open(os.path.join(path, "lock"), "a+")
+        except OSError:
+            probe = None
+        if probe is not None:
+            try:
+                fcntl.flock(probe.fileno(),
+                            fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                probe.close()
+                raise RuntimeError(
+                    f"warm dir {path} is in use by a live process; "
+                    "stop it before clearing tune overrides") from None
+            probe.close()  # drops the probe lock
+    removed = 0
+    for name in os.listdir(path):
+        if name.startswith("tune-") and name.endswith(".npz"):
+            try:
+                os.unlink(os.path.join(path, name))
+                removed += 1
+            except OSError:
+                pass
     return removed

@@ -94,6 +94,7 @@ from spgemm_tpu.obs import slo as obs_slo
 from spgemm_tpu.obs import trace as obs_trace
 from spgemm_tpu.ops import warmstore
 from spgemm_tpu.parallel import mesh as mesh_mod
+from spgemm_tpu import tune as tune_mod
 from spgemm_tpu.serve import placement, protocol
 from spgemm_tpu.serve.queue import (TERMINAL, Job, JobAbandoned, JobQueue,
                                     QueueFull, TenantCapExceeded)
@@ -482,7 +483,8 @@ class Daemon:
                  persist_compile_cache: bool = False,
                  slices: str | None = None, n_devices: int | None = None,
                  tenant_inflight: int | None = None,
-                 recover_s: float | None = None):
+                 recover_s: float | None = None,
+                 device_kind: str | None = None):
         self.socket_path = socket_path or protocol.default_socket_path()
         self.journal_path = self.socket_path + ".journal"
         # postmortem flight dumps (watchdog reap / wedge / degrade) land
@@ -567,6 +569,13 @@ class Daemon:
         # read by the executors and every stats request.
         self.degraded = False                    # spgemm-lint: guarded-by(_lock)
         self.degrade_reason: str | None = None   # spgemm-lint: guarded-by(_lock)
+        # autotuner (spgemm_tpu/tune): the tune-class device kind (main()
+        # passes the probed platform; a jax.devices() call HERE would
+        # hang on a dead TPU and break the module's jax-free contract),
+        # and the pool-wide last-trial-leg claim stamp -- one leg per
+        # SPGEMM_TPU_TUNE_TRIAL_S across every executor's idle tick
+        self._tune_device_kind = device_kind or "cpu"
+        self._tune_last_trial = 0.0              # spgemm-lint: guarded-by(_lock)
         self._probe_outcome: str | None = None   # spgemm-lint: guarded-by(_lock)
         self._started_at = time.time()
         self._next_id = 1                        # spgemm-lint: guarded-by(_lock)
@@ -726,6 +735,18 @@ class Daemon:
         if warmstore.configure(self.warm_dir) \
                 and self._persist_compile_cache:
             warmstore.configure_compilation_cache()
+        # autotuner: wire the warm store's tune tier as the override
+        # persistence (promotions/reverts flush immediately -- unlike
+        # plans, a tune record mutates) and adopt every persisted
+        # override up front, so a restarted daemon serves its first
+        # same-class job already tuned (canary records re-audit: the
+        # first post-restart job runs the tightened-deadline gate again)
+        if tune_mod.enabled():
+            tune_mod.TUNER.persist_with(warmstore.save_tune)
+            adopted = tune_mod.TUNER.load(warmstore.load_tunes())
+            if adopted:
+                log.info("tuner: adopted %d persisted override record(s)",
+                         adopted)
         self._journal_replay()
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
@@ -945,6 +966,12 @@ class Daemon:
                 timeout=0.2,
                 accept=lambda j: gen == sl.gen and self._accepts(sl, j))
             if job is None:
+                # the autotune trial lane: an idle tick (no job claimed)
+                # may run AT MOST one timed trial leg, and only while
+                # the whole pool is idle -- a real job always wins the
+                # next tick because run_trial_leg's heartbeat preempts
+                # the leg the moment the queue goes nonempty
+                self._maybe_tune(sl, gen)
                 continue
             if job.state != "queued":  # reaped while still in the FIFO
                 if sl.current is job:
@@ -961,6 +988,22 @@ class Daemon:
             job.slice = sl.name
             job.device_ids = sl.device_ids \
                 if len(self.slices) > 1 or sl.width > 1 else None
+            # autotune activation: resolve the job's structure class
+            # (admission group key x device kind) and swap the process
+            # overlay to ITS promoted vector -- replace-atomic, so a
+            # class with no override restores the base vector.  The
+            # estimator-accuracy baseline rides the job for the
+            # terminal-side adaptation diff.  All no-ops (overlay stays
+            # {} = {}) under SPGEMM_TPU_TUNE=0 or for untuned classes:
+            # the phase never accumulates, the scrape stays identical.
+            job.tune_class = plancache.tune_class_key(
+                job.group_key, self._tune_device_kind)
+            overlay = tune_mod.TUNER.overlay_for(job.tune_class)
+            if overlay != knobs.tuned_overlay():
+                with ENGINE.phase("tune_apply"):
+                    knobs.set_tuned(overlay)
+            tcanary = tune_mod.TUNER.consume_canary(job.tune_class)
+            job.est_base = obs_profile.est_stats()
             with self._lock:
                 degraded = sl.degraded
                 canary = sl.canary and not degraded
@@ -991,12 +1034,24 @@ class Daemon:
                     job.timeout_s = tight
                 obs_events.emit("slice_canary", slice=sl.name,
                                 job_id=job.id, timeout_s=job.timeout_s)
+            if tcanary:
+                # the TUNED-OVERRIDE canary (PR 13's recovery-canary
+                # gate, reused for rollout): the first job under a
+                # freshly promoted knob vector runs a tightened deadline
+                # -- if the vector somehow misbehaves at scale, the reap
+                # costs one cheap job and note_terminal reverts + backs
+                # off.  Same tightening arithmetic as the slice canary.
+                tight = job.timeout_s / 2 if job.timeout_s > 0 \
+                    else self._wedge_grace_s
+                if tight > 0:
+                    job.timeout_s = tight
             # cross-job batching (SPGEMM_TPU_SERVE_BATCH_K/_WINDOW_S):
             # a batchable head drains same-structure mates and the whole
             # group runs as one fused pickup.  Degraded and canary
             # pickups never batch (the failover path has no fused
-            # runner; an audition must risk exactly one job).
-            mates = [] if degraded or canary \
+            # runner; an audition -- slice recovery OR tuned-override
+            # rollout -- must risk exactly one job).
+            mates = [] if degraded or canary or tcanary \
                 else self._drain_batch_mates(sl, job)
             if mates:
                 self._run_batch_members(sl, job, mates)
@@ -1087,6 +1142,76 @@ class Daemon:
                 # still ours, never the successor's current job
                 if sl.current is job:
                     sl.current = None
+
+    # ------------------------------------------------------------ autotune --
+    def _maybe_tune(self, sl: _Slice, gen: int) -> None:
+        """One idle-tick autotune hook (ARCHITECTURE.md "L6 autotune
+        lifecycle"): with the trial lane armed
+        (SPGEMM_TPU_TUNE_TRIAL_S > 0 and SPGEMM_TPU_TUNE on), an
+        executor whose queue poll came up empty may run AT MOST one
+        timed trial leg -- and only while the WHOLE pool is idle (any
+        slice mid-job skews the measurement and a trial must never
+        contend for the device a real job is about to want).  The
+        cadence stamp is claimed under _lock so a many-slice pool still
+        runs one leg per cadence window, not one per slice.  Trial legs
+        are invisible to tenant DRR, admission, and the SLO windows by
+        construction: they never touch the queue or Job machinery."""
+        if self._stop.is_set() or gen != sl.gen:
+            return
+        cadence = tune_mod.trial_cadence_s()
+        if cadence <= 0 or not tune_mod.enabled():
+            return
+        now = time.monotonic()
+        with self._lock:
+            if sl.degraded or sl.canary:
+                return  # never trial on an untrusted / auditioning slice
+            if any(s.current is not None for s in self.slices):
+                return  # pool not idle: a real job is running somewhere
+            if now - self._tune_last_trial < cadence:
+                return
+            self._tune_last_trial = now
+        if self.queue.counts()["depth"] > 0:
+            return  # work already waiting beats any trial
+        tune_mod.run_trial_leg(self._tune_run_fn(sl, gen),
+                               placement.rep_folder,
+                               extra={"SPGEMM_TPU_DELTA": "0"})
+
+    def _tune_run_fn(self, sl: _Slice, gen: int):
+        """The trial leg's chain runner: read the class's representative
+        folder, reduce the chain exactly as a solo job would, and return
+        a content digest of the result (the tuner's parity spot-check --
+        every candidate vector must reproduce the baseline leg's bits).
+        The heartbeat chain_product plants between multiplies raises
+        TrialPreempted the moment a real job is queued, the daemon is
+        stopping, or this executor generation retired: a trial yields
+        the device within one multiply boundary, the same granularity as
+        the watchdog's abandonment contract.  Trials run on the
+        process-default device placement -- every pool device is the
+        same kind, so the wall-clock ranking transfers to any slice; the
+        leg runs under SPGEMM_TPU_DELTA=0 (run_trial_leg's `extra` pin),
+        so repeats are never answered from the delta store's retained
+        result."""
+        def run(folder: str) -> str:
+            import hashlib  # noqa: PLC0415
+
+            from spgemm_tpu import chain  # noqa: PLC0415
+            from spgemm_tpu.ops import plancache  # noqa: PLC0415
+            from spgemm_tpu.utils import io_text  # noqa: PLC0415
+
+            def beat() -> None:
+                if self._stop.is_set() or gen != sl.gen \
+                        or self.queue.counts()["depth"] > 0:
+                    raise tune_mod.TrialPreempted(folder)
+
+            beat()  # a job may have landed between the claim and here
+            n, k = io_text.read_size(folder)
+            mats = io_text.read_chain(folder, 0, n - 1, k)
+            result = chain.chain_product(mats, heartbeat=beat)
+            h = hashlib.sha256()
+            plancache.hash_update(h, result.coords)
+            plancache.hash_update(h, result.tiles)
+            return h.hexdigest()
+        return run
 
     # ------------------------------------------------------------ batching --
     def _drain_batch_mates(self, sl: _Slice, head: Job) -> list[Job]:
@@ -1348,6 +1473,33 @@ class Daemon:
                             wall_s=wall, queue_wait_s=queue_wait,
                             error=outcome != "done",
                             trace_id=job.trace_id)
+        # autotune terminal feed (outside _lock, like the SLO record --
+        # the tuner has its own lock and daemon/engine locks never
+        # nest): register the class sighting + its representative
+        # folder for the idle trial lane, settle an in-flight override
+        # canary on this job's outcome, and score the estimator's
+        # accuracy over the job (the pickup baseline diffs against the
+        # live obs/profile account) for the class's sample/confidence
+        # adaptation.  job.tune_class is None for first-contact and
+        # replayed jobs -- every call below no-ops then.
+        tune_ck = getattr(job, "tune_class", None)
+        if tune_ck is not None:
+            tune_mod.TUNER.note_job(tune_ck, self._tune_device_kind)
+            placement.note_class(tune_ck, job.folder)
+            tune_mod.TUNER.note_terminal(tune_ck, outcome == "done")
+            base = job.est_base
+            if base is not None:
+                cur = obs_profile.est_stats()
+                errs = []
+                for qty, hist in cur["rel_error"].items():
+                    prev = (base.get("rel_error") or {}).get(
+                        qty, {"sum": 0.0, "count": 0})
+                    dn = hist["count"] - prev["count"]
+                    if dn > 0:
+                        errs.append((hist["sum"] - prev["sum"]) / dn)
+                if errs:
+                    tune_mod.TUNER.note_est_accuracy(
+                        tune_ck, sum(errs) / len(errs))
 
     def _flight_dump(self, name: str) -> str | None:
         """Snapshot the span flight recorder next to the journal
@@ -2016,6 +2168,7 @@ class Daemon:
             plan_cache=cache,
             delta=delta_stats,
             warm=warm_stats,
+            tune=tune_mod.TUNER.stats(),
             socket=self.socket_path,
         )
 
@@ -2085,6 +2238,20 @@ class Daemon:
         samples += [("spgemmd_tenant_queue_depth", {"tenant": tenant}, n)
                     for tenant, n in sorted(depths.items())]
         samples += obs_slo.SLO.samples()
+        # autotune families render only once the tuner holds class state
+        # (first sighting needs a job under a recorded structure WITH
+        # tuning on), so a SPGEMM_TPU_TUNE=0 daemon's scrape -- and a
+        # tuned-but-never-contacted one's -- stays byte-identical to the
+        # pre-tuner surface
+        tstats = tune_mod.TUNER.stats()
+        if tstats["classes"]:
+            samples += [("spgemm_tune_overrides", {"state": state}, n)
+                        for state, n in sorted(tstats["overrides"].items())]
+            samples += [("spgemm_tune_win_ratio",
+                         {"class": row["class"]}, row["win"])
+                        for row in tstats["classes"]
+                        if row["state"] in ("canary", "live")
+                        and row["win"] is not None]
         return protocol.ok(
             content_type="text/plain; version=0.0.4; charset=utf-8",
             text=obs_metrics.render(samples))
@@ -2169,17 +2336,26 @@ def main(argv: list[str] | None = None) -> int:
     # safe, and a degraded-at-start daemon serves host-only anyway
     try:
         import jax  # noqa: PLC0415
-        n_devices = len(jax.devices())
+        devices = jax.devices()
+        n_devices = len(devices)
+        # the autotune class key's device half: a vector tuned on this
+        # pool must never be adopted by a pool of a different device
+        # kind (main() resolves it here, post-probe, because the Daemon
+        # itself is jax-free -- a jax.devices() call there would hang on
+        # a dead TPU)
+        device_kind = devices[0].platform if devices else "cpu"
     except Exception as e:  # noqa: BLE001 -- a dead backend must not kill the failover daemon
         log.warning("device count unavailable (%r); pool runs host-only",
                     e)
         n_devices = 1
+        device_kind = "cpu"
         degraded_at_start = True
     try:
         daemon = Daemon(args.socket, queue_cap=args.queue_cap,
                         journal=not args.no_journal,
                         persist_compile_cache=True,
-                        slices=args.slices, n_devices=n_devices)
+                        slices=args.slices, n_devices=n_devices,
+                        device_kind=device_kind)
     except mesh_mod.SliceSpecError as e:
         print(f"spgemmd: {e}", file=sys.stderr)
         return 1

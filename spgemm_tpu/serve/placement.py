@@ -51,6 +51,8 @@ BOOK_CAP = 4096
 
 _LOCK = threading.Lock()
 _BOOK: "OrderedDict[str, float]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
+# autotune class -> representative folder (same LRU discipline)
+_CLASS_BOOK: "OrderedDict[str, str]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
 _STATS = {"book_hits": 0, "book_misses": 0,
           "routed": {}}  # spgemm-lint: guarded-by(_LOCK)
 
@@ -112,6 +114,36 @@ def lookup_mass(folder: str) -> float | None:
         return _BOOK[sig]
 
 
+def note_class(class_key: str | None, folder: str) -> None:
+    """Record a representative folder for an autotune structure class
+    (executor terminal path, alongside note_mass): the tuner's idle
+    trial legs replay THIS folder to time candidate knob vectors on the
+    class's real structure.  Newest sighting wins -- any member folder
+    is representative, the class groups same-structure chains."""
+    if class_key is None:
+        return
+    with _LOCK:
+        _CLASS_BOOK[class_key] = folder
+        _CLASS_BOOK.move_to_end(class_key)
+        while len(_CLASS_BOOK) > BOOK_CAP:
+            _CLASS_BOOK.popitem(last=False)
+
+
+def rep_folder(class_key: str) -> str | None:
+    """The recorded representative folder for a tune class, or None
+    (class never seen, evicted, or the folder vanished -- the tuner
+    skips the class; a stale path is re-checked here so a deleted input
+    never reaches a trial leg)."""
+    with _LOCK:
+        folder = _CLASS_BOOK.get(class_key)
+    if folder is not None and not os.path.isdir(folder):
+        with _LOCK:
+            if _CLASS_BOOK.get(class_key) == folder:
+                del _CLASS_BOOK[class_key]
+        return None
+    return folder
+
+
 def route(folder: str) -> dict:
     """The admission-time placement record for a job: `class` is
     small|large|default (narrowest slice / widest slice / the spec's
@@ -150,5 +182,6 @@ def clear() -> None:
     """Drop the book and zero the stats (tests, A/B harnesses)."""
     with _LOCK:
         _BOOK.clear()
+        _CLASS_BOOK.clear()
         _STATS["book_hits"] = _STATS["book_misses"] = 0
         _STATS["routed"].clear()

@@ -128,7 +128,7 @@ RESPONSE_FIELDS: dict[str, dict[str, int]] = {
               "tenant_inflight_cap": 2, "placement": 2, "journal": 1,
               "failpoints": 1, "trace": 3, "events": 3, "profile": 3,
               "slo": 3, "flight_dir": 3, "plan_cache": 1, "delta": 1,
-              "warm": 1, "socket": 1},
+              "warm": 1, "tune": 3, "socket": 1},
     "metrics": {"content_type": 1, "text": 1},
     "trace": {"spans": 1, "trace_events": 1},
     "profile": {"profile": 1},

@@ -138,6 +138,14 @@ class Job:
         # baseline): per-job detail diffs against it, so a second job's
         # hit/miss figures never inherit the first's process totals
         self.cache_base = None
+        # autotune pickup state (serve/daemon + spgemm_tpu/tune): the
+        # job's resolved structure-class key (None = first contact,
+        # never tuned) and the estimator-accuracy baseline captured at
+        # pickup (obs/profile.est_stats) -- the terminal path diffs the
+        # live account against it to score this job's estimator for the
+        # class's sample/confidence adaptation
+        self.tune_class: str | None = None
+        self.est_base = None
         self._lock = threading.Lock()
         self._terminal = threading.Event()
 

@@ -1,0 +1,22 @@
+"""spgemm_tpu.tune: telemetry-driven autotuner (ARCHITECTURE.md "L6
+autotune lifecycle").
+
+The control loop the rest of the engine only measures: a deterministic
+trial planner enumerates the bit-identical jit-static knob space per
+structure class, spgemmd times the legs on idle slices (preempted the
+moment a real job arrives), winners persist into the warm store's
+tuned-override tier, and a promoted vector reaches live traffic behind
+the canary gate.  jax-free by design: trial execution is a
+daemon-supplied callback, persistence is an injected store.
+"""
+
+from spgemm_tpu.tune.tuner import (  # noqa: F401
+    TUNER,
+    TrialPreempted,
+    Tuner,
+    enabled,
+    min_win,
+    run_trial_leg,
+    trial_cadence_s,
+    trial_vectors,
+)

@@ -1,0 +1,526 @@
+"""Telemetry-driven autotuner: per-structure-class search over the
+bit-identical jit-static knob space (ARCHITECTURE.md "L6 autotune
+lifecycle").
+
+Every knob the planner enumerates (`SPGEMM_TPU_ACCUM_ROUTE`,
+`SPGEMM_TPU_ROUND_BATCH`, `SPGEMM_TPU_MXU_R`, `SPGEMM_TPU_RING_OVERLAP`)
+is bit-identical A/B by construction -- tuning steers wall clock only,
+never bits -- so the search needs no numeric acceptance test beyond the
+trial-time parity spot-check (every leg's result digest must equal the
+baseline leg's; a mismatch is an engine bug and parks the class).
+
+Lifecycle per (structure class, device kind):
+
+    idle -> trialing -> canary -> live
+                \\-> settled (no vector beat SPGEMM_TPU_TUNE_MIN_WIN)
+    canary failure / parity mismatch -> reverted (+ exponential backoff,
+    re-trialed after the backoff expires)
+
+Scheduling is the daemon's job: spgemmd calls `run_trial_leg` from an
+executor's idle tick, at most ONE leg per tick, only while the whole
+pool is idle -- preemption is structural (a real job arriving mid-leg
+aborts it at the next heartbeat via TrialPreempted), and trial legs are
+never counted against tenant DRR or SLO windows.
+
+jax-free by design: trial execution is a daemon-supplied
+`run_fn(folder) -> digest` callback (wall time is clocked here), and
+persistence is an injected store (ops/warmstore's tune tier).  The
+overlay a promoted vector activates is knobs.set_tuned -- process-global
+and replace-atomic; two slices concurrently activating different
+classes race on wall clock only, never on bits.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from spgemm_tpu.obs import events
+from spgemm_tpu.utils import failpoints, knobs
+from spgemm_tpu.utils.timers import ENGINE
+
+# Vector candidates per searched knob (deviations from the base value
+# are enumerated; the base vector itself is always leg 0).
+_ROUTE_CHOICES = ("auto", "ladder", "dense")
+_MXU_R_CHOICES = ("4", "8", "16")
+
+# Revert backoff: first canary/parity failure parks the class this long;
+# every subsequent failure doubles it (capped).
+BACKOFF0_S = 60.0
+BACKOFF_CAP_S = 3600.0
+
+# Estimator adaptation (ROADMAP item (b)): after EST_MIN_JOBS scored
+# jobs, a class whose mean rel-error stays under EST_TIGHT halves its
+# row-sample budget (floored at the registry minimum), and a class whose
+# mean rel-error exceeds EST_MISS raises its confidence threshold by
+# EST_CONF_STEP (capped at 1.0 -- past 1 the registry doc says the
+# fallback fires everywhere, which is exactly the intent for a class
+# the estimator keeps misjudging).
+EST_MIN_JOBS = 4
+EST_TIGHT = 0.05
+EST_MISS = 0.5
+EST_CONF_STEP = 0.2
+EST_ROWS_FLOOR = 8
+
+
+class TrialPreempted(Exception):
+    """Raised by the daemon's trial run_fn (from the heartbeat it plants
+    between multiplies) when a real job arrived mid-leg: the leg is
+    discarded and the executor returns to the queue within one
+    heartbeat."""
+
+
+def enabled() -> bool:
+    """Master tuner switch (SPGEMM_TPU_TUNE)."""
+    return bool(knobs.get("SPGEMM_TPU_TUNE"))
+
+
+def trial_cadence_s() -> float:
+    """Idle-trial cadence (SPGEMM_TPU_TUNE_TRIAL_S; 0 = no trials)."""
+    return float(knobs.get("SPGEMM_TPU_TUNE_TRIAL_S") or 0)
+
+
+def min_win() -> float:
+    """Promotion threshold (SPGEMM_TPU_TUNE_MIN_WIN)."""
+    return float(knobs.get("SPGEMM_TPU_TUNE_MIN_WIN") or 1.1)
+
+
+def trial_vectors(device_kind: str) -> list[dict[str, str]]:
+    """Deterministic trial plan for one structure class: leg 0 is the
+    base vector (empty overlay -- the incumbent), then one-knob
+    deviations from the base in registry-stable order.  Coordinate
+    search, not the cross product: the searched knobs are near-
+    independent (route and batching act on disjoint dispatch layers),
+    and one-at-a-time keeps the idle-lane budget at ~7 compiles per
+    class instead of 36.
+
+    MXU_R / RING_OVERLAP deviations only enumerate off-CPU: the CPU
+    'mxu' lowering is an XLA oracle and single-host CPU runs never take
+    the ring, so their legs would time pure noise.
+    """
+    legs: list[dict[str, str]] = [{}]
+    base_route = str(knobs.base_get("SPGEMM_TPU_ACCUM_ROUTE"))
+    for route in _ROUTE_CHOICES:
+        if route != base_route:
+            legs.append({"SPGEMM_TPU_ACCUM_ROUTE": route})
+    base_rb = "1" if knobs.base_get("SPGEMM_TPU_ROUND_BATCH") else "0"
+    legs.append({"SPGEMM_TPU_ROUND_BATCH": "0" if base_rb == "1" else "1"})
+    if "cpu" not in (device_kind or "").lower():
+        base_r = str(knobs.base_get("SPGEMM_TPU_MXU_R"))
+        for r in _MXU_R_CHOICES:
+            if r != base_r:
+                legs.append({"SPGEMM_TPU_MXU_R": r})
+        base_ring = "1" if knobs.base_get("SPGEMM_TPU_RING_OVERLAP") else "0"
+        legs.append(
+            {"SPGEMM_TPU_RING_OVERLAP": "0" if base_ring == "1" else "1"})
+    return legs
+
+
+class _ClassState:
+    """One structure class's tuner record.  All mutable fields are owned
+    by the Tuner's lock (the class object never leaves the Tuner)."""
+
+    def __init__(self, class_key: str, device_kind: str):
+        self.class_key = class_key
+        self.device_kind = device_kind
+        self.state = "idle"  # idle|trialing|settled|canary|live|reverted
+        self.pending: list[dict[str, str]] | None = None
+        self.results: list[tuple[dict[str, str], float]] = []
+        self.baseline_s: float | None = None
+        self.baseline_digest = None
+        self.override: dict[str, str] | None = None
+        self.win: float | None = None
+        self.backoff_s = 0.0
+        self.retry_at = 0.0          # monotonic: no re-trial before this
+        self.canary_inflight = False
+        self.est_n = 0
+        self.est_sum = 0.0
+        self.est_override: dict[str, str] = {}
+
+    def row(self) -> dict:
+        """Status row (cli tune / spgemmd stats)."""
+        return {
+            "class": self.class_key,
+            "device_kind": self.device_kind,
+            "state": self.state,
+            "knobs": dict(self.override or {}),
+            "est": dict(self.est_override),
+            "win": self.win,
+            "backoff_s": self.backoff_s,
+        }
+
+
+class Tuner:
+    """The autotuner state machine: class registry, trial planning,
+    promotion, canary accounting, estimator adaptation, persistence.
+
+    Thread-safe: executors feed it from their idle ticks and terminal
+    paths concurrently.  Trial EXECUTION happens outside the lock (the
+    leg's run_fn compiles and dispatches); only bookkeeping holds it.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._classes: dict[str, _ClassState] = {}  # spgemm-lint: guarded-by(_lock)
+        self._persist = None                        # spgemm-lint: guarded-by(_lock)
+        self._trials = 0                            # spgemm-lint: guarded-by(_lock)
+        self._reverts = 0                           # spgemm-lint: guarded-by(_lock)
+
+    # -------------------------------------------------- wiring --
+    def persist_with(self, fn) -> None:
+        """Install the override store (warmstore.save_tune-shaped:
+        fn(class_key, record) -> bool).  None disables persistence."""
+        with self._lock:
+            self._persist = fn
+
+    def load(self, records: dict[str, dict]) -> int:
+        """Seed classes from the warm store's tune tier (daemon start).
+        Returns the number of records adopted.  A record whose state was
+        canary when the daemon died stays canary: the first job after
+        restart re-audits it.  Reverted records keep their backoff
+        (re-anchored to this process's clock from the stored horizon)."""
+        now = time.monotonic()
+        wall = time.time()
+        n = 0
+        with self._lock:
+            for class_key, rec in sorted(records.items()):
+                st = self._classes.get(class_key)
+                if st is None:
+                    st = _ClassState(class_key,
+                                     str(rec.get("device_kind", "")))
+                    self._classes[class_key] = st
+                state = rec.get("state")
+                if state in ("canary", "live"):
+                    ov = {str(k): str(v)
+                          for k, v in (rec.get("knobs") or {}).items()
+                          if k in knobs.REGISTRY}
+                    if not ov:
+                        continue
+                    st.state = state
+                    st.override = ov
+                    st.win = rec.get("win")
+                elif state == "reverted":
+                    st.state = "reverted"
+                    st.backoff_s = float(rec.get("backoff_s") or BACKOFF0_S)
+                    st.retry_at = now + max(
+                        0.0, float(rec.get("not_before", 0.0)) - wall)
+                else:
+                    continue
+                st.est_override = {
+                    str(k): str(v)
+                    for k, v in (rec.get("est") or {}).items()
+                    if k in knobs.REGISTRY}
+                n += 1
+        return n
+
+    def _persist_locked(self, st: _ClassState) -> None:
+        fn = self._persist
+        if fn is None:
+            return
+        rec = {"class_key": st.class_key, "device_kind": st.device_kind,
+               "state": st.state, "knobs": dict(st.override or {}),
+               "est": dict(st.est_override), "win": st.win,
+               "backoff_s": st.backoff_s,
+               "not_before": time.time() + max(
+                   0.0, st.retry_at - time.monotonic())}
+        try:
+            fn(st.class_key, rec)
+        except Exception:  # noqa: BLE001 -- a failing store must never take down the serving path; the override just won't survive restart
+            pass
+
+    # -------------------------------------------------- job feed --
+    def note_job(self, class_key: str | None, device_kind: str) -> None:
+        """Register a structure class sighting (daemon terminal path).
+        First sighting creates the class in idle; trials start once the
+        rep-folder book can answer for it."""
+        if not class_key or not enabled():
+            return
+        with self._lock:
+            if class_key not in self._classes:
+                self._classes[class_key] = _ClassState(class_key,
+                                                       device_kind)
+
+    def overlay_for(self, class_key: str | None) -> dict[str, str]:
+        """The knob overlay this class's jobs should run under: the
+        promoted vector (canary/live) merged with the estimator
+        adaptation; {} when nothing is tuned (or tuning is off)."""
+        if not class_key or not enabled():
+            return {}
+        with self._lock:
+            st = self._classes.get(class_key)
+            if st is None:
+                return {}
+            ov = dict(st.est_override)
+            if st.state in ("canary", "live") and st.override:
+                ov.update(st.override)
+            return ov
+
+    def consume_canary(self, class_key: str | None) -> bool:
+        """True exactly once per canary attempt: the caller (daemon job
+        pickup) tightens the job's deadline and audits its terminal
+        outcome via note_terminal."""
+        if not class_key or not enabled():
+            return False
+        with self._lock:
+            st = self._classes.get(class_key)
+            if st is None or st.state != "canary" or st.canary_inflight:
+                return False
+            st.canary_inflight = True
+            return True
+
+    def note_terminal(self, class_key: str | None, ok: bool) -> None:
+        """Terminal outcome of a job that ran under this class (daemon
+        _observe_terminal).  Settles an in-flight canary: success goes
+        live, failure reverts the override and backs off."""
+        if not class_key:
+            return
+        with self._lock:
+            st = self._classes.get(class_key)
+            if st is None or not st.canary_inflight:
+                return
+            st.canary_inflight = False
+            if ok:
+                st.state = "live"
+                self._persist_locked(st)
+                events.emit("tune_canary_passed", class_key=class_key,
+                            win=st.win, knobs=dict(st.override or {}))
+            else:
+                self._revert_locked(st, "canary-failed")
+
+    def _revert_locked(self, st: _ClassState, reason: str) -> None:
+        st.state = "reverted"
+        st.override = None
+        st.win = None
+        st.pending = None
+        st.results = []
+        st.baseline_s = None
+        st.baseline_digest = None
+        st.backoff_s = min(BACKOFF_CAP_S,
+                           (st.backoff_s * 2) if st.backoff_s else BACKOFF0_S)
+        st.retry_at = time.monotonic() + st.backoff_s
+        self._reverts += 1
+        ENGINE.incr("tune_reverts")
+        self._persist_locked(st)
+        events.emit("tune_revert", class_key=st.class_key, reason=reason,
+                    backoff_s=st.backoff_s)
+
+    # ------------------------------------------- estimator loop --
+    def note_est_accuracy(self, class_key: str | None,
+                          mean_rel_err: float, n: int = 1) -> None:
+        """Feed one job's observed estimator accuracy (mean rel-error
+        over the quantities obs/profile scored for it).  ROADMAP (b):
+        tight classes shrink SPGEMM_TPU_EST_SAMPLE_ROWS, misfiring
+        classes raise SPGEMM_TPU_EST_CONFIDENCE -- both bounded by the
+        registry's declared ranges, both riding the class overlay."""
+        if not class_key or not enabled() or n <= 0:
+            return
+        with self._lock:
+            st = self._classes.get(class_key)
+            if st is None:
+                return
+            st.est_n += n
+            st.est_sum += float(mean_rel_err) * n
+            if st.est_n < EST_MIN_JOBS:
+                return
+            mean = st.est_sum / st.est_n
+            st.est_n = 0
+            st.est_sum = 0.0
+            if mean < EST_TIGHT:
+                kb = knobs.REGISTRY["SPGEMM_TPU_EST_SAMPLE_ROWS"]
+                cur = int(st.est_override.get(
+                    "SPGEMM_TPU_EST_SAMPLE_ROWS",
+                    knobs.base_get("SPGEMM_TPU_EST_SAMPLE_ROWS")))
+                floor = max(int(kb.minimum or 1), EST_ROWS_FLOOR)
+                new = max(floor, cur // 2)
+                if new != cur:
+                    st.est_override["SPGEMM_TPU_EST_SAMPLE_ROWS"] = str(new)
+                    self._persist_locked(st)
+            elif mean > EST_MISS:
+                cur = float(st.est_override.get(
+                    "SPGEMM_TPU_EST_CONFIDENCE",
+                    knobs.base_get("SPGEMM_TPU_EST_CONFIDENCE")))
+                new = min(1.0, cur + EST_CONF_STEP)
+                if new != cur:
+                    st.est_override["SPGEMM_TPU_EST_CONFIDENCE"] = \
+                        f"{new:g}"
+                    self._persist_locked(st)
+
+    # ---------------------------------------------- trial lane --
+    def next_leg(self, folder_of) -> tuple[str, str, dict[str, str]] | None:
+        """Claim the next due trial leg: (class_key, folder, vector), or
+        None when no class is due.  `folder_of(class_key)` resolves the
+        class's representative folder (serve/placement.rep_folder); a
+        class the book cannot answer for is skipped.  Classes are
+        visited in sorted order for determinism; a reverted class
+        re-enters trialing once its backoff expired."""
+        if not enabled():
+            return None
+        now = time.monotonic()
+        with self._lock:
+            for class_key in sorted(self._classes):
+                st = self._classes[class_key]
+                if st.state == "reverted" and now >= st.retry_at:
+                    st.state = "idle"
+                if st.state not in ("idle", "trialing"):
+                    continue
+                folder = folder_of(class_key)
+                if folder is None:
+                    continue
+                if st.pending is None:
+                    st.state = "trialing"
+                    st.pending = trial_vectors(st.device_kind)
+                    st.results = []
+                if not st.pending:
+                    continue
+                return class_key, folder, st.pending[0]
+        return None
+
+    def record_leg(self, class_key: str, vector: dict[str, str],
+                   seconds: float, digest) -> None:
+        """Commit one timed leg.  The baseline leg (empty vector) pins
+        the parity digest; any later leg whose digest differs parks the
+        class (that would be an engine bug -- the searched knobs are
+        bit-identical by construction -- so the tuner must not promote
+        anything on top of it).  Exhausting the plan decides: the best
+        candidate is promoted to canary iff it beat the baseline by
+        SPGEMM_TPU_TUNE_MIN_WIN, else the class settles untuned."""
+        with self._lock:
+            st = self._classes.get(class_key)
+            if st is None or st.state != "trialing" or not st.pending \
+                    or st.pending[0] != vector:
+                return  # stale leg (revert/reload raced it): discard
+            st.pending.pop(0)
+            if not vector:
+                st.baseline_s = seconds
+                st.baseline_digest = digest
+            elif st.baseline_digest is not None \
+                    and digest != st.baseline_digest:
+                self._revert_locked(st, "parity-mismatch")
+                return
+            else:
+                st.results.append((dict(vector), seconds))
+            if st.pending:
+                return
+            st.pending = None
+            self._decide_locked(st)
+
+    def record_preempted(self, class_key: str, vector: dict[str, str],
+                         reason: str) -> None:
+        """A leg was aborted (real job arrived, failpoint, overlay swap
+        mid-measurement): discard the measurement -- class state is
+        deliberately untouched, so the same leg simply re-runs at the
+        next idle window."""
+        events.emit("tune_trial_preempted", class_key=class_key,
+                    knobs=dict(vector), reason=reason)
+
+    def _decide_locked(self, st: _ClassState) -> None:
+        if st.baseline_s is None or not st.results:
+            st.state = "settled"
+            return
+        best_vec, best_s = min(st.results, key=lambda r: r[1])
+        win = (st.baseline_s / best_s) if best_s > 0 else 0.0
+        if win >= min_win():
+            st.override = best_vec
+            st.win = round(win, 3)
+            st.state = "canary"
+            st.canary_inflight = False
+            with ENGINE.phase("tune_apply"):
+                self._persist_locked(st)
+            events.emit("tune_apply", class_key=st.class_key,
+                        knobs=best_vec, win=st.win,
+                        baseline_s=round(st.baseline_s, 6),
+                        best_s=round(best_s, 6))
+        else:
+            st.state = "settled"
+            st.win = round(win, 3)
+
+    # -------------------------------------------------- surface --
+    def stats(self) -> dict:
+        """Stats block (spgemmd stats op / cli tune): per-class rows
+        plus the counters the scrape renders."""
+        with self._lock:
+            rows = [self._classes[k].row() for k in sorted(self._classes)]
+            trials, reverts = self._trials, self._reverts
+        states: dict[str, int] = {}
+        for r in rows:
+            if r["state"] in ("canary", "live", "reverted"):
+                states[r["state"]] = states.get(r["state"], 0) + 1
+        return {"enabled": enabled(), "classes": rows,
+                "overrides": states, "trials": trials, "reverts": reverts}
+
+    def _count_trial(self) -> None:
+        with self._lock:
+            self._trials += 1
+
+    def clear(self) -> None:
+        """Drop every class (tests; cli tune --clear clears the store,
+        the daemon's in-memory state follows at next restart)."""
+        with self._lock:
+            self._classes.clear()
+            self._trials = 0
+            self._reverts = 0
+
+
+TUNER = Tuner()
+
+
+def run_trial_leg(run_fn, folder_of, tuner: Tuner = None,
+                  extra: dict | None = None) -> bool:
+    """Execute AT MOST ONE trial leg (the daemon's idle-tick entry
+    point): claim the next due (class, folder, vector), activate the
+    candidate overlay, run `run_fn(folder) -> digest` under it, clock
+    the wall, restore the previous overlay, and commit the measurement.
+    Returns True iff a leg ran (successfully or not).
+
+    `extra` pins measurement-context knobs onto EVERY leg's overlay --
+    baseline included -- without ever joining the persisted winner
+    vector (the daemon passes {"SPGEMM_TPU_DELTA": "0"}: a repeat trial
+    multiply answered from the delta store's retained result would time
+    a splice, not the candidate vector).
+
+    Preemption contract: `run_fn` raises TrialPreempted from its
+    inter-multiply heartbeat when a real job arrives -- the leg is
+    discarded and this returns within one heartbeat.  A leg during
+    which the process-global overlay generation moved (another slice
+    activated a class's vector mid-measurement) is discarded too: its
+    timing measured a mixture.  The armed `tune.trial` failpoint aborts
+    the leg the same revert-free way -- a chaos trial must never touch
+    a real job's result, SLO window, or the admission path."""
+    t = tuner if tuner is not None else TUNER
+    leg = t.next_leg(folder_of)
+    if leg is None:
+        return False
+    class_key, folder, vector = leg
+    prev = knobs.tuned_overlay()
+    with ENGINE.phase("tune_trial"):
+        ENGINE.incr("tune_trials")
+        t._count_trial()
+        try:
+            failpoints.check("tune.trial")
+            overlay = dict(prev)
+            overlay.update(extra or {})
+            overlay.update(vector)
+            knobs.set_tuned(overlay)
+            gen0 = knobs.tuned_generation()
+            t0 = time.perf_counter()
+            digest = run_fn(folder)
+            dt = time.perf_counter() - t0
+            skewed = knobs.tuned_generation() != gen0
+        except TrialPreempted:
+            t.record_preempted(class_key, vector, "preempted")
+            return True
+        except failpoints.FailpointTriggered:
+            t.record_preempted(class_key, vector, "failpoint")
+            return True
+        except Exception as e:  # noqa: BLE001 -- a dying trial leg must never take down the executor's idle tick; the leg is discarded and the class re-tries next window
+            t.record_preempted(class_key, vector, f"error:{type(e).__name__}")
+            return True
+        finally:
+            knobs.set_tuned(prev)
+        if skewed:
+            t.record_preempted(class_key, vector, "overlay-swapped")
+            return True
+        events.emit("tune_trial", class_key=class_key, knobs=dict(vector),
+                    seconds=round(dt, 6))
+        t.record_leg(class_key, vector, dt, digest)
+    return True

@@ -126,6 +126,11 @@ _FAILPOINTS = (
               "The chain heartbeat hangs mid-chain (a backend call that "
               "never returns between multiplies): no beats reach the "
               "watchdog, the wedge grace window runs out."),
+    Failpoint("tune.trial", "raise", "tune/tuner.py",
+              "An autotuner trial leg dies mid-measurement: the tuner "
+              "discards the leg, counts the revert-free abort, and the "
+              "trial lane's failure must never touch a real job's "
+              "result, SLO window, or the admission path."),
 )
 
 REGISTRY: dict[str, Failpoint] = {f.name: f for f in _FAILPOINTS}

@@ -25,6 +25,7 @@ mid-process exactly as before the registry existed.
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass
 
 _UNSET = "(unset)"
@@ -371,6 +372,37 @@ _KNOBS = (
          "top-K by recency -- an evicted tenant's windows are dropped "
          "and counted on spgemm_slo_tenants_evicted_total).",
          "obs/slo.py", default="3600", minimum=1),
+    Knob("SPGEMM_TPU_TUNE", "bool01",
+         "Telemetry-driven autotuner master switch (spgemm_tpu/tune): 1 = "
+         "spgemmd loads persisted tuned overrides from the warm store at "
+         "start, applies each structure class's winning knob vector at "
+         "job pickup behind the canary gate (first job under a fresh "
+         "vector runs a tightened deadline; a canary failure reverts the "
+         "override and backs off), and adapts the estimator's per-class "
+         "sampling budget from observed rel-error; 0 = no overrides ever "
+         "applied or loaded and no trials run -- the whole-feature A/B, "
+         "byte-identical to the pre-tuner daemon.  Safe by construction: "
+         "every searched knob is bit-identical A/B, so tuning can only "
+         "ever change wall clock, never bits.",
+         "tune/tuner.py", default="1"),
+    Knob("SPGEMM_TPU_TUNE_TRIAL_S", "float",
+         "Idle-slice trial cadence, seconds: a slice executor whose whole "
+         "pool is idle (empty queue, no slice busy) this long after the "
+         "previous trial leg runs ONE timed trial leg (one knob vector of "
+         "the deterministic per-class enumeration) on the class's "
+         "recorded representative folder, returning to the job poll "
+         "between legs so a real job preempts within one queue "
+         "heartbeat.  0 = no background trials at all (the default: "
+         "persisted overrides still apply under SPGEMM_TPU_TUNE=1, but "
+         "the daemon never spends idle cycles searching).",
+         "serve/daemon.py", default="0", minimum=0),
+    Knob("SPGEMM_TPU_TUNE_MIN_WIN", "float",
+         "Minimum measured speedup (incumbent wall / candidate wall) "
+         "before the autotuner promotes a trial winner to a tuned "
+         "override: below this the class keeps its incumbent vector and "
+         "the trial result is recorded as a loss.  Guards against "
+         "promoting measurement noise into canary churn.",
+         "tune/tuner.py", default="1.1", minimum=1),
     Knob("SPGEMM_TPU_PROBE_TIMEOUT", "float",
          "Backend liveness probe subprocess timeout, seconds (a dead TPU "
          "HANGS, never raises -- the probe is the only safe touch).",
@@ -403,6 +435,76 @@ _KNOBS = (
 )
 
 REGISTRY: dict[str, Knob] = {k.name: k for k in _KNOBS}
+
+
+class _TunedOverlay:
+    """Process-wide tuned-override overlay (spgemm_tpu/tune).
+
+    The autotuner activates one structure class's winning knob vector at
+    job pickup by REPLACING this overlay atomically; `get()` resolves
+    env > tuned > default, so an operator's exported value always beats a
+    tuned one.  Every value the tuner may set is bit-identical A/B by
+    construction, so a concurrent slice reading a just-swapped overlay
+    can only ever change wall clock, never bits.  The generation counter
+    lets a timed trial detect that another slice swapped the overlay
+    under it (the measurement is then discarded, not promoted)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values: dict[str, str] = {}  # spgemm-lint: guarded-by(_lock)
+        self._gen = 0  # spgemm-lint: guarded-by(_lock)
+
+    def replace(self, mapping: dict[str, str]) -> None:
+        validated: dict[str, str] = {}
+        for name, raw in mapping.items():
+            kb = REGISTRY[name]  # registering is the price of tuning
+            assert kb.kind != "flag", "flag knobs have no tunable value"
+            _parse(kb, str(raw))  # invalid tuned value raises HERE
+            validated[name] = str(raw)
+        with self._lock:
+            if validated != self._values:
+                self._values = validated
+                self._gen += 1
+
+    def lookup(self, name: str) -> str | None:
+        with self._lock:
+            return self._values.get(name)
+
+    def snapshot(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._values)
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._gen
+
+
+_OVERLAY = _TunedOverlay()
+
+
+def set_tuned(mapping: dict[str, str]) -> None:
+    """Atomically replace the tuned-override overlay with `mapping`
+    ({knob name: string value}); {} clears it.  Values are validated
+    against the registry immediately (an invalid tuned value raises at
+    activation, never deep inside a kernel)."""
+    _OVERLAY.replace(mapping)
+
+
+def clear_tuned() -> None:
+    """Drop every tuned override (the pre-tuner resolution order)."""
+    _OVERLAY.replace({})
+
+
+def tuned_overlay() -> dict[str, str]:
+    """Copy of the live tuned-override overlay ({} when none active)."""
+    return _OVERLAY.snapshot()
+
+
+def tuned_generation() -> int:
+    """Monotonic overlay swap counter: a timed trial records it before
+    and after a measurement and discards the leg when it moved (another
+    slice activated a different class's vector mid-measurement)."""
+    return _OVERLAY.generation()
 
 
 def _parse(kb: Knob, raw: str):
@@ -449,13 +551,9 @@ def _parse(kb: Knob, raw: str):
     raise AssertionError(f"unknown knob kind {kb.kind!r}")  # registry bug
 
 
-def get(name: str):
-    """Typed, validated value of a registered knob.
-
-    Unset (or set to whitespace) falls back to the registered default;
-    with no default, returns None (False for flag knobs).  Invalid values
-    raise ValueError naming the knob.  Unregistered names raise KeyError
-    -- registering is the price of reading."""
+def _resolve(name: str, use_tuned: bool):
+    """Shared resolution body for get()/base_get(): env > [tuned >]
+    default, typed and validated."""
     kb = REGISTRY[name]
     raw = os.environ.get(name)
     if kb.kind == "flag":
@@ -463,10 +561,35 @@ def get(name: str):
     if raw is not None:
         raw = raw.strip()
     if not raw:
+        if use_tuned:
+            tuned = _OVERLAY.lookup(name)
+            if tuned is not None:
+                return _parse(kb, tuned)
         raw = kb.default
         if raw is None:
             return None
     return _parse(kb, raw)
+
+
+def get(name: str):
+    """Typed, validated value of a registered knob.
+
+    Resolution order: a (non-empty) env value wins, else a live tuned
+    override (spgemm_tpu/tune, set via `set_tuned`), else the registered
+    default; with no default, returns None (False for flag knobs).
+    Invalid values raise ValueError naming the knob.  Unregistered names
+    raise KeyError -- registering is the price of reading."""
+    return _resolve(name, use_tuned=True)
+
+
+def base_get(name: str):
+    """`get()` with the tuned overlay IGNORED: env > default only.
+
+    The warm store's tuned-override tier validates its on-disk entries
+    against this base form (`base_jit_static_vector`) -- validating
+    against the overlaid vector would be circular, since loading an
+    override is exactly what changes the overlaid vector."""
+    return _resolve(name, use_tuned=False)
 
 
 def jit_static_vector() -> tuple:
@@ -475,8 +598,20 @@ def jit_static_vector() -> tuple:
     (ops/spgemm), the compile records (obs/profile), and the warm-start
     store's on-disk validation (ops/warmstore) all key on this one
     definition, so the three surfaces can never drift on what "same
-    compiled configuration" means."""
+    compiled configuration" means.  Tuned overrides flow through (a
+    tuned MXU_R compiles and fingerprints like an exported one); the
+    warm store's tune tier alone keys on `base_jit_static_vector`."""
     return tuple((kb.name, str(get(kb.name)))
+                 for kb in REGISTRY.values() if kb.jit_static)
+
+
+def base_jit_static_vector() -> tuple:
+    """`jit_static_vector` with the tuned overlay ignored (env > default
+    only): the validation key for the warm store's tuned-override tier.
+    An env-exported jit-static knob that changed across a restart makes
+    every persisted override a counted cold fallback -- it was measured
+    under a different base configuration."""
+    return tuple((kb.name, str(base_get(kb.name)))
                  for kb in REGISTRY.values() if kb.jit_static)
 
 
@@ -506,12 +641,17 @@ def pin_unless_exported(name: str, value: str):
 
 def source(name: str) -> str:
     """'env' if the process environment supplies a (non-empty) value for
-    this registered knob, else 'default'."""
+    this registered knob, 'tuned' if a live tuned override covers it,
+    else 'default'."""
     kb = REGISTRY[name]
     raw = os.environ.get(name)
     if kb.kind == "flag":
         return "env" if raw else "default"
-    return "env" if raw is not None and raw.strip() else "default"
+    if raw is not None and raw.strip():
+        return "env"
+    if _OVERLAY.lookup(name) is not None:
+        return "tuned"
+    return "default"
 
 
 def _display(val) -> str:

@@ -1,0 +1,382 @@
+"""Autotuner (spgemm_tpu/tune): trial planning, preemption, canary
+rollout + revert backoff, warm tune-tier round-trip, estimator
+adaptation, and the SPGEMM_TPU_TUNE=0 whole-feature A/B -- tier-1 on
+the 8-vdev CPU backend."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.obs import profile as obs_profile
+from spgemm_tpu.ops import warmstore
+from spgemm_tpu.serve import placement
+from spgemm_tpu.serve.daemon import Daemon
+from spgemm_tpu.serve.queue import Job
+from spgemm_tpu.tune import tuner as tune_mod
+from spgemm_tpu.tune.tuner import (BACKOFF0_S, TUNER, TrialPreempted, Tuner,
+                                   run_trial_leg, trial_vectors)
+from spgemm_tpu.utils import io_text, knobs
+from spgemm_tpu.utils.gen import random_chain
+from spgemm_tpu.utils.timers import ENGINE
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tune_state():
+    """The tuner singleton, the process-global tuned overlay, the
+    engine phase accumulators, AND the profiler's span-fed phase
+    histograms survive across tests (a daemon pickup swaps the overlay;
+    a trial leg accumulates the tune_trial phase a later scrape would
+    render): reset every side so each test starts from the untuned
+    engine."""
+    TUNER.clear()
+    TUNER.persist_with(None)
+    knobs.clear_tuned()
+    placement.clear()
+    ENGINE.reset()
+    obs_profile.clear()
+    yield
+    TUNER.clear()
+    TUNER.persist_with(None)
+    knobs.clear_tuned()
+    placement.clear()
+    ENGINE.reset()
+    obs_profile.clear()
+
+
+def _chain_folder(tmp_path, n=2, k=2, seed=7, name="tune_in"):
+    mats = random_chain(n, 4, k, 0.5, np.random.default_rng(seed), "full")
+    folder = str(tmp_path / name)
+    io_text.write_chain_dir(folder, mats, k)
+    return folder
+
+
+def _drive_trials(t: Tuner, ck: str, folder: str, winner: dict,
+                  base_s: float = 1.0, best_s: float = 0.5) -> None:
+    """Walk the class through its whole trial plan with fabricated
+    timings: the baseline leg costs base_s, `winner` costs best_s, every
+    other candidate slightly worse than baseline.  Digests all match
+    (the knobs under trial are bit-identical by construction)."""
+    t.note_job(ck, "cpu")
+    while True:
+        leg = t.next_leg(lambda key: folder)
+        if leg is None:
+            break
+        key, _fld, vec = leg
+        secs = base_s if not vec else \
+            (best_s if vec == winner else base_s * 1.01)
+        t.record_leg(key, vec, secs, "digest-0")
+
+
+# ------------------------------------------------------------- planning --
+def test_trial_vectors_shape():
+    legs = trial_vectors("cpu")
+    assert legs[0] == {}  # baseline first, always
+    names = {k for leg in legs for k in leg}
+    # CPU pools never deviate the MXU pair width or the ring overlap:
+    # the CPU 'mxu' lowering is an XLA oracle and single-host CPU runs
+    # never take the ring, so those legs would time pure noise
+    assert names == {"SPGEMM_TPU_ACCUM_ROUTE", "SPGEMM_TPU_ROUND_BATCH"}
+    tpu_names = {k for leg in trial_vectors("tpu") for k in leg}
+    assert "SPGEMM_TPU_MXU_R" in tpu_names
+    assert "SPGEMM_TPU_RING_OVERLAP" in tpu_names
+    # every leg is a one-knob deviation (coordinate search, never the
+    # cross product)
+    assert all(len(leg) <= 1 for leg in trial_vectors("tpu"))
+
+
+def test_promotion_needs_min_win():
+    t = Tuner()
+    _drive_trials(t, "ck@cpu", "/nonexistent-ok", winner={}, base_s=1.0)
+    st = t.stats()["classes"][0]
+    # no candidate beat the baseline: the class settles untuned
+    assert st["state"] == "settled" and st["knobs"] == {}
+    assert t.overlay_for("ck@cpu") == {}
+
+
+def test_promotion_and_canary_lifecycle():
+    t = Tuner()
+    winner = {"SPGEMM_TPU_ACCUM_ROUTE": "dense"}
+    _drive_trials(t, "ck@cpu", "/nonexistent-ok", winner=winner)
+    st = t.stats()["classes"][0]
+    assert st["state"] == "canary" and st["knobs"] == winner
+    assert st["win"] == pytest.approx(2.0)
+    # canary/live overlays apply; the gate is consumed exactly once
+    assert t.overlay_for("ck@cpu") == winner
+    assert t.consume_canary("ck@cpu") is True
+    assert t.consume_canary("ck@cpu") is False
+    t.note_terminal("ck@cpu", ok=True)
+    assert t.stats()["classes"][0]["state"] == "live"
+    assert t.overlay_for("ck@cpu") == winner
+
+
+def test_canary_failure_reverts_and_backs_off():
+    t = Tuner()
+    winner = {"SPGEMM_TPU_ACCUM_ROUTE": "dense"}
+    _drive_trials(t, "ck@cpu", "/nonexistent-ok", winner=winner)
+    assert t.consume_canary("ck@cpu") is True
+    t.note_terminal("ck@cpu", ok=False)
+    st = t.stats()["classes"][0]
+    assert st["state"] == "reverted"
+    assert st["backoff_s"] == BACKOFF0_S
+    assert t.overlay_for("ck@cpu") == {}  # the override is gone
+    assert t.stats()["reverts"] == 1
+    # still parked: no trial leg before the backoff horizon
+    assert t.next_leg(lambda key: "/x") is None
+    # expire the backoff and fail the canary again: the backoff doubles
+    with t._lock:
+        t._classes["ck@cpu"].retry_at = time.monotonic() - 1
+    _drive_trials(t, "ck@cpu", "/nonexistent-ok", winner=winner)
+    assert t.consume_canary("ck@cpu") is True
+    t.note_terminal("ck@cpu", ok=False)
+    assert t.stats()["classes"][0]["backoff_s"] == 2 * BACKOFF0_S
+
+
+def test_parity_mismatch_parks_the_class():
+    t = Tuner()
+    t.note_job("ck@cpu", "cpu")
+    leg = t.next_leg(lambda key: "/x")
+    assert leg[2] == {}
+    t.record_leg("ck@cpu", {}, 1.0, "digest-base")
+    key, _f, vec = t.next_leg(lambda key: "/x")
+    t.record_leg(key, vec, 0.1, "digest-DIFFERENT")
+    st = t.stats()["classes"][0]
+    # a candidate that changed the bits is an engine bug: never promote
+    # on top of it, park the class in backoff
+    assert st["state"] == "reverted" and st["knobs"] == {}
+    assert t.stats()["reverts"] == 1
+
+
+# ----------------------------------------------------------- preemption --
+def test_preempted_leg_is_discarded_and_retried():
+    t = Tuner()
+    t.note_job("ck@cpu", "cpu")
+
+    def preempting_run(folder):
+        raise TrialPreempted(folder)
+
+    assert run_trial_leg(preempting_run, lambda key: "/x", tuner=t) is True
+    # the leg was discarded, not recorded: the class still owes the same
+    # baseline leg, and the overlay is restored
+    assert knobs.tuned_overlay() == {}
+    assert t.next_leg(lambda key: "/x")[2] == {}
+    # and a later quiet window simply re-runs it
+    assert run_trial_leg(lambda folder: "d0", lambda key: "/x",
+                         tuner=t) is True
+    assert t.next_leg(lambda key: "/x")[2] != {}  # baseline landed
+
+
+def test_trial_failpoint_aborts_leg_without_side_effects(monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_FAILPOINTS", "tune.trial:1")
+    t = Tuner()
+    t.note_job("ck@cpu", "cpu")
+    ran = []
+    assert run_trial_leg(lambda folder: ran.append(folder) or "d0",
+                         lambda key: "/x", tuner=t) is True
+    # the armed failpoint aborted BEFORE the leg ran anything: no
+    # measurement recorded, overlay restored, class unharmed
+    assert ran == []
+    assert knobs.tuned_overlay() == {}
+    assert t.next_leg(lambda key: "/x")[2] == {}
+    assert t.stats()["classes"][0]["state"] == "trialing"
+
+
+def test_daemon_beat_preempts_within_one_heartbeat(tmp_path):
+    """The daemon's trial runner yields the device the moment a real job
+    is queued: the heartbeat planted between multiplies (and fired once
+    before the chain even loads) raises TrialPreempted -- a queued job
+    never waits past one multiply boundary on a trial."""
+    d = Daemon(str(tmp_path / "t.sock"), journal=False)  # never started
+    sl = d.slices[0]
+    run = d._tune_run_fn(sl, sl.gen)
+    folder = _chain_folder(tmp_path)
+    # idle queue: the leg completes and the digest is deterministic
+    # (the tuner's parity contract relies on it)
+    assert run(folder) == run(folder)
+    # a queued job preempts at the FIRST beat, before any multiply
+    d.queue.submit(Job("job-t1", folder, str(tmp_path / "out"), {}))
+    t0 = time.perf_counter()
+    with pytest.raises(TrialPreempted):
+        run(folder)
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_maybe_tune_never_runs_while_pool_busy(tmp_path, monkeypatch):
+    monkeypatch.setenv("SPGEMM_TPU_TUNE_TRIAL_S", "0.01")
+    d = Daemon(str(tmp_path / "t.sock"), journal=False)
+    sl = d.slices[0]
+    folder = _chain_folder(tmp_path)
+    TUNER.note_job("ck@cpu", "cpu")
+    placement.note_class("ck@cpu", folder)
+    # a busy slice (a real job mid-execute) blocks the trial lane
+    sl.current = Job("job-b", folder, str(tmp_path / "o"), {})
+    before = TUNER.stats()["trials"]
+    d._maybe_tune(sl, sl.gen)
+    assert TUNER.stats()["trials"] == before
+    sl.current = None
+    d._maybe_tune(sl, sl.gen)
+    assert TUNER.stats()["trials"] == before + 1
+
+
+# ------------------------------------------------------ warm store tier --
+def test_override_roundtrips_warm_store_across_restart(monkeypatch,
+                                                       tmp_path):
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    t = Tuner()
+    t.persist_with(warmstore.save_tune)
+    winner = {"SPGEMM_TPU_ACCUM_ROUTE": "dense"}
+    _drive_trials(t, "ck@cpu", "/x", winner=winner)
+    t.consume_canary("ck@cpu")
+    t.note_terminal("ck@cpu", ok=True)  # live -> persisted
+    assert any(n.startswith("tune-") for n in os.listdir(tmp_path))
+    # "restart": a fresh tuner adopts the persisted override verbatim
+    warmstore.reset()
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    t2 = Tuner()
+    assert t2.load(warmstore.load_tunes()) == 1
+    assert t2.overlay_for("ck@cpu") == winner
+    assert t2.stats()["classes"][0]["state"] == "live"
+
+
+def test_canary_record_reauditions_after_restart(monkeypatch, tmp_path):
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    t = Tuner()
+    t.persist_with(warmstore.save_tune)
+    winner = {"SPGEMM_TPU_ACCUM_ROUTE": "dense"}
+    _drive_trials(t, "ck@cpu", "/x", winner=winner)  # canary, unsettled
+    warmstore.reset()
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    t2 = Tuner()
+    assert t2.load(warmstore.load_tunes()) == 1
+    # a daemon that died mid-audition re-runs the canary gate: the
+    # override applies, and the first job consumes a fresh canary
+    assert t2.stats()["classes"][0]["state"] == "canary"
+    assert t2.consume_canary("ck@cpu") is True
+
+
+def test_knob_vector_skewed_override_is_counted_cold_fallback(monkeypatch,
+                                                              tmp_path):
+    """A tune record persisted under a different BASE jit-static vector
+    (hand-copied dir, changed deployment env) must be refused by the
+    envelope check -- counted, never adopted."""
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    t = Tuner()
+    t.persist_with(warmstore.save_tune)
+    _drive_trials(t, "ck@cpu", "/x",
+                  winner={"SPGEMM_TPU_ACCUM_ROUTE": "dense"})
+    assert any(n.startswith("tune-") for n in os.listdir(tmp_path))
+    warmstore.reset()
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    monkeypatch.setenv("SPGEMM_TPU_MXU_R", "16")  # base vector changed
+    assert warmstore.load_tunes() == {}
+    assert warmstore.stats()["corrupt"] >= 1
+
+
+def test_clear_tunes_leaves_plans(monkeypatch, tmp_path):
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", str(tmp_path))
+    t = Tuner()
+    t.persist_with(warmstore.save_tune)
+    _drive_trials(t, "ck@cpu", "/x",
+                  winner={"SPGEMM_TPU_ACCUM_ROUTE": "dense"})
+    (tmp_path / "plan-deadbeef.npz").write_bytes(b"not-a-real-plan")
+    warmstore.reset()
+    removed = warmstore.clear_tunes(str(tmp_path))
+    assert removed == 1
+    names = os.listdir(tmp_path)
+    assert not any(n.startswith("tune-") for n in names)
+    assert "plan-deadbeef.npz" in names  # the plan tier is untouched
+
+
+# ------------------------------------------------- estimator adaptation --
+def test_est_adaptation_tight_class_shrinks_sample_budget():
+    t = Tuner()
+    t.note_job("ck@cpu", "cpu")
+    for _ in range(tune_mod.EST_MIN_JOBS):
+        t.note_est_accuracy("ck@cpu", 0.01)
+    ov = t.overlay_for("ck@cpu")
+    assert ov["SPGEMM_TPU_EST_SAMPLE_ROWS"] == "24"  # default 48 halved
+    # repeated tight windows keep halving down to the floor, never below
+    for _ in range(10 * tune_mod.EST_MIN_JOBS):
+        t.note_est_accuracy("ck@cpu", 0.01)
+    floor = max(tune_mod.EST_ROWS_FLOOR, 1)
+    assert int(t.overlay_for("ck@cpu")["SPGEMM_TPU_EST_SAMPLE_ROWS"]) \
+        >= floor
+
+
+def test_est_adaptation_misfiring_class_raises_confidence():
+    t = Tuner()
+    t.note_job("ck@cpu", "cpu")
+    for _ in range(tune_mod.EST_MIN_JOBS):
+        t.note_est_accuracy("ck@cpu", 0.9)
+    ov = t.overlay_for("ck@cpu")
+    assert float(ov["SPGEMM_TPU_EST_CONFIDENCE"]) == pytest.approx(0.7)
+    # capped at 1.0 however often the class misfires
+    for _ in range(10 * tune_mod.EST_MIN_JOBS):
+        t.note_est_accuracy("ck@cpu", 0.9)
+    assert float(t.overlay_for("ck@cpu")["SPGEMM_TPU_EST_CONFIDENCE"]) \
+        <= 1.0
+
+
+# ------------------------------------------------------------ TUNE=0 A/B --
+def test_tune_off_is_inert_everywhere(monkeypatch, tmp_path):
+    monkeypatch.setenv("SPGEMM_TPU_TUNE", "0")
+    t = Tuner()
+    t.note_job("ck@cpu", "cpu")  # gated: no class is even created
+    assert t.stats()["classes"] == []
+    assert t.overlay_for("ck@cpu") == {}
+    assert t.consume_canary("ck@cpu") is False
+    assert run_trial_leg(lambda folder: "d0", lambda key: "/x",
+                         tuner=t) is False
+    d = Daemon(str(tmp_path / "t.sock"), journal=False)
+    d.start()
+    try:
+        scrape = d._op_metrics()["text"]
+        assert "spgemm_tune" not in scrape
+        assert "tune_trial" not in scrape and "tune_apply" not in scrape
+        assert d._op_stats()["tune"]["enabled"] is False
+    finally:
+        d.stop()
+
+
+def test_tune_enabled_idle_daemon_scrape_unchanged(tmp_path):
+    """Tuning ON but never contacted: the scrape must stay byte-free of
+    every tune family (count-0 gating -- the surface only grows once a
+    class exists)."""
+    d = Daemon(str(tmp_path / "t.sock"), journal=False)
+    d.start()
+    try:
+        scrape = d._op_metrics()["text"]
+        assert "spgemm_tune" not in scrape
+    finally:
+        d.stop()
+
+
+# ----------------------------------------------------- daemon trial lane --
+def test_daemon_idle_trials_settle_a_seeded_class(tmp_path, monkeypatch):
+    """End-to-end trial lane on the live daemon: a seeded class's legs
+    run on idle ticks (real chain_product on the CPU backend) and the
+    class leaves the trialing state on its own -- every leg bit-exact
+    (a parity mismatch would park it as reverted and fail the state
+    assertion below)."""
+    monkeypatch.setenv("SPGEMM_TPU_TUNE_TRIAL_S", "0.01")
+    folder = _chain_folder(tmp_path)
+    d = Daemon(str(tmp_path / "t.sock"), journal=False)
+    d.start()
+    try:
+        TUNER.note_job("ck@cpu", "cpu")
+        placement.note_class("ck@cpu", folder)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rows = TUNER.stats()["classes"]
+            if rows and rows[0]["state"] in ("settled", "canary"):
+                break
+            time.sleep(0.05)
+        rows = TUNER.stats()["classes"]
+        assert rows and rows[0]["state"] in ("settled", "canary"), rows
+        assert TUNER.stats()["trials"] >= len(trial_vectors("cpu"))
+        # the scrape now carries the tune families iff an override exists
+        stats = d._op_stats()
+        assert stats["tune"]["classes"]
+    finally:
+        d.stop()
