@@ -22,7 +22,7 @@ MEMFLAG = $(MEMFLAG_$(MEM))
 NATIVE_SRC = spgemm_tpu/native/smmio.cpp spgemm_tpu/native/symbolic.cpp
 NATIVE_SO  = spgemm_tpu/native/libsmmio.so
 
-.PHONY: all native run test lint lint-fast lint-sarif lint-cache-clean bench bench-large warm serve-smoke obs-smoke chaos-smoke clean
+.PHONY: all native run test lint lint-fast lint-sarif lint-cache-clean bench bench-large warm serve-smoke obs-smoke chaos-smoke fleet-smoke clean
 
 all: native
 
@@ -123,6 +123,22 @@ obs-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 		$(PY) -m spgemm_tpu.serve.chaos_smoke
+
+# fleet end-to-end proof on CPU: two spgemmd backends each on a TCP
+# front-end (SPGEMM_TPU_SERVE_ADDR / --addr) plus one spgemm-router
+# (`cli route`) fronting both -- a mixed-tenant burst must spread across
+# both backends bit-exact vs the oracle with every submit answer naming
+# its backend, the aggregated scrape must carry the router's families
+# AND every backend's series relabeled with backend=, one submit's
+# client-minted trace must stitch via `cli trace-dump --merge` into ONE
+# Perfetto file spanning client + router + backend, a SIGKILLed backend
+# under load must leave every job completed-bit-exact (one-shot
+# failover to the survivor) or structured backend-lost (never a hang)
+# with later submits landing on the survivor, and SIGTERM must drain
+# the router and the survivor to exit 0; exits nonzero on any step.
+fleet-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) -m spgemm_tpu.fleet.fleet_smoke
 
 # the reference's Large scale (1M tiles) through the out-of-core pipeline
 bench-large:

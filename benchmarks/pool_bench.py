@@ -24,6 +24,18 @@ the speedup misses --target (default 3x; meaningful only on hosts with
 enough cores to actually overlap the slices -- `detail.core_limited`
 flags captures where the host, not the scheduler, is the ceiling).
 
+--fleet is the federation-router acceptance mode: the same mixed batch
+of small chains submitted through one spgemm-router (spgemm_tpu/fleet)
+fronting 1 backend vs --backends spgemmd processes, each backend a real
+`cli serve` subprocess on its own TCP front-end (cold jit caches per
+leg, process-level parallelism -- the fleet's actual deployment shape).
+Reported per leg: makespan, jobs/min, per-job backend spread, router
+failover count (must be 0 on a healthy run), and PARITY -- every output
+byte-compared against the host oracle in BOTH legs (routing must never
+change bits).  --check gates parity plus the fleet speedup at
+--fleet-target (default 1.5x; `detail.core_limited` flags core-starved
+hosts here too).
+
 --queue-depth-sweep is the cross-job batching acceptance mode instead:
 same-structure submits at queue depths 1/4/16 to a SINGLE-slice daemon,
 a batched leg (SPGEMM_TPU_SERVE_BATCH_WINDOW_S armed, the executor
@@ -261,6 +273,196 @@ def run_sweep(args) -> int:
     return 0
 
 
+def _free_port() -> int:
+    import socket  # noqa: PLC0415
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_tcp_up(port: int, proc, what: str, deadline_s: float) -> bool:
+    import socket  # noqa: PLC0415
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            sys.stderr.write(f"pool_bench: {what} exited rc "
+                             f"{proc.returncode} before listening\n")
+            return False
+        try:
+            socket.create_connection(("127.0.0.1", port),
+                                     timeout=1.0).close()
+            return True
+        except OSError:
+            time.sleep(0.1)
+    sys.stderr.write(f"pool_bench: {what} never listened on {port}\n")
+    return False
+
+
+def _fleet_leg(args, tmp, jobs_spec, n_backends: int) -> dict | None:
+    """One fleet leg: n real `cli serve` subprocesses (own TCP
+    front-end each, cold jit caches) behind one in-process router; the
+    whole batch submitted through the router back-to-back."""
+    from spgemm_tpu.fleet.router import Router  # noqa: PLC0415
+    from spgemm_tpu.serve import client  # noqa: PLC0415
+    from spgemm_tpu.utils import knobs  # noqa: PLC0415
+
+    # the legs own every serve/fleet knob; memoization and disk warmth
+    # would fake the makespan exactly like the in-process legs (the
+    # pins write through os.environ, so the backend children inherit)
+    knobs.pin_unless_exported("SPGEMM_TPU_DELTA", "0")
+    knobs.pin_unless_exported("SPGEMM_TPU_WARM", "0")
+    env = {k: v for k, v in os.environ.items()
+           if not (k.startswith("SPGEMM_TPU_SERVE")
+                   or k.startswith("SPGEMM_TPU_ROUTER"))}
+
+    ports = [_free_port() for _ in range(n_backends)]
+    names = [f"tcp:127.0.0.1:{p}" for p in ports]
+    backends = []
+    router = None
+    try:
+        for i, port in enumerate(ports):
+            sock = os.path.join(tmp, f"fleet{n_backends}-b{i}.sock")
+            backends.append(subprocess.Popen(
+                [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+                 "--socket", sock, "--addr", names[i], "--device", "cpu"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        for i, port in enumerate(ports):
+            if not _wait_tcp_up(port, backends[i],
+                                f"backend {i}/{n_backends}",
+                                args.job_timeout):
+                return None
+        router = Router(listen="tcp:127.0.0.1:0", backends=names,
+                        poll_s=0.5)
+        router.start()
+        addr = f"tcp:127.0.0.1:{router.port}"
+        deadline = time.time() + args.job_timeout
+        while True:
+            st = client.stats(addr)
+            if sum(1 for b in st["backends"].values()
+                   if b["up"]) == n_backends:
+                break
+            if time.time() > deadline:
+                sys.stderr.write("pool_bench: router never saw all "
+                                 f"{n_backends} backends healthy\n")
+                return None
+            time.sleep(0.1)
+        t0 = time.time()
+        subs = [client.submit(js["folder"], addr,
+                              {"output": js["output"]}) for js in jobs_spec]
+        jobs = []
+        for sub in subs:
+            resp = client.wait(sub["id"], addr,
+                               timeout=args.job_timeout)
+            jobs.append(dict(resp["job"], backend=resp["backend"]))
+        bad = [j["id"] for j in jobs if j["state"] != "done"]
+        if bad:
+            sys.stderr.write(f"pool_bench: fleet jobs failed: {bad}\n")
+            return None
+        makespan = max(j["finished_at"] for j in jobs) - t0
+        failovers = client.stats(addr)["jobs"]["failovers"]
+        return {
+            "backends": n_backends,
+            "makespan_s": round(makespan, 4),
+            "jobs": len(jobs),
+            "jobs_per_min": round(len(jobs) / makespan * 60.0, 3)
+            if makespan > 0 else None,
+            "failovers": failovers,
+            "per_job": [{"id": j["id"], "backend": j["backend"]}
+                        for j in jobs],
+        }
+    finally:
+        if router is not None:
+            router.stop()
+        for proc in backends:
+            proc.terminate()
+        for proc in backends:
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+def run_fleet(args) -> int:
+    """--fleet: 1-backend vs --backends makespan through the router,
+    bit-exact parity both legs."""
+    import numpy as np  # noqa: PLC0415 -- parent stays jax-free
+
+    from spgemm_tpu.utils import io_text  # noqa: PLC0415
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix  # noqa: PLC0415
+    from spgemm_tpu.utils.gen import random_chain  # noqa: PLC0415
+    from spgemm_tpu.utils.semantics import chain_oracle  # noqa: PLC0415
+
+    tmp = tempfile.mkdtemp(prefix="fleetbench-")
+    folders, wants = [], {}
+    # distinct structures: every submit is a first contact, so the
+    # router round-robins the batch across the backends -- the spread
+    # the fleet is built for
+    for i in range(args.small):
+        folder = os.path.join(tmp, f"job{i}")
+        mats = random_chain(args.chain, args.small_dim, args.k,
+                            args.density, np.random.default_rng(7 + i),
+                            "full")
+        io_text.write_chain_dir(folder, mats, args.k)
+        want = chain_oracle([m.to_dict() for m in mats], args.k)
+        wants[folder] = io_text.format_matrix(BlockSparseMatrix.from_dict(
+            mats[0].rows, mats[-1].cols, args.k, want).prune_zeros())
+        folders.append(folder)
+
+    legs = {}
+    for label, n in (("one_backend", 1), ("fleet", args.backends)):
+        jobs_spec = [{"folder": f, "output": f + f".{label}.out"}
+                     for f in folders]
+        leg = _fleet_leg(args, tmp, jobs_spec, n)
+        if leg is None:
+            print(json.dumps({"metric": "fleet_makespan", "value": None,
+                              "unit": "s", "vs_baseline": None,
+                              "error": f"leg {label} failed"}))
+            return 1 if args.check else 0
+        leg["parity"] = all(
+            open(js["output"], "rb").read() == wants[js["folder"]]
+            for js in jobs_spec)
+        legs[label] = leg
+
+    m1 = legs["one_backend"]["makespan_s"]
+    mf = legs["fleet"]["makespan_s"]
+    speedup = round(m1 / mf, 3) if mf else None
+    parity = legs["one_backend"]["parity"] and legs["fleet"]["parity"]
+    spread = {j["backend"] for j in legs["fleet"]["per_job"]}
+    cores = os.cpu_count() or 1
+    row = {
+        "metric": "fleet_makespan",
+        "value": mf,
+        "unit": "s",
+        "vs_baseline": None,
+        "detail": {
+            "speedup_vs_1backend": speedup,
+            "makespan_1backend_s": m1,
+            "makespan_fleet_s": mf,
+            "backends": args.backends,
+            "backends_used": len(spread),
+            "jobs": args.small,
+            "jobs_per_min_fleet": legs["fleet"]["jobs_per_min"],
+            "jobs_per_min_1backend": legs["one_backend"]["jobs_per_min"],
+            "failovers": legs["fleet"]["failovers"],
+            "parity": parity,
+            "cores": cores,
+            "core_limited": cores < args.backends,
+            "per_job_fleet": legs["fleet"]["per_job"],
+        },
+    }
+    print(json.dumps(row))
+    if args.check and (not parity or speedup is None
+                       or speedup < args.fleet_target):
+        print(f"pool_bench: FLEET CHECK FAILED (parity={parity} "
+              f"speedup={speedup} target={args.fleet_target})",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--small", type=int, default=6,
@@ -296,12 +498,23 @@ def main() -> int:
     p.add_argument("--batch-target", type=float, default=2.0,
                    help="--check speedup floor at the deepest sweep depth "
                         "(default 2.0x)")
+    p.add_argument("--fleet", action="store_true",
+                   help="federation-router acceptance mode: 1-backend "
+                        "vs --backends spgemmd subprocesses behind one "
+                        "spgemm-router, parity both legs")
+    p.add_argument("--backends", type=int, default=2,
+                   help="--fleet leg backend count (default 2)")
+    p.add_argument("--fleet-target", type=float, default=1.5,
+                   help="--check speedup floor for the fleet leg "
+                        "(default 1.5x)")
     p.add_argument("--leg", default=None, help=argparse.SUPPRESS)
     args = p.parse_args()
     if args.leg:
         return run_leg(json.loads(args.leg))
     if args.queue_depth_sweep:
         return run_sweep(args)
+    if args.fleet:
+        return run_fleet(args)
 
     import numpy as np  # noqa: PLC0415 -- parent stays jax-free
 

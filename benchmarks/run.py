@@ -545,6 +545,43 @@ def config_serve_batching():
             "value_parity": det["parity"]}
 
 
+def config_fleet_scaling():
+    """Federation-router serving throughput (benchmarks/pool_bench.py
+    --fleet): a batch of distinct small chains submitted through one
+    spgemm-router fronting 1 vs 2 spgemmd subprocess backends, each on
+    its own TCP front-end (spgemm_tpu/fleet), every result bit-exact vs
+    the oracle in both legs and zero failovers on the healthy run.  The
+    row carries the fleet leg's makespan and jobs/minute plus the
+    speedup over the single-backend daemon -- the RESULTS.md view of
+    horizontal (multi-daemon) scaling next to the in-daemon pool row."""
+    child = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "pool_bench.py"),
+         "--fleet", "--small", "4", "--chain", "3", "--small-dim", "6",
+         "--k", "8"],
+        capture_output=True, text=True, timeout=1800)
+    last = next((ln for ln in reversed(child.stdout.strip().splitlines())
+                 if ln.startswith("{")), None)
+    if child.returncode != 0 or last is None:
+        raise RuntimeError(f"pool_bench --fleet failed "
+                           f"(rc {child.returncode}): {child.stderr[-500:]}")
+    row = json.loads(last)
+    if "error" in row:
+        raise RuntimeError(f"pool_bench --fleet error: {row['error']}")
+    det = row["detail"]
+    return {"config": "fleet-scaling", "backend": "spgemm-router",
+            "platform": "cpu",
+            "wall_s": det["makespan_fleet_s"],
+            "jobs": det["jobs"],
+            "jobs_per_min": det["jobs_per_min_fleet"],
+            "jobs_per_min_1backend": det["jobs_per_min_1backend"],
+            "speedup_vs_1backend": det["speedup_vs_1backend"],
+            "fleet_backends": det["backends_used"],
+            "fleet_failovers": det["failovers"],
+            "core_limited": det["core_limited"],
+            "host_cores": det["cores"],
+            "value_parity": det["parity"]}
+
+
 def config_accum_route():
     """Dense vs ladder accumulator-route A/B (SPGEMM_TPU_ACCUM_ROUTE):
     a hub-skew structure whose single deep fanout class pays the ladder's
@@ -655,6 +692,7 @@ CONFIGS = {
     "loader-scaling": config_loader_scaling,
     "pool-scaling": config_pool_scaling,
     "serve-batching": config_serve_batching,
+    "fleet-scaling": config_fleet_scaling,
     "accum-route": config_accum_route,
     "autotune": config_autotune,
 }
@@ -779,6 +817,14 @@ def write_table(rows, path=None):
             if r.get("speedup_vs_window0") is not None:
                 jobs_col += (f" ({r['speedup_vs_window0']:g}x vs "
                              "window=0)")
+            # fleet-scaling row (pool_bench --fleet): router-fronted
+            # multi-daemon throughput vs the single-backend A/B
+            if r.get("speedup_vs_1backend") is not None:
+                jobs_col += (f" ({r['speedup_vs_1backend']:g}x vs "
+                             "1-backend")
+                if r.get("core_limited"):
+                    jobs_col += f", {r.get('host_cores')}-core host"
+                jobs_col += ")"
         # padded-MAC column (accum-route A/B + any row that reports the
         # ratio): shipped/real MAC tax under ladder, the dense route's
         # residual stream-tail ratio, and the dense leg's wall speedup

@@ -305,15 +305,17 @@ def run_knobs(argv: list[str]) -> int:
 
 
 def run_warm(argv: list[str]) -> int:
-    """`spgemm_tpu warm [--stat|--clear] [--dir PATH] [--json]`: inspect
-    or empty the persistent warm-start store (ops/warmstore) -- the
-    on-disk plan/delta entries a restarted spgemmd rehydrates from.  The
-    dir resolves like the daemon's: --dir, else SPGEMM_TPU_WARM_DIR, else
-    the default socket's journal-adjacent <socket>.warm/."""
+    """`spgemm_tpu warm [--stat|--clear|--clone SRC_DIR] [--dir PATH]
+    [--json]`: inspect, empty, or seed the persistent warm-start store
+    (ops/warmstore) -- the on-disk plan/delta entries a restarted
+    spgemmd rehydrates from.  The dir resolves like the daemon's:
+    --dir, else SPGEMM_TPU_WARM_DIR, else the default socket's
+    journal-adjacent <socket>.warm/."""
     p = argparse.ArgumentParser(
         prog="spgemm_tpu warm",
-        description="inspect (--stat, default) or empty (--clear) the "
-                    "persistent warm-start store")
+        description="inspect (--stat, default), empty (--clear), or "
+                    "seed from a peer (--clone) the persistent "
+                    "warm-start store")
     g = p.add_mutually_exclusive_group()
     g.add_argument("--stat", action="store_true",
                    help="entry counts, bytes, budget, and whether a live "
@@ -322,6 +324,13 @@ def run_warm(argv: list[str]) -> int:
                    help="delete every warm entry and the xla compilation-"
                         "cache subdir; refuses while a live process holds "
                         "the dir's lock")
+    g.add_argument("--clone", default=None, metavar="SRC_DIR",
+                   help="copy a peer's warm entries into the dir (fleet "
+                        "seeding: a new backend skips the fleet's known "
+                        "first contacts) -- envelope-checked entry by "
+                        "entry, schema skew is a counted skip, existing "
+                        "local entries are kept; refuses while a live "
+                        "process holds the destination's lock")
     p.add_argument("--dir", default=None, metavar="PATH",
                    help="warm dir (default: SPGEMM_TPU_WARM_DIR, else "
                         "<default socket>.warm)")
@@ -338,6 +347,23 @@ def run_warm(argv: list[str]) -> int:
             print(f"warm: {e}", file=sys.stderr)
             return 1
         print(f"warm: cleared {removed} entries from {target}")
+        return 0
+    if args.clone:
+        try:
+            result = warmstore.clone(args.clone, target)
+        except RuntimeError as e:
+            print(f"warm: {e}", file=sys.stderr)
+            return 1
+        if args.as_json:
+            import json  # noqa: PLC0415
+
+            print(json.dumps(result, indent=2))
+        else:
+            print(f"warm: cloned {result['copied']} entries "
+                  f"{args.clone} -> {target} "
+                  f"({result['skipped']} skipped"
+                  + (f": {result['skip_reasons']}"
+                     if result["skip_reasons"] else "") + ")")
         return 0
     info = warmstore.scan(target)
     if args.as_json:
@@ -462,11 +488,15 @@ def _subcommands() -> dict:
         from spgemm_tpu.serve import client  # noqa: PLC0415
         return client.main_slo(argv)
 
+    def route(argv: list[str]) -> int:
+        from spgemm_tpu.fleet import router  # noqa: PLC0415
+        return router.main(argv)
+
     return {"knobs": run_knobs, "serve": serve,
             "submit": submit, "status": status,
             "metrics": metrics, "trace-dump": trace_dump,
             "profile": profile, "events": events, "slo": slo,
-            "warm": run_warm, "tune": run_tune}
+            "warm": run_warm, "tune": run_tune, "route": route}
 
 
 def run(argv: list[str] | None = None) -> int:
@@ -481,7 +511,7 @@ def run(argv: list[str] | None = None) -> int:
     # scratch dir does not swallow the subcommand
     if (argv and argv[0] in ("knobs", "serve", "submit", "status",
                              "metrics", "trace-dump", "profile", "events",
-                             "slo", "warm", "tune")
+                             "slo", "warm", "tune", "route")
             and not os.path.exists(os.path.join(argv[0], "size"))):
         return _subcommands()[argv[0]](argv[1:])
     parser = build_parser()

@@ -1,0 +1,13 @@
+"""L7 fleet layer: multi-daemon federation behind the TCP front-end.
+
+`fleet/router.py` is the jax-free resident router (`cli route`) that
+fronts N spgemmd backends over the same newline-JSON protocol the unix
+socket speaks; `fleet/pricebook.py` is its replicated estimator price
+book (pair-mass signatures gossiped via each backend's stats placement
+block).  `fleet/fleet_smoke.py` is the end-to-end CPU proof
+(`make fleet-smoke`).
+
+jax-free by design, like serve/client.py: a router must place and proxy
+without ever paying a JAX import or touching a possibly-dead backend
+device -- the daemons own the devices, the router owns only sockets.
+"""

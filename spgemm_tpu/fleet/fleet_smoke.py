@@ -1,0 +1,349 @@
+"""`make fleet-smoke`: end-to-end fleet proof on the CPU backend.
+
+Starts TWO real spgemmd subprocesses, each listening on a unix socket
+AND a TCP port (`--addr tcp:127.0.0.1:P` -- the network front-end), and
+one spgemm-router subprocess fronting both over TCP, then asserts the
+fleet contract:
+
+  * the router's poll marks both backends healthy (stats `backends`
+    block) and placement spreads a mixed-tenant burst across BOTH
+    backends, every result byte-exact against the host-only oracle
+    multiply, every submit answer naming its `backend`;
+  * the aggregated scrape carries the router's own families
+    (spgemm_router_backend_up per backend) AND every backend's own
+    series re-labeled with `backend=` -- one flat fleet surface;
+  * TRACE LEG: a submit's client-minted trace context passes through
+    the router untouched, and `cli trace-dump --merge` over the
+    client's ring dump + the router's trace + the serving backend's
+    trace stitches ONE Perfetto file in which that trace id resolves
+    to spans from all THREE processes (client_submit -> router_submit
+    -> backend job spans);
+  * KILL LEG: SIGKILL one backend under a burst of in-flight jobs --
+    every job either completes bit-exact (failed over to the survivor:
+    re-submitted once, idempotent by the stored submit message) or
+    fails with a structured error (backend-lost), never a hang; the
+    router marks the dead backend down and lands every later submit on
+    the survivor;
+  * shutdown is clean: SIGTERM drains the router (exit 0) and the
+    surviving daemon (exit 0).
+
+Any step failing exits nonzero.  This process itself stays jax-free
+(the oracle and generator are pure numpy; the router is jax-free by
+design) -- only the daemons touch a backend, which is the deployment
+shape being smoked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _fail(procs, msg: str) -> int:
+    print(f"fleet-smoke: FAIL: {msg}", file=sys.stderr)
+    for proc in procs:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+    for proc in procs:
+        if proc is not None:
+            try:
+                out, _ = proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                continue
+            sys.stderr.write(out[-3000:] if out else "")
+    return 1
+
+
+def _free_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_tcp(port: int, proc, procs, what: str,
+              deadline_s: float = 120.0) -> int | None:
+    deadline = time.time() + deadline_s
+    while True:
+        if proc.poll() is not None:
+            return _fail(procs, f"{what} exited before listening on "
+                                f"port {port}")
+        try:
+            probe = socket.create_connection(("127.0.0.1", port),
+                                             timeout=1.0)
+        except OSError:
+            if time.time() > deadline:
+                return _fail(procs, f"{what} never listened on port "
+                                    f"{port}")
+            time.sleep(0.1)
+            continue
+        probe.close()
+        return None
+
+
+def main() -> int:
+    import numpy as np  # noqa: PLC0415
+
+    from spgemm_tpu.obs import trace as obs_trace  # noqa: PLC0415
+    from spgemm_tpu.serve import client  # noqa: PLC0415
+    from spgemm_tpu.utils import io_text  # noqa: PLC0415
+    from spgemm_tpu.utils.blockcsr import BlockSparseMatrix  # noqa: PLC0415
+    from spgemm_tpu.utils.gen import random_chain  # noqa: PLC0415
+    from spgemm_tpu.utils.semantics import chain_oracle  # noqa: PLC0415
+
+    tmp = tempfile.mkdtemp(prefix="spgemm-fleet-smoke-")
+    k = 8
+    folder = os.path.join(tmp, "chain_in")
+    mats = random_chain(4, 12, k, 0.4, np.random.default_rng(11), "full")
+    io_text.write_chain_dir(folder, mats, k)
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, k, want).prune_zeros())
+
+    # the harness owns every serve/fleet knob it asserts against
+    env = {key: v for key, v in os.environ.items()
+           if not (key.startswith("SPGEMM_TPU_WARM")
+                   or key.startswith("SPGEMM_TPU_SERVE")
+                   or key.startswith("SPGEMM_TPU_ROUTER"))}
+    ports = [_free_port(), _free_port()]
+    router_port = _free_port()
+    socks = [os.path.join(tmp, f"b{i}.sock") for i in (0, 1)]
+    backend_names = [f"tcp:127.0.0.1:{p}" for p in ports]
+    router_addr = f"tcp:127.0.0.1:{router_port}"
+
+    backends = []
+    procs: list[subprocess.Popen | None] = []
+    for i in (0, 1):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spgemm_tpu.cli", "serve",
+             "--socket", socks[i], "--addr", backend_names[i],
+             "--device", "cpu", "-v"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        backends.append(proc)
+        procs.append(proc)
+    router = None
+    try:
+        for i in (0, 1):
+            rc = _wait_tcp(ports[i], backends[i], procs,
+                           f"backend {i}")
+            if rc is not None:
+                return rc
+
+        router = subprocess.Popen(
+            [sys.executable, "-m", "spgemm_tpu.cli", "route",
+             "--listen", router_addr,
+             "--backends", ",".join(backend_names),
+             "--poll-s", "0.5", "-v"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        procs.append(router)
+        rc = _wait_tcp(router_port, router, procs, "router")
+        if rc is not None:
+            return rc
+        deadline = time.time() + 60
+        while True:
+            st = client.stats(router_addr)
+            up = [name for name, b in (st.get("backends") or {}).items()
+                  if b.get("up")]
+            if len(up) == 2:
+                break
+            if time.time() > deadline:
+                return _fail(procs, f"router never saw both backends "
+                                    f"healthy (up: {up})")
+            time.sleep(0.2)
+        if st.get("daemon") != "spgemm-router":
+            return _fail(procs, f"stats daemon={st.get('daemon')!r} "
+                                "(want spgemm-router)")
+
+        # ---- mixed-tenant burst through the front door ----
+        jobs = []
+        for i in range(6):
+            out = os.path.join(tmp, f"matrix.{i}")
+            resp = client.submit(folder, router_addr, {"output": out},
+                                 tenant=f"team-{i % 3}")
+            if not resp.get("backend"):
+                return _fail(procs, f"submit {i} answer carries no "
+                                    "`backend` field")
+            jobs.append((resp["id"], resp["backend"], out))
+        served = set()
+        for jid, backend, out in jobs:
+            r = client.wait(jid, router_addr, timeout=300)
+            if r["job"]["state"] != "done":
+                return _fail(procs, f"job {jid} ended "
+                                    f"{r['job']['state']}: "
+                                    f"{r['job'].get('error')}")
+            if r["job"]["id"] != jid:
+                return _fail(procs, f"wait answered job "
+                                    f"{r['job']['id']} for fleet id "
+                                    f"{jid}")
+            if open(out, "rb").read() != want_bytes:
+                return _fail(procs, f"job {jid} output does not match "
+                                    "the oracle bytes")
+            served.add(backend)
+        if len(served) != 2:
+            return _fail(procs, "the burst did not spread across both "
+                                f"backends (served by {served})")
+
+        # ---- aggregated scrape: router families + relabeled backends --
+        scrape = client.metrics(router_addr)
+        for name in backend_names:
+            needle = f'spgemm_router_backend_up{{backend="{name}"}} 1'
+            if needle not in scrape:
+                return _fail(procs, f"scrape lacks {needle!r}")
+        if not any(('backend="' in ln
+                    and not ln.startswith("spgemm_router_"))
+                   for ln in scrape.splitlines()):
+            return _fail(procs, "scrape carries no backend-relabeled "
+                                "passthrough series")
+
+        # ---- trace leg: client -> router -> backend, one flame view --
+        out_t = os.path.join(tmp, "matrix.trace")
+        resp = client.submit(folder, router_addr, {"output": out_t},
+                             tenant="tracer")
+        trace_id = resp.get("trace")
+        t_backend = resp["backend"]
+        if not isinstance(trace_id, str) or len(trace_id) != 32:
+            return _fail(procs, f"submit returned no 128-bit trace "
+                                f"context through the router "
+                                f"(got {trace_id!r})")
+        r = client.wait(resp["id"], router_addr, timeout=300)
+        if r["job"]["state"] != "done":
+            return _fail(procs, f"trace-leg job ended "
+                                f"{r['job']['state']}: "
+                                f"{r['job'].get('error')}")
+        stitch = os.path.join(tmp, "stitch")
+        obs_trace.dump_json(os.path.join(stitch, "client.trace.json"),
+                            process_name="fleet-smoke-client")
+        for addr, fname in ((router_addr, "router.trace.json"),
+                            (t_backend, "backend.trace.json")):
+            rc = subprocess.run(
+                [sys.executable, "-m", "spgemm_tpu.cli", "trace-dump",
+                 "--addr", addr, "-o", os.path.join(stitch, fname)],
+                capture_output=True, text=True, timeout=60)
+            if rc.returncode != 0:
+                return _fail(procs, f"trace-dump --addr {addr} failed: "
+                                    f"{rc.stderr[-500:]}")
+        merged_path = os.path.join(tmp, "merged.trace.json")
+        rc = subprocess.run(
+            [sys.executable, "-m", "spgemm_tpu.cli", "trace-dump",
+             "--merge", stitch, "--trace", trace_id, "-o", merged_path],
+            capture_output=True, text=True, timeout=60)
+        if rc.returncode != 0:
+            return _fail(procs, f"cli trace-dump --merge failed: "
+                                f"{rc.stderr[-500:]}")
+        with open(merged_path, encoding="utf-8") as f:
+            merged = json.load(f)
+        spans = [ev for ev in merged if ev.get("ph") != "M"]
+        pids = {ev["pid"] for ev in spans}
+        names = {ev["name"] for ev in spans}
+        if len(pids) < 3:
+            return _fail(procs, f"merge did not stitch client AND "
+                                f"router AND backend tracks (pids "
+                                f"{pids}, names {sorted(names)})")
+        for span in ("client_submit", "router_submit"):
+            if span not in names:
+                return _fail(procs, f"merged trace lacks the {span} "
+                                    f"span (saw {sorted(names)})")
+
+        # ---- kill leg: one backend dies under load ----
+        kill_jobs = []
+        for i in range(6):
+            out = os.path.join(tmp, f"matrix.k{i}")
+            resp = client.submit(folder, router_addr, {"output": out},
+                                 tenant=f"team-{i % 3}")
+            kill_jobs.append((resp["id"], out))
+        backends[0].kill()  # SIGKILL: no drain, jobs die with it
+        completed = structured = 0
+        for jid, out in kill_jobs:
+            try:
+                r = client.wait(jid, router_addr, timeout=300)
+            except client.ServeError as e:
+                if e.code not in ("backend-lost", "no-backend",
+                                  "job-error", "unknown-job"):
+                    return _fail(procs, f"job {jid} failed with an "
+                                        f"undeclared code after the "
+                                        f"kill: [{e.code}] {e.message}")
+                structured += 1
+                continue
+            if r["job"]["state"] == "done":
+                if open(out, "rb").read() != want_bytes:
+                    return _fail(procs, f"post-kill job {jid} output "
+                                        "does not match the oracle "
+                                        "bytes")
+                completed += 1
+            else:
+                structured += 1  # terminal failed with a structured error
+        if completed + structured != len(kill_jobs):
+            return _fail(procs, "some post-kill job neither completed "
+                                "nor failed structured")
+
+        # the router must have benched the dead backend and every new
+        # submit must land on the survivor
+        deadline = time.time() + 30
+        while True:
+            st = client.stats(router_addr)
+            dead = (st.get("backends") or {}).get(backend_names[0], {})
+            if not dead.get("up"):
+                break
+            if time.time() > deadline:
+                return _fail(procs, "router still reports the killed "
+                                    "backend up")
+            time.sleep(0.2)
+        out_s = os.path.join(tmp, "matrix.survivor")
+        resp = client.submit(folder, router_addr, {"output": out_s})
+        if resp["backend"] != backend_names[1]:
+            return _fail(procs, f"post-kill submit landed on "
+                                f"{resp['backend']} (want the survivor "
+                                f"{backend_names[1]})")
+        r = client.wait(resp["id"], router_addr, timeout=300)
+        if r["job"]["state"] != "done":
+            return _fail(procs, f"survivor job ended "
+                                f"{r['job']['state']}: "
+                                f"{r['job'].get('error')}")
+        if open(out_s, "rb").read() != want_bytes:
+            return _fail(procs, "survivor output does not match the "
+                                "oracle bytes")
+        failovers = (client.stats(router_addr).get("jobs")
+                     or {}).get("failovers", 0)
+
+        # ---- clean drain: router then the survivor ----
+        router.send_signal(signal.SIGTERM)
+        try:
+            rc_router = router.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            return _fail(procs, "router did not exit after SIGTERM")
+        if rc_router != 0:
+            return _fail(procs, f"router exited {rc_router} after "
+                                "SIGTERM")
+        client.shutdown(socks[1])
+        try:
+            rc_b = backends[1].wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            return _fail(procs, "surviving daemon did not exit after "
+                                "shutdown")
+        if rc_b != 0:
+            return _fail(procs, f"surviving daemon exited {rc_b} after "
+                                "shutdown")
+    finally:
+        for proc in procs:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+    print(f"fleet-smoke: OK (6 mixed-tenant jobs bit-exact across "
+          f"{sorted(served)}; aggregated scrape labeled per backend; "
+          f"trace {trace_id} stitched across {len(pids)} processes; "
+          f"kill leg: {completed} completed / {structured} structured "
+          f"of {len(kill_jobs)} with {failovers} failover(s), survivor "
+          f"took the rest; router + survivor drained clean)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,83 @@
+"""Replicated estimator price book for fleet-level placement.
+
+Each spgemmd prices structures it has actually read (the sampled
+estimator's pair mass, serve/placement.note_mass) and gossips its newest
+book entries in every stats answer (`placement.book`, bounded by
+placement.BOOK_GOSSIP_CAP).  The router's poll loop merges those samples
+HERE, so a submit whose folder any backend has priced routes on a real
+estimate -- the same Ocean-style estimation-steers-resources signal the
+in-daemon scheduler uses, one level up.
+
+Keys are serve/placement.signature stat signatures (folder + file
+names/sizes/mtimes), so the book is content-stamped exactly like the
+per-daemon one: a mutated input re-prices instead of riding a stale
+mass.  Pricing steers placement only, never bits.
+
+jax-free by design (imported by the router's conn and poll threads).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from spgemm_tpu.serve import placement
+
+# merged-book capacity, LRU past it (same scale as the per-daemon book:
+# one entry per distinct (folder, content-stamp) across the fleet)
+CAP = 4096
+
+
+class PriceBook:
+    """The router's merged (signature -> pair mass) book."""
+
+    def __init__(self, cap: int = CAP):
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._book: "OrderedDict[str, float]" = OrderedDict()  # spgemm-lint: guarded-by(_lock)
+        self._merged = 0   # spgemm-lint: guarded-by(_lock)
+        self._hits = 0     # spgemm-lint: guarded-by(_lock)
+        self._misses = 0   # spgemm-lint: guarded-by(_lock)
+
+    def merge(self, placement_block) -> int:
+        """Fold one backend's gossiped stats placement block in (newest
+        sightings win); returns the number of entries taken.  A
+        malformed block contributes nothing -- gossip is best-effort,
+        placement falls back to round-robin."""
+        book = (placement_block or {}).get("book") \
+            if isinstance(placement_block, dict) else None
+        if not isinstance(book, dict):
+            return 0
+        taken = 0
+        with self._lock:
+            for sig, mass in book.items():
+                if not isinstance(sig, str) \
+                        or not isinstance(mass, (int, float)):
+                    continue
+                self._book[sig] = float(mass)
+                self._book.move_to_end(sig)
+                taken += 1
+            while len(self._book) > self._cap:
+                self._book.popitem(last=False)
+            self._merged += taken
+        return taken
+
+    def lookup(self, folder: str) -> float | None:
+        """The fleet-replicated pair mass for the folder's CURRENT
+        content, or None on first contact / content change / unreadable
+        folder."""
+        sig = placement.signature(folder)
+        with self._lock:
+            if sig is None or sig not in self._book:
+                self._misses += 1
+                return None
+            self._book.move_to_end(sig)
+            self._hits += 1
+            return self._book[sig]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"book_entries": len(self._book),
+                    "book_hits": self._hits,
+                    "book_misses": self._misses,
+                    "merged": self._merged}

@@ -121,6 +121,16 @@ EVENT_KINDS: dict[str, str] = {
     "tune_revert": "a tuned override was dropped (canary failure or "
                    "trial-time parity mismatch) and its class backed "
                    "off before re-trial",
+    "router_start": "spgemm-router came up (listen address, backend "
+                    "list, poll cadence)",
+    "router_backend_down": "a backend failed its stats poll (or was "
+                           "degraded) and left placement",
+    "router_backend_up": "a backend answered its stats poll healthy "
+                         "and (re)joined placement",
+    "router_failover": "a job's backend died mid-flight; the job was "
+                       "re-submitted once to a healthy peer (or "
+                       "failed structured backend-lost -- outcome "
+                       "rides along)",
 }
 
 

@@ -885,3 +885,101 @@ def clear_tunes(path: str) -> int:
             except OSError:
                 pass
     return removed
+
+
+def clone(src: str, dst: str) -> dict:
+    """Seed one warm dir from a peer's (`cli warm --clone SRC_DIR`;
+    fleet bring-up: a new backend starts with a sibling's plans/deltas/
+    tunes instead of a cold first contact for every structure the fleet
+    already knows).  The SOURCE is read lock-free -- entries land via
+    atomic rename, so a concurrent daemon's flush can never hand us a
+    torn file, only a complete old or new one.  The DESTINATION gets
+    the same live-process refusal as clear(): seeding under a running
+    daemon would race its flush/prune cycle.
+
+    Every entry is envelope-checked before it lands: unreadable npz,
+    schema-version skew, or a kind/filename mismatch is a counted skip,
+    never a crash -- and an entry already present at the destination is
+    left alone (the local copy may be newer).  Knob-vector and identity
+    checks stay with the loading daemon (_check_envelope): the cloner
+    cannot know the destination's jit-static vector.  Returns
+    {"copied", "skipped", "skip_reasons"}."""
+    import shutil  # noqa: PLC0415
+    import zipfile  # noqa: PLC0415
+
+    if not os.path.isdir(src):
+        raise RuntimeError(f"warm clone source {src} is not a directory")
+    if os.path.abspath(src) == os.path.abspath(dst):
+        raise RuntimeError("warm clone source and destination are the "
+                           "same directory")
+    if os.path.isdir(dst):
+        with _LOCK:
+            own = _LOCK_FILE is not None and _DIR == dst
+        if not own:
+            import fcntl  # noqa: PLC0415
+            lock_path = os.path.join(dst, "lock")
+            if os.path.exists(lock_path):
+                try:
+                    probe = open(lock_path, "a+")
+                except OSError:
+                    probe = None
+                if probe is not None:
+                    try:
+                        fcntl.flock(probe.fileno(),
+                                    fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    except OSError:
+                        probe.close()
+                        raise RuntimeError(
+                            f"warm dir {dst} is in use by a live "
+                            "process; stop it before seeding") from None
+                    probe.close()  # drops the probe lock
+    else:
+        os.makedirs(dst, exist_ok=True)
+    copied = skipped = 0
+    reasons: dict[str, int] = {}
+
+    def skip(reason: str) -> None:
+        nonlocal skipped
+        skipped += 1
+        reasons[reason] = reasons.get(reason, 0) + 1
+
+    for name in sorted(os.listdir(src)):
+        if not name.endswith(".npz") or name.endswith(".tmp.npz"):
+            continue
+        prefix = name.split("-", 1)[0]
+        if prefix not in ("plan", "delta", "tune"):
+            skip("unknown-kind")
+            continue
+        dst_path = os.path.join(dst, name)
+        if os.path.exists(dst_path):
+            skip("exists")
+            continue
+        src_path = os.path.join(src, name)
+        try:
+            with np.load(src_path, allow_pickle=False) as z:
+                schema = int(z["schema"]) if "schema" in z.files else -1
+                if schema != SCHEMA_VERSION:
+                    skip("schema-skew")
+                    continue
+                if str(z["kind"]) != prefix:
+                    skip("kind-mismatch")
+                    continue
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            skip("unreadable")
+            continue
+        tmp = dst_path + ".tmp.npz"
+        try:
+            shutil.copyfile(src_path, tmp)
+            os.replace(tmp, dst_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            skip("copy-failed")
+            continue
+        copied += 1
+    log.info("warm clone %s -> %s: %d copied, %d skipped %s",
+             src, dst, copied, skipped, reasons or "")
+    return {"copied": copied, "skipped": skipped,
+            "skip_reasons": reasons}

@@ -55,18 +55,29 @@ class ServeError(Exception):
 
 def _connect(path: str, timeout: float | None,
              retry_total_s: float) -> socket.socket:
-    """Connect to the daemon socket, riding out a restart window:
-    connection-refused / socket-missing retries with capped exponential
-    backoff for at most retry_total_s seconds, then raises a structured
-    daemon-unavailable ServeError (chained on the last OS error)."""
+    """Connect to the daemon at `path` -- a unix socket path, `unix:PATH`,
+    or `tcp:HOST:PORT` (protocol.parse_addr) -- riding out a restart
+    window: connection-refused / socket-missing / connection-reset
+    retries with capped exponential backoff for at most retry_total_s
+    seconds, then raises a structured daemon-unavailable ServeError
+    (chained on the last OS error).  The retry/backoff/error contract is
+    transport-independent: a TCP front-end restart looks exactly like a
+    unix-socket rollout to the caller."""
+    parsed = protocol.parse_addr(path)
     deadline = time.time() + retry_total_s
     backoff = 0.0
     while True:
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        if parsed[0] == "tcp":
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            target = (parsed[1], parsed[2])
+        else:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            target = parsed[1]
         sock.settimeout(timeout)
         try:
-            sock.connect(path)
-        except (ConnectionRefusedError, FileNotFoundError) as e:
+            sock.connect(target)
+        except (ConnectionRefusedError, ConnectionResetError,
+                FileNotFoundError) as e:
             sock.close()
             now = time.time()
             if now >= deadline:
@@ -104,7 +115,7 @@ def request(msg: dict, socket_path: str | None = None,
     (protocol.strip_for_version; the daemon supplies the fallback:
     default tenant, minted trace) -- rolling upgrades work in both
     directions."""
-    path = socket_path or protocol.default_socket_path()
+    path = socket_path or protocol.default_addr()
     if retry_total_s is None:
         retry_total_s = CONNECT_RETRY_TOTAL_S
     version = protocol.version_for(msg)
@@ -248,6 +259,23 @@ def shutdown(socket_path: str | None = None) -> dict:
 
 
 # ------------------------------------------------------------- CLI glue --
+def _add_addr_arg(p: argparse.ArgumentParser) -> None:
+    """The ONE uniform network-address flag every daemon-facing
+    subcommand carries: `tcp:HOST:PORT` dials a TCP front-end (daemon or
+    fleet router), a path dials a unix socket.  Wins over --socket;
+    both unset falls back to SPGEMM_TPU_SERVE_ADDR, then the default
+    unix socket -- so an exported fleet address redirects every client
+    on the host without per-command flags."""
+    p.add_argument("--addr", default=None, metavar="ADDR",
+                   help="daemon address: tcp:HOST:PORT or a unix socket "
+                        "path (wins over --socket; default: "
+                        "SPGEMM_TPU_SERVE_ADDR, then the unix socket)")
+
+
+def _resolve_addr(args) -> str | None:
+    return args.addr or args.socket
+
+
 def main_submit(argv: list[str] | None = None) -> int:
     """`spgemm_tpu submit <folder>`: enqueue a chain job on the daemon."""
     p = argparse.ArgumentParser(
@@ -258,6 +286,7 @@ def main_submit(argv: list[str] | None = None) -> int:
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
+    _add_addr_arg(p)
     p.add_argument("--output", default=None,
                    help="result path (default: <folder>/matrix)")
     p.add_argument("--backend", choices=list(protocol.CHAIN_BACKENDS),
@@ -292,11 +321,12 @@ def main_submit(argv: list[str] | None = None) -> int:
         ("checkpoint_dir", args.checkpoint_dir),
         ("timeout_s", args.timeout),
         ("failover", args.failover or None)) if v is not None}
+    addr = _resolve_addr(args)
     try:
-        resp = submit(args.folder, args.socket, options,
+        resp = submit(args.folder, addr, options,
                       tenant=args.tenant, trace=args.trace)
         if args.wait:
-            resp = wait(resp["id"], args.socket)
+            resp = wait(resp["id"], addr)
     except (ServeError, OSError) as e:
         print(f"submit failed: {e}", file=sys.stderr)
         return 1
@@ -319,9 +349,10 @@ def main_metrics(argv: list[str] | None = None) -> int:
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
+    _add_addr_arg(p)
     args = p.parse_args(argv)
     try:
-        sys.stdout.write(metrics(args.socket))
+        sys.stdout.write(metrics(_resolve_addr(args)))
     except (ServeError, OSError) as e:
         print(f"metrics failed: {e}", file=sys.stderr)
         return 1
@@ -343,12 +374,13 @@ def main_profile(argv: list[str] | None = None) -> int:
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
+    _add_addr_arg(p)
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="full machine-readable report (per-record compile "
                         "list + every aggregate account)")
     args = p.parse_args(argv)
     try:
-        rep = profile(args.socket)
+        rep = profile(_resolve_addr(args))
     except (ServeError, OSError) as e:
         print(f"profile failed: {e}", file=sys.stderr)
         return 1
@@ -403,6 +435,7 @@ def main_events(argv: list[str] | None = None) -> int:
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
+    _add_addr_arg(p)
     p.add_argument("--tail", type=int, default=50, metavar="N",
                    help="newest N records (default 50; bounded by the "
                         "daemon's in-process event ring -- the on-disk "
@@ -415,7 +448,7 @@ def main_events(argv: list[str] | None = None) -> int:
                         "repeats a line; Ctrl-C exits 0)")
     args = p.parse_args(argv)
     try:
-        resp = events_info(args.tail, args.socket)
+        resp = events_info(args.tail, _resolve_addr(args))
     except (ServeError, OSError) as e:
         print(f"events failed: {e}", file=sys.stderr)
         return 1
@@ -455,11 +488,12 @@ def main_slo(argv: list[str] | None = None) -> int:
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
+    _add_addr_arg(p)
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="full machine-readable report")
     args = p.parse_args(argv)
     try:
-        rep = slo(args.socket)
+        rep = slo(_resolve_addr(args))
     except (ServeError, OSError) as e:
         print(f"slo failed: {e}", file=sys.stderr)
         return 1
@@ -511,6 +545,7 @@ def main_trace_dump(argv: list[str] | None = None) -> int:
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="daemon socket (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
+    _add_addr_arg(p)
     p.add_argument("--merge", default=None, metavar="DIR",
                    help="instead of scraping a daemon, stitch every "
                         "*.json trace dump under DIR (client ring dumps, "
@@ -542,7 +577,7 @@ def main_trace_dump(argv: list[str] | None = None) -> int:
             return 1
     else:
         try:
-            events = trace(args.socket)
+            events = trace(_resolve_addr(args))
         except (ServeError, OSError) as e:
             print(f"trace-dump failed: {e}", file=sys.stderr)
             return 1
@@ -568,20 +603,22 @@ def main_status(argv: list[str] | None = None) -> int:
                     "daemon-wide stats with no job id")
     p.add_argument("job_id", nargs="?", default=None)
     p.add_argument("--socket", default=None, metavar="PATH")
+    _add_addr_arg(p)
     p.add_argument("--wait", action="store_true",
                    help="block until the job is terminal")
     p.add_argument("--shutdown", action="store_true",
                    help="ask the daemon to shut down cleanly")
     args = p.parse_args(argv)
+    addr = _resolve_addr(args)
     try:
         if args.shutdown:
-            resp = shutdown(args.socket)
+            resp = shutdown(addr)
         elif args.job_id is None:
-            resp = stats(args.socket)
+            resp = stats(addr)
         elif args.wait:
-            resp = wait(args.job_id, args.socket)
+            resp = wait(args.job_id, addr)
         else:
-            resp = status(args.job_id, args.socket)
+            resp = status(args.job_id, addr)
     except (ServeError, OSError) as e:
         print(f"status failed: {e}", file=sys.stderr)
         return 1

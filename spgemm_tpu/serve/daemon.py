@@ -484,8 +484,28 @@ class Daemon:
                  slices: str | None = None, n_devices: int | None = None,
                  tenant_inflight: int | None = None,
                  recover_s: float | None = None,
-                 device_kind: str | None = None):
+                 device_kind: str | None = None,
+                 addr: str | None = None):
         self.socket_path = socket_path or protocol.default_socket_path()
+        # optional TCP front-end (the fleet layer's network half): the
+        # same protocol bytes on an AF_INET listener beside the unix
+        # socket.  Unset = unix-only, byte-identical to the pre-fleet
+        # daemon.  Parsed HERE so a malformed spec fails construction,
+        # not the accept path.
+        self._addr_spec = addr if addr is not None \
+            else knobs.get("SPGEMM_TPU_SERVE_ADDR")
+        if self._addr_spec:
+            parsed = protocol.parse_addr(self._addr_spec)
+            if parsed[0] != "tcp":
+                raise ValueError(
+                    f"SPGEMM_TPU_SERVE_ADDR must be tcp:HOST:PORT (the "
+                    f"unix socket always listens), got {self._addr_spec!r}")
+            self._tcp_bind = (parsed[1], parsed[2])
+        else:
+            self._tcp_bind = None
+        # the REAL bound port (resolves a tcp:...:0 ephemeral bind);
+        # written once in start() before the accept threads spawn
+        self.tcp_port: int | None = None
         self.journal_path = self.socket_path + ".journal"
         # postmortem flight dumps (watchdog reap / wedge / degrade) land
         # here, next to the journal: <socket>.flight/<job>.trace.json
@@ -582,6 +602,7 @@ class Daemon:
         self._stop = threading.Event()
         self._lock = threading.Lock()  # ids, journal file, degrade state
         self._listener: socket.socket | None = None
+        self._tcp_listener: socket.socket | None = None
         self._conn_count = 0               # spgemm-lint: guarded-by(_lock)
         self._threads: list[threading.Thread] = []
 
@@ -755,16 +776,40 @@ class Daemon:
         # blocked accept on Linux, and shutdown semantics vary -- the
         # accept loop re-checks the stop flag every tick instead
         self._listener.settimeout(0.2)
+        if self._tcp_bind is not None:
+            # the TCP front-end: same protocol bytes, same accept loop,
+            # same conn cap/idle timeout -- only the address family
+            # differs.  Bind failures (port taken, bad host) propagate:
+            # an exported SPGEMM_TPU_SERVE_ADDR must never degrade to a
+            # silently unix-only daemon.
+            self._tcp_listener = socket.socket(socket.AF_INET,
+                                               socket.SOCK_STREAM)
+            self._tcp_listener.setsockopt(socket.SOL_SOCKET,
+                                          socket.SO_REUSEADDR, 1)
+            self._tcp_listener.bind(self._tcp_bind)
+            self._tcp_listener.listen(16)
+            self._tcp_listener.settimeout(0.2)
+            self.tcp_port = self._tcp_listener.getsockname()[1]
         for sl in self.slices:
             self._spawn_executor(sl)
-        for target, name in ((self._accept_loop, "spgemmd-accept"),
-                             (self._watchdog_loop, "spgemmd-watchdog")):
-            t = threading.Thread(target=target, name=name, daemon=True)
+        accept_loops = [(self._listener, "spgemmd-accept")]
+        if self._tcp_listener is not None:
+            accept_loops.append((self._tcp_listener, "spgemmd-accept-tcp"))
+        for listener, name in accept_loops:
+            t = threading.Thread(target=self._accept_loop,
+                                 args=(listener,), name=name, daemon=True)
             t.start()
             self._threads.append(t)
-        log.info("spgemmd serving on %s (%d slice(s): %s; queue cap %d, "
+        t = threading.Thread(target=self._watchdog_loop,
+                             name="spgemmd-watchdog", daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("spgemmd serving on %s%s (%d slice(s): %s; queue cap %d, "
                  "job timeout %s)",
-                 self.socket_path, len(self.slices),
+                 self.socket_path,
+                 (f" + tcp:{self._tcp_bind[0]}:{self.tcp_port}"
+                  if self.tcp_port is not None else ""),
+                 len(self.slices),
                  ",".join(f"{s.name}{'*' if s.default else ''}"
                           for s in self.slices),
                  self._cap, self._job_timeout_s or "none")
@@ -790,11 +835,12 @@ class Daemon:
         live journal records: the successor daemon re-runs them (the
         at-least-once restart contract)."""
         self._stop.set()
-        if self._listener is not None:
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+        for listener in (self._listener, self._tcp_listener):
+            if listener is not None:
+                try:
+                    listener.close()
+                except OSError:
+                    pass
         deadline = time.time() + self.DRAIN_GRACE_S
         while time.time() < deadline and self.queue.running():
             time.sleep(0.05)
@@ -1823,10 +1869,10 @@ class Daemon:
         obs_events.emit("slice_canary_passed", slice=sl.name)
 
     # ----------------------------------------------------------- protocol --
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, listener: socket.socket) -> None:
         while not self._stop.is_set():
             try:
-                conn, _ = self._listener.accept()
+                conn, _ = listener.accept()
             except socket.timeout:
                 continue
             except OSError:
@@ -2304,6 +2350,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--socket", default=None, metavar="PATH",
                    help="unix socket path (default: SPGEMM_TPU_SERVE_SOCKET "
                         "or <tmpdir>/spgemmd-<uid>.sock)")
+    p.add_argument("--addr", default=None, metavar="ADDR",
+                   help="TCP front-end address, tcp:HOST:PORT (default: "
+                        "SPGEMM_TPU_SERVE_ADDR; unset = unix-socket only)")
     p.add_argument("--device", default=None, metavar="PLATFORM",
                    help="pin a JAX platform before serving (e.g. cpu); "
                         "without it the default backend is probed first and "
@@ -2355,8 +2404,8 @@ def main(argv: list[str] | None = None) -> int:
                         journal=not args.no_journal,
                         persist_compile_cache=True,
                         slices=args.slices, n_devices=n_devices,
-                        device_kind=device_kind)
-    except mesh_mod.SliceSpecError as e:
+                        device_kind=device_kind, addr=args.addr)
+    except (mesh_mod.SliceSpecError, ValueError) as e:
         print(f"spgemmd: {e}", file=sys.stderr)
         return 1
     if degraded_at_start:

@@ -49,6 +49,11 @@ LARGE_INPUT_BYTES = 64 << 20
 # LRU past this, like every other client-growable resource in the daemon
 BOOK_CAP = 4096
 
+# newest book entries gossiped in stats() for the fleet router's
+# replicated price book (fleet/pricebook.py): bounded so a stats answer
+# stays a small wire line even with a full book
+BOOK_GOSSIP_CAP = 64
+
 _LOCK = threading.Lock()
 _BOOK: "OrderedDict[str, float]" = OrderedDict()  # spgemm-lint: guarded-by(_LOCK)
 # autotune class -> representative folder (same LRU discipline)
@@ -170,12 +175,17 @@ def stats() -> dict:
     """Live placement state for spgemmd stats: book size/hit rate and the
     admission routing histogram."""
     with _LOCK:
+        # the gossip sample: newest (most-recently-used) signatures
+        # first -- the slice of the book a federation router most wants
+        # replicated (what this daemon priced lately)
+        newest = list(_BOOK.items())[-BOOK_GOSSIP_CAP:]
         return {"book_entries": len(_BOOK),
                 "book_hits": _STATS["book_hits"],
                 "book_misses": _STATS["book_misses"],
                 "routed": dict(_STATS["routed"]),
                 "large_mass_pairs": LARGE_MASS_PAIRS,
-                "large_input_bytes": LARGE_INPUT_BYTES}
+                "large_input_bytes": LARGE_INPUT_BYTES,
+                "book": {sig: mass for sig, mass in newest}}
 
 
 def clear() -> None:

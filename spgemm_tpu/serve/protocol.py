@@ -1,5 +1,5 @@
 """spgemmd wire protocol: versioned newline-delimited JSON over a unix
-domain socket.
+domain socket, and (knob-gated) the same byte stream over TCP.
 
 One request per line, one response line per request, connections may carry
 any number of requests.  Every message is a JSON object; requests carry
@@ -80,13 +80,18 @@ import tempfile
 
 from spgemm_tpu.utils import knobs
 
-PROTOCOL_VERSION = 3
+PROTOCOL_VERSION = 4
 
 # versions the daemon still speaks: v2 added the optional submit `tenant`
 # field (absent = DEFAULT_TENANT), v3 the optional submit `trace` field
-# (absent = the daemon mints the trace context) -- v1/v2 requests parse
-# unchanged, so old clients keep working against a new daemon
-ACCEPTED_VERSIONS = (1, 2, 3)
+# (absent = the daemon mints the trace context), v4 the fleet layer's
+# RESPONSE-side fields only (`backend` on submit/status/wait answers,
+# `backends` on stats -- authored by the federation router, ignored by
+# older clients) -- v1..v3 requests parse unchanged, and because v4 adds
+# no request field, FIELD_MIN_VERSION and client stamping are untouched:
+# a v4 router/daemon serves v3 clients and a v3 daemon serves v4 clients
+# without a downgrade retry
+ACCEPTED_VERSIONS = (1, 2, 3, 4)
 
 # THE declarative wire registry (one table per direction, not one ad-hoc
 # literal per call site): op -> field -> the lowest protocol version
@@ -118,9 +123,10 @@ REQUEST_FIELDS: dict[str, dict[str, int]] = {
 # version and old clients ignore unknown keys), so a min version here
 # documents the introduction point rather than driving negotiation
 RESPONSE_FIELDS: dict[str, dict[str, int]] = {
-    "submit": {"id": 1, "state": 1, "queued": 1, "trace": 3},
-    "status": {"job": 1},
-    "wait": {"job": 1},
+    "submit": {"id": 1, "state": 1, "queued": 1, "trace": 3,
+               "backend": 4},
+    "status": {"job": 1, "backend": 4},
+    "wait": {"job": 1, "backend": 4},
     "stats": {"daemon": 1, "uptime_s": 1, "degraded": 1,
               "degrade_reason": 1, "backend_probe": 1, "queue_cap": 1,
               "job_timeout_s": 1, "jobs": 1, "jobs_terminal": 1,
@@ -128,7 +134,7 @@ RESPONSE_FIELDS: dict[str, dict[str, int]] = {
               "tenant_inflight_cap": 2, "placement": 2, "journal": 1,
               "failpoints": 1, "trace": 3, "events": 3, "profile": 3,
               "slo": 3, "flight_dir": 3, "plan_cache": 1, "delta": 1,
-              "warm": 1, "tune": 3, "socket": 1},
+              "warm": 1, "tune": 3, "socket": 1, "backends": 4},
     "metrics": {"content_type": 1, "text": 1},
     "trace": {"spans": 1, "trace_events": 1},
     "profile": {"profile": 1},
@@ -230,6 +236,11 @@ ERROR_CODES: dict[str, str] = {
                      "(in a failed job's error dict)",
     "job-error": "the chain runner raised "
                  "(in a failed job's error dict)",
+    "backend-lost": "fleet router: the backend holding the job died and "
+                    "the one idempotent re-submit to a healthy peer was "
+                    "not possible (already retried, or no healthy peer)",
+    "no-backend": "fleet router: no healthy backend available for "
+                  "placement (all dead, degraded, or still unprobed)",
 }
 
 # request-level error codes
@@ -247,6 +258,10 @@ E_UNAVAILABLE = "daemon-unavailable"
 E_JOB_TIMEOUT = "job-timeout"
 E_EXECUTOR_DIED = "executor-died"
 E_JOB_ERROR = "job-error"
+
+# fleet-router codes (fleet/router.py mints them, never a daemon)
+E_BACKEND_LOST = "backend-lost"
+E_NO_BACKEND = "no-backend"
 
 
 def protocol_table_md() -> str:
@@ -323,6 +338,55 @@ def default_socket_path() -> str:
         return configured
     return os.path.join(tempfile.gettempdir(),
                         f"spgemmd-{os.getuid()}.sock")
+
+
+def parse_addr(spec: str):
+    """Parse one wire address spec into ("tcp", host, port) or
+    ("unix", path).  `tcp:HOST:PORT` is the network front-end form
+    (IPv6 hosts use their last colon as the port separator; port 0 is
+    legal -- the listener binds an ephemeral port and reports it);
+    `unix:PATH` or a bare path is the unix-domain form.  ValueError on
+    anything else, naming the spec -- an address typo must fail loudly,
+    never fall back to a default socket."""
+    if not isinstance(spec, str) or not spec:
+        raise ValueError(f"empty wire address spec {spec!r}")
+    if spec.startswith("tcp:"):
+        host, sep, port = spec[4:].rpartition(":")
+        if not sep or not host:
+            raise ValueError(
+                f"bad tcp address {spec!r} (want tcp:HOST:PORT)")
+        try:
+            port_no = int(port)
+        except ValueError:
+            raise ValueError(
+                f"bad tcp port in {spec!r} (want tcp:HOST:PORT)") from None
+        if not 0 <= port_no <= 65535:
+            raise ValueError(f"tcp port out of range in {spec!r}")
+        return ("tcp", host.strip("[]"), port_no)
+    if spec.startswith("unix:"):
+        path = spec[5:]
+        if not path:
+            raise ValueError(f"empty unix path in {spec!r}")
+        return ("unix", path)
+    return ("unix", spec)
+
+
+def format_addr(parsed) -> str:
+    """The canonical spec string for a parse_addr() result (stable
+    identity for backend labels and log lines)."""
+    if parsed[0] == "tcp":
+        return f"tcp:{parsed[1]}:{parsed[2]}"
+    return f"unix:{parsed[1]}"
+
+
+def default_addr() -> str:
+    """The client's default target: SPGEMM_TPU_SERVE_ADDR when exported
+    (the TCP front-end -- clients on other hosts share the export), else
+    the local unix socket path."""
+    configured = knobs.get("SPGEMM_TPU_SERVE_ADDR")
+    if configured:
+        return configured
+    return default_socket_path()
 
 
 def encode(msg: dict) -> bytes:

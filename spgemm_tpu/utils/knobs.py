@@ -225,6 +225,34 @@ _KNOBS = (
          "<tmpdir>/spgemmd-<uid>.sock); the on-disk job journal lives "
          "next to it at <socket>.journal.",
          "serve/protocol.py"),
+    Knob("SPGEMM_TPU_SERVE_ADDR", "str",
+         "spgemmd TCP front-end address, `tcp:HOST:PORT` (e.g. "
+         "tcp:127.0.0.1:7463; port 0 binds ephemeral and the daemon "
+         "logs the real port): the daemon listens HERE beside the unix "
+         "socket, same newline-JSON protocol / version negotiation / "
+         "line cap / conn cap / idle timeout, and clients that inherit "
+         "the export dial it by default.  Unset = unix-socket only -- "
+         "byte-identical to the pre-fleet daemon (the whole-feature "
+         "A/B).  A malformed spec fails startup loudly (never a "
+         "silently unix-only daemon).",
+         "serve/protocol.py"),
+    Knob("SPGEMM_TPU_ROUTER_BACKENDS", "str",
+         "spgemm-router backend list: comma-joined wire addresses "
+         "(`tcp:HOST:PORT` or unix socket paths) of the spgemmd "
+         "instances the federation router fronts (fleet/router.py; "
+         "`cli route --backends` overrides).  Each backend is polled "
+         "for health/depth/slices and priced into placement; a dead or "
+         "degraded backend is excluded exactly like a degraded slice.  "
+         "Empty/unset with no --backends fails router startup loudly.",
+         "fleet/router.py"),
+    Knob("SPGEMM_TPU_ROUTER_POLL_S", "float",
+         "spgemm-router backend health/price-book poll cadence, "
+         "seconds: each cycle refreshes every backend's stats op "
+         "(queue depth, slices, degraded flag, placement price-book "
+         "sample) off the request path; a backend that fails its poll "
+         "is marked down until a later poll answers.  Smaller = faster "
+         "failure detection, more stats traffic.",
+         "fleet/router.py", default="2", minimum=0.1),
     Knob("SPGEMM_TPU_SERVE_SLICES", "str",
          "spgemmd device-pool slice spec (parallel/mesh.slice_pool): "
          "terms [COUNTx]WIDTH[*] joined by '+', or 'auto' (one "

@@ -101,3 +101,14 @@ def bad_accum_route_knob_read():
     # raw read is a KNB finding (registered in utils/knobs.py, read via
     # knobs.get in ops/symbolic.py)
     return os.environ.get("SPGEMM_TPU_ACCUM_ROUTE", "auto")  # seeded KNB
+
+
+def bad_fleet_knob_reads():
+    # the fleet-layer knobs (TCP front-end + router) are registry knobs
+    # like any other: raw reads are KNB findings (registered in
+    # utils/knobs.py, read via knobs.get in serve/protocol.py and
+    # fleet/router.py)
+    addr = os.environ.get("SPGEMM_TPU_SERVE_ADDR")  # seeded KNB
+    fleet = os.getenv("SPGEMM_TPU_ROUTER_BACKENDS", "")  # seeded KNB
+    poll = environ["SPGEMM_TPU_ROUTER_POLL_S"]  # seeded KNB
+    return addr, fleet, poll

@@ -1,0 +1,337 @@
+"""Fleet layer (PR 20 tentpole): the TCP front-end on spgemmd
+(`SPGEMM_TPU_SERVE_ADDR` / `--addr`) and the spgemm-router federation
+front door (`spgemm_tpu/fleet/`) -- all tier-1 on the CPU backend with
+fake runners (the network/placement plane under test is jax-free).
+
+The standing contracts:
+  * the TCP listener speaks the SAME newline-JSON protocol as the unix
+    socket -- version negotiation, line cap, malformed-line survival,
+    and the structured error surface are transport-independent;
+  * `SPGEMM_TPU_SERVE_ADDR` unset = no TCP listener at all (the
+    whole-feature A/B: byte-identical pre-fleet daemon);
+  * the router forwards `tenant` and the client-minted `trace` context
+    untouched, answers under FLEET job ids with a `backend` field, and
+    enforces placement over healthy backends only;
+  * a backend that dies mid-job fails over ONCE to a healthy peer
+    (idempotent re-submit) or the caller gets structured
+    `backend-lost`/`no-backend` -- never a hang.
+"""
+
+import json
+import socket
+
+import numpy as np
+import pytest
+
+from spgemm_tpu.fleet.pricebook import PriceBook
+from spgemm_tpu.fleet.router import Router, _label_scrape
+from spgemm_tpu.serve import client, protocol
+from spgemm_tpu.serve.daemon import Daemon
+from spgemm_tpu.utils import io_text
+from spgemm_tpu.utils.blockcsr import BlockSparseMatrix
+from spgemm_tpu.utils.gen import random_chain
+from spgemm_tpu.utils.semantics import chain_oracle
+
+
+def _chain_folder(tmp_path, n=3, k=2, seed=7, name="chain_in"):
+    mats = random_chain(n, 4, k, 0.5, np.random.default_rng(seed), "full")
+    folder = str(tmp_path / name)
+    io_text.write_chain_dir(folder, mats, k)
+    want = chain_oracle([m.to_dict() for m in mats], k)
+    want_bytes = io_text.format_matrix(BlockSparseMatrix.from_dict(
+        mats[0].rows, mats[-1].cols, k, want).prune_zeros())
+    return folder, want_bytes
+
+
+@pytest.fixture
+def make_daemon(tmp_path):
+    """Daemon factory on a per-test socket (+ optional TCP front-end);
+    stops them on teardown."""
+    daemons = []
+
+    def _make(idx=0, **kw):
+        d = Daemon(str(tmp_path / f"d{idx}.sock"), **kw)
+        d.start()
+        daemons.append(d)
+        return d
+
+    yield _make
+    for d in daemons:
+        d.stop()
+
+
+@pytest.fixture
+def make_router(make_daemon):
+    """(router, [daemons]) over N fake-runner daemons, all on TCP."""
+    routers = []
+
+    def _make(n=2, router_kw=None, **daemon_kw):
+        daemon_kw.setdefault("runner", lambda job, degraded=False: None)
+        ds = [make_daemon(idx=i, addr="tcp:127.0.0.1:0", **daemon_kw)
+              for i in range(n)]
+        r = Router(listen="tcp:127.0.0.1:0",
+                   backends=[f"tcp:127.0.0.1:{d.tcp_port}" for d in ds],
+                   poll_s=0.2, **(router_kw or {}))
+        r.start()
+        routers.append(r)
+        return r, ds
+
+    yield _make
+    for r in routers:
+        r.stop()
+
+
+def _tcp_roundtrip(port: int, payload: bytes) -> dict:
+    """One raw line out over TCP, one response line back."""
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=10.0) as s:
+        try:
+            s.sendall(payload)
+        except BrokenPipeError:
+            pass  # answer-and-close races the send, response readable
+        for line in protocol.read_lines(s):
+            return json.loads(line)
+    raise AssertionError("no response line")
+
+
+def _addr(obj) -> str:
+    port = obj.tcp_port if isinstance(obj, Daemon) else obj.port
+    return f"tcp:127.0.0.1:{port}"
+
+
+# ------------------------------------------------------------ parse_addr --
+def test_parse_addr_spellings():
+    assert protocol.parse_addr("tcp:127.0.0.1:7463") == \
+        ("tcp", "127.0.0.1", 7463)
+    assert protocol.parse_addr("tcp:[::1]:80") == ("tcp", "::1", 80)
+    assert protocol.parse_addr("tcp:host:0") == ("tcp", "host", 0)
+    assert protocol.parse_addr("unix:/tmp/x.sock") == \
+        ("unix", "/tmp/x.sock")
+    assert protocol.parse_addr("/tmp/bare.sock") == \
+        ("unix", "/tmp/bare.sock")
+    assert protocol.format_addr(("tcp", "h", 1)) == "tcp:h:1"
+    assert protocol.format_addr(("unix", "/p")) == "unix:/p"
+    for bad in ("", "tcp:", "tcp:hostonly", "tcp::", "tcp:h:notaport",
+                "tcp:h:70000", "unix:"):
+        with pytest.raises(ValueError):
+            protocol.parse_addr(bad)
+
+
+# ------------------------------------------------------- TCP front-end --
+def test_unset_addr_means_no_tcp_listener(make_daemon):
+    """The whole-feature A/B: no SPGEMM_TPU_SERVE_ADDR, no --addr =
+    exactly the pre-fleet unix-only daemon."""
+    d = make_daemon(runner=lambda job, degraded=False: None)
+    assert d.tcp_port is None and d._tcp_listener is None
+
+
+def test_non_tcp_addr_fails_startup_loudly(tmp_path):
+    with pytest.raises(ValueError, match="SPGEMM_TPU_SERVE_ADDR"):
+        Daemon(str(tmp_path / "d.sock"), addr="unix:/elsewhere.sock",
+               runner=lambda job, degraded=False: None)
+
+
+def test_tcp_listener_serves_the_same_protocol(make_daemon):
+    """stats over TCP == stats over the unix socket, same daemon."""
+    d = make_daemon(addr="tcp:127.0.0.1:0",
+                    runner=lambda job, degraded=False: None)
+    assert isinstance(d.tcp_port, int) and d.tcp_port > 0
+    over_tcp = client.stats(_addr(d))
+    over_unix = client.stats(d.socket_path)
+    assert over_tcp["daemon"] == over_unix["daemon"] == "spgemmd"
+    assert over_tcp["socket"] == over_unix["socket"]
+
+
+def test_malformed_tcp_line_gets_error_and_daemon_survives(make_daemon):
+    d = make_daemon(addr="tcp:127.0.0.1:0",
+                    runner=lambda job, degraded=False: None)
+    resp = _tcp_roundtrip(d.tcp_port, b"this is not json\n")
+    assert resp["ok"] is False
+    assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+    # oversized line: answered structured, connection dropped, and the
+    # daemon keeps serving the next connection
+    resp = _tcp_roundtrip(d.tcp_port,
+                          b"x" * (protocol.MAX_LINE_BYTES + 2))
+    assert resp["ok"] is False
+    assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+    assert client.stats(_addr(d))["daemon"] == "spgemmd"
+
+
+def test_tcp_negotiation_old_client_direction(make_daemon):
+    """Rolling upgrade, old-client-vs-new-daemon over TCP: a bare v1
+    line is served; an impossible version is rejected naming what the
+    daemon accepts (the downgrade handshake's raw material)."""
+    d = make_daemon(addr="tcp:127.0.0.1:0",
+                    runner=lambda job, degraded=False: None)
+    resp = _tcp_roundtrip(d.tcp_port,
+                          protocol.encode({"v": 1, "op": "stats"}))
+    assert resp["ok"] is True and resp["daemon"] == "spgemmd"
+    resp = _tcp_roundtrip(d.tcp_port,
+                          protocol.encode({"v": 99, "op": "stats"}))
+    assert resp["ok"] is False
+    assert resp["error"]["code"] == protocol.E_BAD_REQUEST
+    assert protocol.accepted_from_error(resp["error"]["message"]) == \
+        protocol.ACCEPTED_VERSIONS
+
+
+def test_tcp_negotiation_new_client_direction(tmp_path, make_daemon,
+                                              monkeypatch):
+    """Rolling upgrade, new-client-vs-old-daemon over TCP: the client's
+    one-shot downgrade retry (strip + restamp) works unchanged through
+    the TCP transport."""
+    folder, _ = _chain_folder(tmp_path)
+    d = make_daemon(addr="tcp:127.0.0.1:0",
+                    runner=lambda job, degraded=False: None)
+    monkeypatch.setattr(protocol, "ACCEPTED_VERSIONS", (1, 2))
+    sent = []
+    real_encode = protocol.encode
+    monkeypatch.setattr(client.protocol, "encode",
+                        lambda msg: sent.append(msg) or real_encode(msg))
+    resp = client.submit(folder, _addr(d), tenant="alice")
+    reqs = [m for m in sent if m.get("op") == "submit"]
+    assert [m["v"] for m in reqs] == [3, 2]
+    assert "trace" not in reqs[1] and reqs[1]["tenant"] == "alice"
+    assert resp["ok"] and resp["id"]
+
+
+def test_tcp_client_unavailable_is_structured(tmp_path):
+    """No listener behind the port: the TCP client raises the same
+    structured daemon-unavailable the unix path does, within budget."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here now
+    with pytest.raises(client.ServeError) as ei:
+        client.request({"op": "stats"}, f"tcp:127.0.0.1:{port}",
+                       retry_total_s=0.2)
+    assert ei.value.code == protocol.E_UNAVAILABLE
+
+
+# ------------------------------------------------------------ pricebook --
+def test_pricebook_merge_lookup_and_bounds(tmp_path):
+    book = PriceBook(cap=2)
+    folder, _ = _chain_folder(tmp_path)
+    from spgemm_tpu.serve import placement
+    sig = placement.signature(folder)
+    assert book.lookup(folder) is None  # first contact
+    assert book.merge({"book": {sig: 123.0, "other": 7}}) == 2
+    assert book.lookup(folder) == 123.0
+    # malformed gossip contributes nothing
+    assert book.merge(None) == 0
+    assert book.merge({"book": {1: "nan"}}) == 0
+    # LRU cap: a third signature evicts the oldest untouched one
+    assert book.merge({"book": {"third": 9.0}}) == 1
+    assert book.stats()["book_entries"] == 2
+
+
+# --------------------------------------------------------------- router --
+def test_router_requires_backends():
+    with pytest.raises(ValueError, match="at least one backend"):
+        Router(listen="tcp:127.0.0.1:0", backends=[])
+    with pytest.raises(ValueError, match="duplicate"):
+        Router(listen="tcp:127.0.0.1:0",
+               backends=["tcp:127.0.0.1:1", "tcp:127.0.0.1:1"])
+
+
+def test_router_passes_tenant_and_trace_through(tmp_path, make_router):
+    """The client-minted trace context and the tenant reach the backend
+    byte-for-byte; the answer comes back under the FLEET id with the
+    serving backend named."""
+    folder, _ = _chain_folder(tmp_path)
+    r, ds = make_router()
+    trace = protocol.mint_trace()
+    resp = client.submit(folder, _addr(r), tenant="alice", trace=trace)
+    assert resp["id"].startswith("r")
+    assert resp["backend"] in r._backends
+    assert resp["trace"] == trace
+    st = client.wait(resp["id"], _addr(r), timeout=30)
+    job = st["job"]
+    assert job["id"] == resp["id"]  # fleet id, not the backend's
+    assert job["state"] == "done"
+    assert job["tenant"] == "alice" and job["trace"] == trace
+    assert st["backend"] == resp["backend"]
+
+
+def test_router_rejects_bad_tenant_and_unknown_job(tmp_path, make_router):
+    folder, _ = _chain_folder(tmp_path)
+    r, _ = make_router()
+    with pytest.raises(client.ServeError) as ei:
+        client.submit(folder, _addr(r), tenant="bad tenant!")
+    assert ei.value.code == protocol.E_BAD_REQUEST
+    with pytest.raises(client.ServeError) as ei:
+        client.status("r999", _addr(r))
+    assert ei.value.code == protocol.E_UNKNOWN_JOB
+
+
+def test_router_no_backend_when_all_dead(tmp_path):
+    """Backends that never answered a poll are unplaceable: submit gets
+    structured no-backend, never a hang."""
+    folder, _ = _chain_folder(tmp_path)
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    r = Router(listen="tcp:127.0.0.1:0",
+               backends=[f"tcp:127.0.0.1:{dead_port}"], poll_s=30.0)
+    r.start()
+    try:
+        with pytest.raises(client.ServeError) as ei:
+            client.submit(folder, _addr(r))
+        assert ei.value.code == protocol.E_NO_BACKEND
+    finally:
+        r.stop()
+
+
+def test_router_fails_over_to_survivor(tmp_path, make_router):
+    """The backend holding a job dies; the next status through the
+    router re-submits ONCE to the survivor and answers from there --
+    and with no survivor, the caller gets structured backend-lost."""
+    folder, _ = _chain_folder(tmp_path)
+    r, ds = make_router(n=2)
+    resp = client.submit(folder, _addr(r), tenant="alice")
+    first = resp["backend"]
+    victim = next(d for d in ds
+                  if f"tcp:127.0.0.1:{d.tcp_port}" == first)
+    survivor_name = next(n for n in r._backends if n != first)
+    victim.stop()
+    st = client.wait(resp["id"], _addr(r), timeout=30)
+    assert st["job"]["state"] == "done"
+    assert st["backend"] == survivor_name
+    stats = client.stats(_addr(r))
+    assert stats["jobs"]["failovers"] == 1
+    assert stats["backends"][first]["up"] is False
+    # one-shot: kill the survivor too and the SAME job now reports
+    # backend-lost instead of a second silent re-submit
+    next(d for d in ds if d is not victim).stop()
+    with pytest.raises(client.ServeError) as ei:
+        client.status(resp["id"], _addr(r))
+    assert ei.value.code == protocol.E_BACKEND_LOST
+
+
+def test_router_metrics_aggregation(tmp_path, make_router):
+    """One scrape: router families per backend + every backend's own
+    series relabeled with backend= (labels merged, not clobbered)."""
+    folder, _ = _chain_folder(tmp_path)
+    r, ds = make_router()
+    client.submit(folder, _addr(r))
+    text = client.metrics(_addr(r))
+    for name in r._backends:
+        assert f'spgemm_router_backend_up{{backend="{name}"}} 1' in text
+    assert "spgemm_router_failovers_total 0" in text
+    relabeled = [ln for ln in text.splitlines()
+                 if 'backend="' in ln
+                 and not ln.startswith("spgemm_router_")]
+    assert relabeled, "no backend-relabeled passthrough series"
+
+
+def test_label_scrape_injects_not_clobbers():
+    out = _label_scrape('# HELP x y\na{b="c"} 1\nplain 2\n', 'be"1')
+    assert out.splitlines() == [
+        'a{backend="be\\"1",b="c"} 1', 'plain{backend="be\\"1"} 2']
+
+
+def test_router_shutdown_op_stops(tmp_path, make_router):
+    r, _ = make_router()
+    resp = client.shutdown(_addr(r))
+    assert resp["stopping"] is True
+    assert r._stop.wait(5.0)

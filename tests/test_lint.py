@@ -118,7 +118,7 @@ def test_knb_fixture_each_violation_caught():
     the same fixture (how harnesses and tests drive knob values) must NOT
     be."""
     findings = lint_file(os.path.join(FIXTURES, "badknob.py"))
-    assert [f.rule for f in findings] == ["KNB"] * 22
+    assert [f.rule for f in findings] == ["KNB"] * 25
     msgs = " ".join(f.message for f in findings)
     for seeded in ("SPGEMM_TPU_SEEDED_A", "SPGEMM_TPU_SEEDED_B",
                    "SPGEMM_TPU_SEEDED_C", "SPGEMM_TPU_PLAN_AHEAD",
@@ -136,7 +136,10 @@ def test_knb_fixture_each_violation_caught():
                    "SPGEMM_TPU_WARM_MAX_MB",
                    "SPGEMM_TPU_SERVE_BATCH_K",
                    "SPGEMM_TPU_SERVE_BATCH_WINDOW_S",
-                   "SPGEMM_TPU_ACCUM_ROUTE"):
+                   "SPGEMM_TPU_ACCUM_ROUTE",
+                   "SPGEMM_TPU_SERVE_ADDR",
+                   "SPGEMM_TPU_ROUTER_BACKENDS",
+                   "SPGEMM_TPU_ROUTER_POLL_S"):
         assert seeded in msgs  # the finding names the offending knob
 
 
@@ -1665,7 +1668,7 @@ def test_json_report_fixture_run():
     # constant; badevent: 2 undeclared kinds + 1 computed kind;
     # DRF stays quiet like FPT's registry direction (no registry module
     # in the fixture unit set -- staledrift.py alone yields nothing)
-    assert report["counts"] == {"FLD": 9, "KNB": 22, "BKD": 5, "THR": 3,
+    assert report["counts"] == {"FLD": 9, "KNB": 25, "BKD": 5, "THR": 3,
                                 "LCK": 2, "BLK": 3, "TSI": 3,
                                 "EXC": 3, "MET": 10, "FPT": 3,
                                 "PRO": 9, "EVT": 3, "DRF": 0, "DOC": 1,

@@ -265,9 +265,13 @@ def test_version_for_is_the_capability_table():
 def test_registry_min_versions_span_the_protocol():
     """The registry declares at least one field at every version up to
     PROTOCOL_VERSION (otherwise the version constant has drifted past
-    the tables), and FIELD_MIN_VERSION is exactly the post-v1 slice of
+    the tables) -- response-side-only versions count (v4 adds only the
+    fleet router's `backend`/`backends` answer fields, so clients never
+    stamp it) -- and FIELD_MIN_VERSION is exactly the post-v1 slice of
     the request tables."""
-    all_versions = {v for fields in protocol.REQUEST_FIELDS.values()
+    all_versions = {v for table in (protocol.REQUEST_FIELDS,
+                                    protocol.RESPONSE_FIELDS)
+                    for fields in table.values()
                     for v in fields.values()}
     assert set(range(2, protocol.PROTOCOL_VERSION + 1)) <= all_versions
     derived = {name: v for fields in protocol.REQUEST_FIELDS.values()

@@ -481,3 +481,66 @@ def test_cli_warm_clear_refuses_live_dir(monkeypatch, tmp_path):
         assert warmstore.scan(str(tmp_path))["plans"] == 1
     finally:
         holder.close()
+
+
+# ------------------------------------------------- fleet warm seeding
+
+
+def test_warm_clone_serves_first_contact(monkeypatch, tmp_path):
+    """`warm --clone` fleet seeding: a dir cloned from a peer must serve
+    the destination's FIRST same-structure contact from disk
+    (warm_hits >= 1, byte-identical plan) -- and a skewed or unreadable
+    source entry is a counted skip, never a crash or a bad copy."""
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", src)
+    p1 = _host_plan(seed=31)
+    warmstore.flush()
+    warmstore.reset()  # the peer is done; its flock is gone
+    # poison the source with skew + junk the clone must skip
+    np.savez(os.path.join(src, "plan-deadbeef.npz"),
+             schema=np.int64(999), kind=np.array("plan"))
+    with open(os.path.join(src, "plan-junk.npz"), "wb") as f:
+        f.write(b"not an npz")
+    result = warmstore.clone(src, dst)
+    assert result["copied"] == 1
+    assert result["skip_reasons"] == {"schema-skew": 1, "unreadable": 1}
+    # idempotent: a re-clone keeps the existing local entry
+    again = warmstore.clone(src, dst)
+    assert again["copied"] == 0
+    assert again["skip_reasons"].get("exists") == 1
+    # the seeded dir serves the destination's first contact warm
+    plancache.clear()
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", dst)
+    p2 = _host_plan(seed=31)
+    st = warmstore.stats()
+    assert st["plan_hits"] >= 1 and st["corrupt"] == 0
+    _assert_plans_equal(p1, p2)
+
+
+def test_cli_warm_clone_and_live_dst_refusal(monkeypatch, tmp_path,
+                                             capsys):
+    """The CLI spelling (`warm --clone SRC --dir DST`) clones, and a
+    destination held by a live process refuses exactly like --clear."""
+    import fcntl
+
+    from spgemm_tpu import cli
+
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    monkeypatch.setenv("SPGEMM_TPU_WARM_DIR", src)
+    _host_plan(seed=32)
+    warmstore.flush()
+    warmstore.reset()
+    assert cli.run(["warm", "--clone", src, "--dir", dst]) == 0
+    assert "cloned 1 entries" in capsys.readouterr().out
+    assert warmstore.scan(dst)["plans"] == 1
+    # a "daemon" holds the destination: seeding must refuse
+    os.makedirs(os.path.join(dst), exist_ok=True)
+    holder = open(os.path.join(dst, "lock"), "a+")
+    fcntl.flock(holder.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+    try:
+        assert cli.run(["warm", "--clone", src, "--dir", dst]) == 1
+        assert "in use by a live process" in capsys.readouterr().err
+    finally:
+        holder.close()
+    # self-clone is a refusal, not a silent no-op
+    assert cli.run(["warm", "--clone", src, "--dir", src]) == 1
